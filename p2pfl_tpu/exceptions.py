@@ -1,0 +1,34 @@
+"""Framework exceptions.
+
+Reference equivalents: ``p2pfl/exceptions.py:21-36``,
+``p2pfl/learning/exceptions.py:21-31``,
+``p2pfl/communication/exceptions.py:20``.
+"""
+
+
+class NodeRunningException(Exception):
+    """Raised when an operation requires the node to be stopped (or vice versa)."""
+
+
+class LearnerNotSetException(Exception):
+    """Raised when a learning operation runs before a learner exists."""
+
+
+class ZeroRoundsException(Exception):
+    """Raised when learning is started with zero rounds."""
+
+
+class DecodingParamsError(Exception):
+    """Raised when a serialized weights payload cannot be decoded."""
+
+
+class ModelNotMatchingError(Exception):
+    """Raised when received parameters do not match the local model structure."""
+
+
+class NeighborNotConnectedError(Exception):
+    """Raised when sending to a neighbor that is not connected."""
+
+
+class CommunicationError(Exception):
+    """Raised on transport-level send failures."""

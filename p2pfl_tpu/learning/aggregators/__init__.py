@@ -1,0 +1,9 @@
+"""Aggregation strategies over jax.Array pytrees."""
+
+from p2pfl_tpu.learning.aggregators.aggregator import Aggregator
+from p2pfl_tpu.learning.aggregators.fedavg import FedAvg
+from p2pfl_tpu.learning.aggregators.fedmedian import FedMedian
+from p2pfl_tpu.learning.aggregators.krum import Krum
+from p2pfl_tpu.learning.aggregators.trimmed_mean import TrimmedMean
+
+__all__ = ["Aggregator", "FedAvg", "FedMedian", "Krum", "TrimmedMean"]

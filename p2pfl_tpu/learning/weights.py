@@ -1,0 +1,159 @@
+"""Weights containers and the wire codec.
+
+The reference ships weights as a pickle of a list of numpy arrays in
+state-dict order and zips them back positionally
+(``p2pfl/learning/pytorch/lightning_learner.py:113-138``). Here the payload
+is a self-describing binary format: a JSON header with named paths, shapes
+and dtypes, followed by raw little-endian buffers. This gives
+
+- name-aware (not positional) matching → architecture mismatch is detected
+  structurally, raising :class:`ModelNotMatchingError` instead of silently
+  loading wrong layers,
+- zero pickle (no arbitrary code execution from the wire),
+- native bfloat16 support via ml_dtypes.
+
+On transports that stay in-process (memory, mesh-collective) the pytree is
+passed by reference and never hits this codec — weights stay device-resident.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from p2pfl_tpu.exceptions import DecodingParamsError, ModelNotMatchingError
+
+Pytree = Any
+
+_MAGIC = b"P2TW"  # p2pfl-tpu weights
+_VERSION = 1
+
+_SEP = "/"
+
+
+def _flatten_named(tree: Pytree) -> dict[str, np.ndarray]:
+    """Flatten a pytree (nested dicts / dataclass pytrees) to path->array."""
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = _SEP.join(_path_part(p) for p in path)
+        flat[key] = np.asarray(leaf)
+    return flat
+
+
+def _path_part(p) -> str:
+    if hasattr(p, "key"):
+        return str(p.key)
+    if hasattr(p, "idx"):
+        return str(p.idx)
+    if hasattr(p, "name"):
+        return str(p.name)
+    return str(p)
+
+
+def encode_params(tree: Pytree) -> bytes:
+    """Serialize a params pytree to the self-describing wire format."""
+    flat = _flatten_named(tree)
+    entries = []
+    buffers = []
+    for key in sorted(flat):
+        arr = flat[key]
+        buf = np.ascontiguousarray(arr).tobytes()
+        entries.append({"k": key, "shape": list(arr.shape), "dtype": arr.dtype.name, "n": len(buf)})
+        buffers.append(buf)
+    header = json.dumps({"v": _VERSION, "t": entries}).encode("utf-8")
+    out = bytearray()
+    out += _MAGIC
+    out += struct.pack("<I", len(header))
+    out += header
+    for buf in buffers:
+        out += buf
+    return bytes(out)
+
+
+def decode_params(payload: bytes) -> dict[str, np.ndarray]:
+    """Decode the wire format to a flat ``{path: array}`` dict."""
+    try:
+        if payload[:4] != _MAGIC:
+            raise DecodingParamsError("bad magic — not a p2pfl_tpu weights payload")
+        (hlen,) = struct.unpack("<I", payload[4:8])
+        header = json.loads(payload[8 : 8 + hlen].decode("utf-8"))
+        if header["v"] != _VERSION:
+            raise DecodingParamsError(f"unsupported weights version {header['v']}")
+        flat = {}
+        off = 8 + hlen
+        for e in header["t"]:
+            dtype = _resolve_dtype(e["dtype"])
+            count = int(np.prod(e["shape"], dtype=np.int64)) if e["shape"] else 1
+            if e["n"] != count * dtype.itemsize:
+                raise DecodingParamsError(f"inconsistent header for {e['k']}: n={e['n']} vs shape {e['shape']}")
+            if off + e["n"] > len(payload):
+                raise DecodingParamsError(f"truncated payload at {e['k']}")
+            arr = np.frombuffer(payload, dtype=dtype, count=count, offset=off)
+            flat[e["k"]] = arr.reshape(e["shape"])
+            off += e["n"]
+        return flat
+    except DecodingParamsError:
+        raise
+    except Exception as exc:  # noqa: BLE001 — any malformed payload is a decode error
+        raise DecodingParamsError(str(exc)) from exc
+
+
+def _resolve_dtype(name: str) -> np.dtype:
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def restore_like(template: Pytree, flat: dict[str, np.ndarray]) -> Pytree:
+    """Rebuild a pytree with ``template``'s structure from a flat path dict.
+
+    Raises :class:`ModelNotMatchingError` on any structural mismatch — this is
+    the check that makes the reference's ``test_wrong_model`` scenario
+    (``test/node_test.py:155-176``) fail fast instead of hanging.
+    """
+    tmpl_flat = _flatten_named(template)
+    if set(tmpl_flat) != set(flat):
+        missing = set(tmpl_flat) ^ set(flat)
+        raise ModelNotMatchingError(f"param paths differ (symmetric diff: {sorted(missing)[:5]}...)")
+    leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(template)
+    new_leaves = []
+    for path, leaf in leaves_with_path:
+        key = _SEP.join(_path_part(p) for p in path)
+        arr = flat[key]
+        if tuple(arr.shape) != tuple(np.shape(leaf)):
+            raise ModelNotMatchingError(f"shape mismatch at {key}: {arr.shape} vs {np.shape(leaf)}")
+        new_leaves.append(jax.numpy.asarray(arr).astype(leaf.dtype))
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
+@dataclass
+class ModelUpdate:
+    """A model (or partial aggregation of models) moving through the network.
+
+    ``contributors`` is the set of node addresses whose local training is
+    already folded into ``params`` — the unit of the reference's
+    partial-aggregation algebra (``p2pfl/learning/aggregators/aggregator.py``).
+    ``num_samples`` is the total sample weight of those contributors.
+    """
+
+    params: Pytree
+    contributors: list[str] = field(default_factory=list)
+    num_samples: int = 1
+    encoded: Optional[bytes] = None  # populated lazily for byte transports
+
+    def encode(self) -> bytes:
+        if self.encoded is None:
+            self.encoded = encode_params(self.params)
+        return self.encoded
+
+    @staticmethod
+    def decode(payload: bytes, template: Pytree, contributors: list[str], num_samples: int) -> "ModelUpdate":
+        flat = decode_params(payload)
+        return ModelUpdate(restore_like(template, flat), list(contributors), num_samples)

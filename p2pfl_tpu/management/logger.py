@@ -1,0 +1,164 @@
+"""Logger singleton facade — the single observability funnel.
+
+Reference: ``p2pfl/management/logger.py:144-584``. Re-designed without the
+multiprocessing queue (plain stdlib logging handlers are enough and far
+simpler): colored stdout + optional rotating file, a per-node registry, the
+two metric stores, and lifecycle hooks.
+
+Per-node log lines are prefixed ``[addr]`` so N in-process simulated nodes
+remain distinguishable — same UX as the reference.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from logging.handlers import RotatingFileHandler
+from typing import Any, Dict, Optional, Tuple
+
+from p2pfl_tpu.management.metric_storage import GlobalMetricStorage, LocalMetricStorage
+from p2pfl_tpu.settings import Settings
+
+_COLORS = {
+    "DEBUG": "\033[90m",
+    "INFO": "\033[32m",
+    "WARNING": "\033[33m",
+    "ERROR": "\033[31m",
+    "CRITICAL": "\033[41m",
+}
+_RESET = "\033[0m"
+
+
+class _ColorFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        color = _COLORS.get(record.levelname, "")
+        record.levelcolor = f"{color}{record.levelname}{_RESET}"
+        return super().format(record)
+
+
+class P2pflLogger:
+    """Singleton. Use the module-level ``logger`` instance."""
+
+    _instance: Optional["P2pflLogger"] = None
+    _instance_lock = threading.Lock()
+
+    def __new__(cls) -> "P2pflLogger":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = super().__new__(cls)
+                cls._instance._init()
+            return cls._instance
+
+    def _init(self) -> None:
+        self._logger = logging.getLogger("p2pfl_tpu")
+        self._logger.setLevel(Settings.LOG_LEVEL)
+        self._logger.propagate = False
+        if not self._logger.handlers:
+            sh = logging.StreamHandler()
+            sh.setFormatter(_ColorFormatter("%(asctime)s | %(levelcolor)s | %(message)s", datefmt="%H:%M:%S"))
+            self._logger.addHandler(sh)
+        self._file_handler: Optional[logging.Handler] = None
+        self.local_metrics = LocalMetricStorage()
+        self.global_metrics = GlobalMetricStorage()
+        # addr -> (node_state, simulation_flag)
+        self._nodes: Dict[str, Tuple[Any, bool]] = {}
+        self._nodes_lock = threading.Lock()
+
+    # ---- setup ----
+
+    def set_level(self, level: str) -> None:
+        self._logger.setLevel(level)
+
+    def enable_file_logging(self, log_dir: Optional[str] = None) -> None:
+        if self._file_handler is not None:
+            return
+        log_dir = log_dir or Settings.LOG_DIR
+        os.makedirs(log_dir, exist_ok=True)
+        fh = RotatingFileHandler(os.path.join(log_dir, "p2pfl_tpu.log"), maxBytes=10_000_000, backupCount=3)
+        fh.setFormatter(logging.Formatter("%(asctime)s | %(levelname)s | %(message)s"))
+        self._logger.addHandler(fh)
+        self._file_handler = fh
+
+    # ---- leveled logging, keyed by node addr ----
+
+    def log(self, level: int, node: str, message: str) -> None:
+        self._logger.log(level, f"[{node}] {message}")
+
+    def debug(self, node: str, message: str) -> None:
+        self.log(logging.DEBUG, node, message)
+
+    def info(self, node: str, message: str) -> None:
+        self.log(logging.INFO, node, message)
+
+    def warning(self, node: str, message: str) -> None:
+        self.log(logging.WARNING, node, message)
+
+    def error(self, node: str, message: str) -> None:
+        self.log(logging.ERROR, node, message)
+
+    def critical(self, node: str, message: str) -> None:
+        self.log(logging.CRITICAL, node, message)
+
+    # ---- metrics (routing mirrors reference logger.py:392-438) ----
+
+    def log_metric(
+        self,
+        node: str,
+        metric: str,
+        value: float,
+        step: Optional[int] = None,
+        round: Optional[int] = None,  # noqa: A002 — reference API name
+        experiment: Optional[str] = None,
+    ) -> None:
+        exp = experiment or self._experiment_for(node) or "unknown-exp"
+        if round is None:
+            round = self._round_for(node)  # noqa: A001
+        if round is None:
+            round = 0  # noqa: A001
+        if step is None:
+            self.global_metrics.add_log(exp, round, metric, node, value)
+        else:
+            self.local_metrics.add_log(exp, round, metric, node, value, step)
+
+    def get_local_logs(self):
+        return self.local_metrics.get_all_logs()
+
+    def get_global_logs(self):
+        return self.global_metrics.get_all_logs()
+
+    # ---- node registry (reference logger.py:491-543) ----
+
+    def register_node(self, node: str, state: Any = None, simulation: bool = False) -> None:
+        with self._nodes_lock:
+            self._nodes[node] = (state, simulation)
+
+    def unregister_node(self, node: str) -> None:
+        with self._nodes_lock:
+            self._nodes.pop(node, None)
+
+    def _experiment_for(self, node: str) -> Optional[str]:
+        with self._nodes_lock:
+            entry = self._nodes.get(node)
+        state = entry[0] if entry else None
+        return getattr(state, "experiment_name", None) if state is not None else None
+
+    def _round_for(self, node: str) -> Optional[int]:
+        with self._nodes_lock:
+            entry = self._nodes.get(node)
+        state = entry[0] if entry else None
+        return getattr(state, "round", None) if state is not None else None
+
+    # ---- lifecycle hooks (stubs in the reference too, logger.py:549-581) ----
+
+    def experiment_started(self, node: str) -> None:
+        self.debug(node, "experiment started")
+
+    def experiment_finished(self, node: str) -> None:
+        self.debug(node, "experiment finished")
+
+    def round_finished(self, node: str) -> None:
+        self.debug(node, "round finished")
+
+
+logger = P2pflLogger()

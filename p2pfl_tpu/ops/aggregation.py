@@ -1,0 +1,95 @@
+"""Pure aggregation kernels over stacked pytrees.
+
+Each function takes a pytree whose leaves have a leading node axis
+``[N, ...]`` plus per-node scalars, and returns the aggregated pytree.
+All are jit-compatible pure functions — the strategy classes in
+``learning/aggregators`` wrap them with the partial-aggregation bookkeeping.
+
+The reference ships only FedAvg (``p2pfl/learning/aggregators/fedavg.py``);
+the robust family (median / trimmed mean / Krum) covers BASELINE config 4.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+
+
+@partial(jax.jit, static_argnames=("agg_dtype",))
+def fedavg(stacked: Pytree, weights: jax.Array, agg_dtype: str = "float32") -> Pytree:
+    """Sample-weighted mean. weights: [N] (unnormalized sample counts)."""
+    w = weights.astype(agg_dtype)
+    w = w / jnp.sum(w)
+
+    def avg(x):
+        return jnp.tensordot(w, x.astype(agg_dtype), axes=(0, 0)).astype(x.dtype)
+
+    return jax.tree.map(avg, stacked)
+
+
+@jax.jit
+def fedmedian(stacked: Pytree) -> Pytree:
+    """Coordinate-wise median across the node axis."""
+
+    def med(x):
+        return jnp.median(x.astype("float32"), axis=0).astype(x.dtype)
+
+    return jax.tree.map(med, stacked)
+
+
+@partial(jax.jit, static_argnames=("trim",))
+def trimmed_mean(stacked: Pytree, trim: int) -> Pytree:
+    """Coordinate-wise trimmed mean: drop ``trim`` lowest and highest per coord.
+
+    ``trim`` must satisfy ``2 * trim < N``. Robust to ``trim`` Byzantine nodes.
+    """
+
+    def tm(x):
+        n = x.shape[0]
+        xs = jnp.sort(x.astype("float32"), axis=0)
+        kept = jax.lax.slice_in_dim(xs, trim, n - trim, axis=0)
+        return jnp.mean(kept, axis=0).astype(x.dtype)
+
+    return jax.tree.map(tm, stacked)
+
+
+def _flatten_nodes(stacked: Pytree) -> jax.Array:
+    """[N, ...] pytree -> [N, P] matrix of all params per node (fp32)."""
+    leaves = [x.astype("float32").reshape(x.shape[0], -1) for x in jax.tree.leaves(stacked)]
+    return jnp.concatenate(leaves, axis=1)
+
+
+@partial(jax.jit, static_argnames=("n_byzantine", "multi"))
+def krum_select(stacked: Pytree, n_byzantine: int, multi: int = 1) -> jax.Array:
+    """Krum / Multi-Krum selection scores.
+
+    Returns the indices of the ``multi`` nodes with the lowest Krum score
+    (sum of squared distances to their ``N - f - 2`` nearest neighbors).
+    The [N, P] distance matrix is one MXU matmul: ``|a-b|^2 = |a|^2 + |b|^2 - 2ab``.
+    """
+    flat = _flatten_nodes(stacked)  # [N, P]
+    n = flat.shape[0]
+    sq = jnp.sum(flat * flat, axis=1)  # [N]
+    d2 = sq[:, None] + sq[None, :] - 2.0 * (flat @ flat.T)  # [N, N]
+    d2 = jnp.maximum(d2, 0.0)
+    d2 = d2.at[jnp.arange(n), jnp.arange(n)].set(jnp.inf)
+    k = max(n - n_byzantine - 2, 1)
+    nearest = jax.lax.top_k(-d2, k)[0]  # [N, k] negated distances
+    scores = -jnp.sum(nearest, axis=1)  # [N]
+    return jax.lax.top_k(-scores, multi)[1]  # indices of lowest scores
+
+
+def krum(stacked: Pytree, n_byzantine: int, multi: int = 1) -> Pytree:
+    """(Multi-)Krum aggregate: mean of the ``multi`` selected node models."""
+    idx = krum_select(stacked, n_byzantine, multi)
+
+    def pick(x):
+        sel = jnp.take(x, idx, axis=0).astype("float32")
+        return jnp.mean(sel, axis=0).astype(x.dtype)
+
+    return jax.tree.map(pick, stacked)

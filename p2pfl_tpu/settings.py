@@ -1,0 +1,76 @@
+"""Global configuration knobs.
+
+Mirrors the reference's single mutable ``Settings`` class
+(``p2pfl/settings.py:26-115``): class attributes mutated in place, read by
+every layer. Same knob names where the concept is the same, so users of the
+reference find what they expect; TPU-specific knobs are added at the bottom.
+"""
+
+from __future__ import annotations
+
+
+class Settings:
+    """Mutable global settings (class attributes, no instances needed)."""
+
+    # --- general ---
+    GRPC_TIMEOUT: float = 10.0  # seconds; also used by the memory transport
+    LOG_LEVEL: str = "INFO"
+    LOG_DIR: str = "logs"
+    EXCLUDE_BEAT_LOGS: bool = True
+
+    # --- heartbeat (membership / failure detection) ---
+    HEARTBEAT_PERIOD: float = 2.0
+    HEARTBEAT_TIMEOUT: float = 5.0
+
+    # --- gossip (message plane) ---
+    GOSSIP_PERIOD: float = 0.1
+    TTL: int = 10
+    GOSSIP_MESSAGES_PER_PERIOD: int = 100
+    AMOUNT_LAST_MESSAGES_SAVED: int = 100
+
+    # --- gossip (model plane) ---
+    GOSSIP_MODELS_PERIOD: float = 1.0
+    GOSSIP_MODELS_PER_ROUND: int = 2
+    GOSSIP_EXIT_ON_X_EQUAL_ROUNDS: int = 10
+
+    # --- learning round ---
+    TRAIN_SET_SIZE: int = 4
+    VOTE_TIMEOUT: float = 60.0
+    AGGREGATION_TIMEOUT: float = 300.0
+    WAIT_HEARTBEATS_CONVERGENCE: float = 1.0
+
+    # --- monitoring ---
+    RESOURCE_MONITOR_PERIOD: float = 1.0
+
+    # --- TPU-native additions ---
+    # Default dtype for on-wire / aggregation math. bfloat16 keeps matmuls on
+    # the MXU; aggregation accumulates in float32 for exactness.
+    COMPUTE_DTYPE: str = "bfloat16"
+    AGG_DTYPE: str = "float32"
+    # Donate weight buffers into jitted aggregation / train steps.
+    DONATE_BUFFERS: bool = True
+    # Mesh axis names used by the parallel runtime.
+    MESH_NODES_AXIS: str = "nodes"
+    MESH_MODEL_AXIS: str = "model"
+
+
+def set_test_settings() -> None:
+    """Shrink every timeout for fast tests.
+
+    Reference equivalent: ``p2pfl/utils.py:37-53``.
+    """
+    Settings.GRPC_TIMEOUT = 0.5
+    Settings.HEARTBEAT_PERIOD = 0.3
+    Settings.HEARTBEAT_TIMEOUT = 1.5
+    Settings.GOSSIP_PERIOD = 0.05
+    Settings.TTL = 10
+    Settings.GOSSIP_MESSAGES_PER_PERIOD = 100
+    Settings.AMOUNT_LAST_MESSAGES_SAVED = 100
+    Settings.GOSSIP_MODELS_PERIOD = 0.1
+    Settings.GOSSIP_MODELS_PER_ROUND = 4
+    Settings.GOSSIP_EXIT_ON_X_EQUAL_ROUNDS = 4
+    Settings.TRAIN_SET_SIZE = 4
+    Settings.VOTE_TIMEOUT = 10.0
+    Settings.AGGREGATION_TIMEOUT = 10.0
+    Settings.WAIT_HEARTBEATS_CONVERGENCE = 0.4
+    Settings.LOG_LEVEL = "DEBUG"

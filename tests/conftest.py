@@ -1,0 +1,22 @@
+"""Test config: force an 8-device virtual CPU mesh BEFORE jax initializes.
+
+This replaces the reference's "multi-node without a cluster" approach
+(real gRPC on loopback) with a virtual device mesh, per SURVEY.md §4.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+from p2pfl_tpu.settings import set_test_settings  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fast_settings():
+    set_test_settings()
+    yield

@@ -1,0 +1,235 @@
+"""Core learning-layer tests: codec roundtrip, aggregation kernels, the
+partial-aggregation algebra. Parity with reference ``test/learning_test.py``
+(encode/decode identity 38-47, FedAvg hand-built + weighted 50-71) plus the
+robust aggregators the reference lacks.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2pfl_tpu.exceptions import DecodingParamsError, ModelNotMatchingError
+from p2pfl_tpu.learning.aggregators import FedAvg, FedMedian, Krum, TrimmedMean
+from p2pfl_tpu.learning.weights import ModelUpdate, decode_params, encode_params, restore_like
+from p2pfl_tpu.ops.tree import tree_allclose, tree_stack, tree_weighted_mean
+
+
+def params_like(seed: float, dtype="float32"):
+    return {
+        "dense": {"kernel": jnp.full((4, 3), seed, dtype), "bias": jnp.full((3,), seed, dtype)},
+        "out": {"kernel": jnp.full((3, 2), 2 * seed, dtype)},
+    }
+
+
+# ---- codec ----
+
+def test_encode_decode_roundtrip():
+    p = params_like(1.5)
+    restored = restore_like(p, decode_params(encode_params(p)))
+    assert tree_allclose(p, restored, atol=0)
+    # re-encode identity (reference learning_test.py:38-47)
+    assert encode_params(restored) == encode_params(p)
+
+
+def test_encode_decode_bfloat16():
+    p = params_like(0.25, dtype="bfloat16")
+    restored = restore_like(p, decode_params(encode_params(p)))
+    assert all(x.dtype == jnp.bfloat16 for x in jax.tree.leaves(restored))
+    assert tree_allclose(p, restored, atol=0)
+
+
+def test_decode_garbage_raises():
+    with pytest.raises(DecodingParamsError):
+        decode_params(b"not a weights payload at all")
+
+
+def test_restore_structure_mismatch_raises():
+    p = params_like(1.0)
+    other = {"different": {"kernel": jnp.ones((4, 3))}}
+    with pytest.raises(ModelNotMatchingError):
+        restore_like(other, decode_params(encode_params(p)))
+
+
+def test_restore_shape_mismatch_raises():
+    p = params_like(1.0)
+    bad = jax.tree.map(lambda x: jnp.zeros(x.shape + (1,), x.dtype), p)
+    with pytest.raises(ModelNotMatchingError):
+        restore_like(bad, decode_params(encode_params(p)))
+
+
+# ---- pure aggregation math ----
+
+def test_weighted_mean_hand_values():
+    a, b = params_like(1.0), params_like(3.0)
+    # equal weights -> plain mean
+    out = tree_weighted_mean([a, b], [1.0, 1.0])
+    assert tree_allclose(out, params_like(2.0), atol=1e-6)
+    # 3:1 weights
+    out = tree_weighted_mean([a, b], [3.0, 1.0])
+    assert tree_allclose(out, params_like(1.5), atol=1e-6)
+
+
+def test_fedavg_aggregator_weighted_by_samples():
+    agg = FedAvg("n0")
+    agg.set_nodes_to_aggregate(["n0", "n1"])
+    agg.add_model(ModelUpdate(params_like(0.0), ["n0"], num_samples=1))
+    agg.add_model(ModelUpdate(params_like(4.0), ["n1"], num_samples=3))
+    result = agg.wait_and_get_aggregation(timeout=1)
+    assert tree_allclose(result.params, params_like(3.0), atol=1e-6)
+    assert result.contributors == ["n0", "n1"]
+    assert result.num_samples == 4
+
+
+def test_fedmedian_ignores_outlier():
+    models = [ModelUpdate(params_like(v), [f"n{i}"]) for i, v in enumerate([1.0, 1.0, 1.0, 1000.0])]
+    agg = FedMedian("n0")
+    out = agg.aggregate(models)
+    assert tree_allclose(out.params, params_like(1.0), atol=1e-5)
+
+
+def test_trimmed_mean_ignores_outliers():
+    vals = [1.0, 1.0, 1.0, 1.0, -500.0, 500.0]
+    models = [ModelUpdate(params_like(v), [f"n{i}"]) for i, v in enumerate(vals)]
+    out = TrimmedMean("n0", trim=1).aggregate(models)
+    assert tree_allclose(out.params, params_like(1.0), atol=1e-5)
+
+
+def test_krum_picks_clustered_model():
+    # 4 honest models near 1.0, 1 byzantine at 100 — krum must pick an honest one
+    vals = [1.0, 1.01, 0.99, 1.0, 100.0]
+    models = [ModelUpdate(params_like(v), [f"n{i}"]) for i, v in enumerate(vals)]
+    out = Krum("n0", n_byzantine=1).aggregate(models)
+    assert tree_allclose(out.params, params_like(1.0), atol=0.05)
+
+
+# ---- partial-aggregation algebra (reference aggregator.py:117-281) ----
+
+def test_partial_accumulation_completes():
+    agg = FedAvg("n0")
+    agg.set_nodes_to_aggregate(["a", "b", "c"])
+    assert agg.add_model(ModelUpdate(params_like(1.0), ["a"])) == ["a"]
+    assert agg.add_model(ModelUpdate(params_like(2.0), ["b", "c"], num_samples=2)) == ["a", "b", "c"]
+    out = agg.wait_and_get_aggregation(timeout=1)
+    assert set(out.contributors) == {"a", "b", "c"}
+
+
+def test_full_set_replaces_partials():
+    agg = FedAvg("n0")
+    agg.set_nodes_to_aggregate(["a", "b"])
+    agg.add_model(ModelUpdate(params_like(5.0), ["a"]))
+    agg.add_model(ModelUpdate(params_like(7.0), ["a", "b"], num_samples=2))
+    out = agg.wait_and_get_aggregation(timeout=1)
+    # full-coverage model replaced the partial entirely (reference 156-168)
+    assert tree_allclose(out.params, params_like(7.0), atol=1e-6)
+
+
+def test_overlapping_and_foreign_rejected():
+    agg = FedAvg("n0")
+    agg.set_nodes_to_aggregate(["a", "b", "c"])
+    agg.add_model(ModelUpdate(params_like(1.0), ["a", "b"], num_samples=2))
+    assert agg.add_model(ModelUpdate(params_like(9.0), ["b"])) == []       # overlap
+    assert agg.add_model(ModelUpdate(params_like(9.0), ["zz"])) == []      # foreign
+    assert agg.add_model(ModelUpdate(params_like(9.0), [])) == []          # empty
+    assert agg.get_aggregated_models() == ["a", "b"]
+
+
+def test_timeout_aggregates_partial_coverage():
+    agg = FedAvg("n0")
+    agg.set_nodes_to_aggregate(["a", "b"])
+    agg.add_model(ModelUpdate(params_like(2.0), ["a"]))
+    out = agg.wait_and_get_aggregation(timeout=0.1)  # 'b' never arrives
+    assert tree_allclose(out.params, params_like(2.0), atol=1e-6)
+    assert out.contributors == ["a"]
+
+
+def test_waiting_mode_takes_first_model():
+    agg = FedAvg("n0")
+    agg.set_waiting_aggregated_model(["a", "b"])
+    agg.add_model(ModelUpdate(params_like(3.0), ["a", "b"], num_samples=2))
+    out = agg.wait_and_get_aggregation(timeout=1)
+    assert tree_allclose(out.params, params_like(3.0), atol=1e-6)
+
+
+def test_get_partial_aggregation_excludes_covered():
+    agg = FedAvg("n0")
+    agg.set_nodes_to_aggregate(["a", "b", "c"])
+    agg.add_model(ModelUpdate(params_like(1.0), ["a"]))
+    agg.add_model(ModelUpdate(params_like(3.0), ["b"]))
+    # peer already has 'b' -> partial must only cover 'a'
+    partial = agg.get_partial_aggregation(["b"])
+    assert partial.contributors == ["a"]
+    assert tree_allclose(partial.params, params_like(1.0), atol=1e-6)
+    # peer has everything -> nothing to send
+    assert agg.get_partial_aggregation(["a", "b"]) is None
+
+
+def test_waiting_mode_first_update_wins():
+    agg = FedAvg("n0")
+    agg.set_waiting_aggregated_model(["a", "b"])
+    agg.add_model(ModelUpdate(params_like(3.0), ["a", "b"], num_samples=2))
+    assert agg.add_model(ModelUpdate(params_like(9.0), ["a"])) == []
+    out = agg.wait_and_get_aggregation(timeout=1)
+    assert tree_allclose(out.params, params_like(3.0), atol=1e-6)
+
+
+def test_robust_aggregator_rejects_partials():
+    agg = FedMedian("n0")
+    agg.set_nodes_to_aggregate(["a", "b", "c"])
+    # a pre-averaged partial would poison the median — must be rejected
+    assert agg.add_model(ModelUpdate(params_like(2.0), ["a", "b"], num_samples=2)) == []
+    assert agg.add_model(ModelUpdate(params_like(1.0), ["a"])) == ["a"]
+    # full coverage (diffusion of the final aggregate) is still accepted
+    assert agg.add_model(ModelUpdate(params_like(5.0), ["a", "b", "c"], num_samples=3)) == ["a", "b", "c"]
+
+
+def test_get_models_to_send_robust_sends_individuals():
+    agg = FedMedian("n0")
+    agg.set_nodes_to_aggregate(["a", "b", "c"])
+    agg.add_model(ModelUpdate(params_like(1.0), ["a"]))
+    agg.add_model(ModelUpdate(params_like(3.0), ["b"]))
+    sends = agg.get_models_to_send(["c"])
+    assert sorted(tuple(m.contributors) for m in sends) == [("a",), ("b",)]
+    # fedavg pre-aggregates instead
+    agg2 = FedAvg("n0")
+    agg2.set_nodes_to_aggregate(["a", "b", "c"])
+    agg2.add_model(ModelUpdate(params_like(1.0), ["a"]))
+    agg2.add_model(ModelUpdate(params_like(3.0), ["b"]))
+    sends2 = agg2.get_models_to_send(["c"])
+    assert len(sends2) == 1 and sorted(sends2[0].contributors) == ["a", "b"]
+
+
+def test_timeout_closes_window_for_next_round():
+    agg = FedAvg("n0")
+    agg.set_nodes_to_aggregate(["a", "b"])
+    agg.add_model(ModelUpdate(params_like(2.0), ["a"]))
+    agg.wait_and_get_aggregation(timeout=0.05)
+    # late update for the finished round is rejected...
+    assert agg.add_model(ModelUpdate(params_like(9.0), ["b"])) == []
+    # ...and the next round can start without an explicit clear()
+    agg.set_nodes_to_aggregate(["a", "b"])
+    agg.clear()
+
+
+def test_decode_inconsistent_header_raises():
+    import json as _json
+    import struct as _struct
+
+    p = params_like(1.0)
+    payload = bytearray(encode_params(p))
+    (hlen,) = _struct.unpack("<I", payload[4:8])
+    header = _json.loads(payload[8 : 8 + hlen])
+    header["t"][0]["n"] += 4  # corrupt the byte count
+    new_header = _json.dumps(header).encode()
+    corrupted = payload[:4] + _struct.pack("<I", len(new_header)) + new_header + payload[8 + hlen :]
+    with pytest.raises(DecodingParamsError):
+        decode_params(bytes(corrupted))
+
+
+def test_double_start_raises():
+    agg = FedAvg("n0")
+    agg.set_nodes_to_aggregate(["a"])
+    with pytest.raises(Exception):
+        agg.set_nodes_to_aggregate(["a"])
+    agg.clear()
+    agg.set_nodes_to_aggregate(["a"])  # ok after clear
