@@ -1,0 +1,113 @@
+"""Small control-plane commands: votes, round status, metrics.
+
+Reference files: ``vote_train_set_command.py``, ``models_agregated_command.py``,
+``models_ready_command.py``, ``metrics_command.py``, ``model_initialized_command.py``.
+All mutate :class:`~p2pfl_tpu.node_state.NodeState` under its locks/events.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+from p2pfl_tpu.commands.command import Command
+from p2pfl_tpu.management.logger import logger
+
+if TYPE_CHECKING:
+    from p2pfl_tpu.node_state import NodeState
+
+
+class ModelInitializedCommand(Command):
+    """Peer announced its model is initialized → ``nei_status[source] = -1``."""
+
+    def __init__(self, state: "NodeState") -> None:
+        self._state = state
+
+    @staticmethod
+    def get_name() -> str:
+        return "model_initialized"
+
+    def execute(self, source: str, round: int, *args, **kwargs) -> None:  # noqa: A002
+        self._state.nei_status[source] = -1
+
+
+class VoteTrainSetCommand(Command):
+    """Train-set vote: flat ``[name, weight, name, weight, ...]`` pairs.
+
+    Accepted for the current round or the next one (peers may be one round
+    ahead), mirroring the reference's tolerance.
+    """
+
+    def __init__(self, state: "NodeState") -> None:
+        self._state = state
+
+    @staticmethod
+    def get_name() -> str:
+        return "vote_train_set"
+
+    def execute(self, source: str, round: int, *args, **kwargs) -> None:  # noqa: A002
+        st = self._state
+        if st.round is not None and round not in (st.round, st.round + 1):
+            logger.debug(st.addr, f"Vote from {source} for stale round {round} (at {st.round}) — ignored")
+            return
+        if len(args) % 2 != 0:
+            logger.error(st.addr, f"Malformed vote from {source}: odd arg count")
+            return
+        votes = {args[i]: int(args[i + 1]) for i in range(0, len(args), 2)}
+        with st.train_set_votes_lock:
+            st.train_set_votes[source] = votes
+        st.votes_ready_event.set()
+
+
+class ModelsAggregatedCommand(Command):
+    """Peer reports which contributors it has folded in this round."""
+
+    def __init__(self, state: "NodeState") -> None:
+        self._state = state
+
+    @staticmethod
+    def get_name() -> str:
+        return "models_aggregated"
+
+    def execute(self, source: str, round: int, *args, **kwargs) -> None:  # noqa: A002
+        st = self._state
+        if st.round is not None and round == st.round:
+            st.models_aggregated[source] = list(args)
+
+
+class ModelsReadyCommand(Command):
+    """Peer finished a round: ``nei_status[source] = round`` (round-1 tolerated)."""
+
+    def __init__(self, state: "NodeState") -> None:
+        self._state = state
+
+    @staticmethod
+    def get_name() -> str:
+        return "models_ready"
+
+    def execute(self, source: str, round: int, *args, **kwargs) -> None:  # noqa: A002
+        st = self._state
+        if st.round is not None and round in (st.round - 1, st.round):
+            st.nei_status[source] = round
+        else:
+            logger.debug(st.addr, f"models_ready from {source} for round {round} (at {st.round}) — ignored")
+
+
+class MetricsCommand(Command):
+    """Peer evaluation metrics → global metric store, keyed by the peer."""
+
+    def __init__(self, state: "NodeState") -> None:
+        self._state = state
+
+    @staticmethod
+    def get_name() -> str:
+        return "metrics"
+
+    def execute(self, source: str, round: int, *args, **kwargs) -> None:  # noqa: A002
+        for i in range(0, len(args) - 1, 2):
+            logger.log_metric(
+                source,
+                args[i],
+                float(args[i + 1]),
+                round=round,
+                experiment=self._state.experiment_name,
+            )

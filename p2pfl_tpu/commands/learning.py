@@ -1,0 +1,117 @@
+"""Learning-lifecycle commands: start/stop, init weights, model ingestion.
+
+Reference files: ``start_learning_command.py``, ``stop_learning_command.py``,
+``init_model_command.py``, ``add_model_command.py``. These are the only
+commands that touch the node facade (thread spawn / teardown) or carry
+weight payloads.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+from p2pfl_tpu.commands.command import Command
+from p2pfl_tpu.exceptions import DecodingParamsError, ModelNotMatchingError
+from p2pfl_tpu.learning.weights import ModelUpdate
+from p2pfl_tpu.management.logger import logger
+
+if TYPE_CHECKING:
+    from p2pfl_tpu.node import Node
+
+
+class StartLearningCommand(Command):
+    """Spawn the learning thread with (rounds, epochs) (reference :134-155)."""
+
+    def __init__(self, node: "Node") -> None:
+        self._node = node
+
+    @staticmethod
+    def get_name() -> str:
+        return "start_learning"
+
+    def execute(self, source: str, round: int, *args, **kwargs) -> None:  # noqa: A002
+        rounds = int(args[0]) if args else 1
+        epochs = int(args[1]) if len(args) > 1 else 1
+        self._node._start_learning_thread(rounds, epochs)
+
+
+class StopLearningCommand(Command):
+    """Interrupt the learner, clear aggregator + state, release latches."""
+
+    def __init__(self, node: "Node") -> None:
+        self._node = node
+
+    @staticmethod
+    def get_name() -> str:
+        return "stop_learning"
+
+    def execute(self, source: str, round: int, *args, **kwargs) -> None:  # noqa: A002
+        self._node._stop_learning()
+
+
+class InitModelCommand(Command):
+    """Initial weights payload: store → signal → re-announce.
+
+    The update is stashed on the node (``pending_init_update``) and applied by
+    the stage after its latch fires, which removes the reference's race
+    between learner construction and early weight arrival
+    (``init_model_command.py:30-117``). Malformed payloads stop the node, as
+    in the reference (:106-117).
+    """
+
+    def __init__(self, node: "Node") -> None:
+        self._node = node
+
+    @staticmethod
+    def get_name() -> str:
+        return "init_model"
+
+    def execute(self, source: str, round: int, *args, update: ModelUpdate = None, **kwargs) -> None:  # noqa: A002
+        node = self._node
+        state = node.state
+        if state.model_initialized_event.is_set():
+            logger.debug(state.addr, f"init_model from {source} ignored — already initialized")
+            return
+        try:
+            if update.params is None:
+                update = node.learner.materialize(update)
+        except (DecodingParamsError, ModelNotMatchingError) as exc:
+            logger.error(state.addr, f"init_model decode failed: {exc} — stopping node")
+            node.stop_async()
+            return
+        node.pending_init_update = update
+        state.model_initialized_event.set()
+        node.protocol.broadcast(node.protocol.build_msg(ModelInitializedName))
+
+
+class AddModelCommand(Command):
+    """Model/partial-aggregation ingestion → aggregator (reference :26-104)."""
+
+    def __init__(self, node: "Node") -> None:
+        self._node = node
+
+    @staticmethod
+    def get_name() -> str:
+        return "add_model"
+
+    def execute(self, source: str, round: int, *args, update: ModelUpdate = None, **kwargs) -> None:  # noqa: A002
+        node = self._node
+        state = node.state
+        if not state.model_initialized_event.is_set():
+            logger.debug(state.addr, f"add_model from {source} before init — ignored")
+            return
+        try:
+            if update.params is None:
+                update = node.learner.materialize(update)
+            covered = node.aggregator.add_model(update)
+        except (DecodingParamsError, ModelNotMatchingError) as exc:
+            logger.error(state.addr, f"add_model decode failed: {exc} — stopping node")
+            node.stop_async()
+            return
+        if covered:
+            node.protocol.broadcast(
+                node.protocol.build_msg("models_aggregated", covered, round=state.round or 0)
+            )
+
+
+ModelInitializedName = "model_initialized"

@@ -1,0 +1,66 @@
+"""Per-node mutable learning state.
+
+Reference: ``p2pfl/node_state.py:26-115``. The reference synchronizes with
+four ``threading.Lock`` objects used as latches (created acquired, released
+to signal); here those are real :class:`threading.Event` objects per
+SURVEY §5's recommendation — same semantics, no lock-as-event hazards.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+
+class NodeState:
+    def __init__(self, addr: str, simulation: bool = False) -> None:
+        self.addr = addr
+        self.simulation = simulation
+        self.status = "Idle"
+        self.experiment_name: Optional[str] = None
+        self.round: Optional[int] = None
+        self.total_rounds: Optional[int] = None
+        self.simulation = simulation
+
+        self.learner: Optional[Any] = None
+
+        # addr -> list of contributors that addr has already aggregated
+        self.models_aggregated: Dict[str, List[str]] = {}
+        # addr -> last round that addr reported finishing (-1 = model init'd)
+        self.nei_status: Dict[str, int] = {}
+
+        self.train_set: List[str] = []
+        self.train_set_votes: Dict[str, Dict[str, int]] = {}
+
+        # synchronization (reference: four lock-latches, node_state.py:77-81)
+        self.train_set_votes_lock = threading.Lock()
+        self.start_thread_lock = threading.Lock()
+        self.votes_ready_event = threading.Event()
+        self.model_initialized_event = threading.Event()
+
+    def set_experiment(self, exp_name: str, total_rounds: int) -> None:
+        """Enter learning mode (reference ``node_state.py:83``)."""
+        self.status = "Learning"
+        self.experiment_name = exp_name
+        self.total_rounds = total_rounds
+        self.round = 0
+
+    def increase_round(self) -> None:
+        """Advance the round; clears per-round caches (``node_state.py:97``)."""
+        if self.round is None:
+            raise ValueError("round not initialized")
+        self.round += 1
+        self.models_aggregated = {}
+
+    def clear(self) -> None:
+        """Back to idle (``node_state.py:110``)."""
+        self.status = "Idle"
+        self.experiment_name = None
+        self.round = None
+        self.total_rounds = None
+        self.models_aggregated = {}
+        self.nei_status = {}
+        self.train_set = []
+        self.train_set_votes = {}
+        self.votes_ready_event.clear()
+        self.model_initialized_event.clear()

@@ -1,0 +1,298 @@
+"""The six stages of a federated round.
+
+Reference: ``p2pfl/stages/base_node/*.py`` (SURVEY §2.2, call stack §3.3).
+Semantics replicated 1:1 including the documented quirks (voting happens only
+in round 0; the elected train set is reused for all rounds —
+``round_finished_stage.py:69-70``). Device work (fit / evaluate / aggregate)
+happens inside the learner & aggregator as jitted pure functions; every
+``wait`` here is a host-side event.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+import time
+from typing import TYPE_CHECKING, Optional, Type
+
+from p2pfl_tpu.management.logger import logger
+from p2pfl_tpu.settings import Settings
+from p2pfl_tpu.stages.stage import Stage
+
+if TYPE_CHECKING:
+    from p2pfl_tpu.node import Node
+
+
+class StartLearningStage(Stage):
+    """Set up the experiment, synchronize initial weights across the overlay."""
+
+    name = "StartLearningStage"
+
+    @staticmethod
+    def execute(node: "Node") -> Optional[Type[Stage]]:
+        state = node.state
+        state.set_experiment(node.experiment_name, node.total_rounds)
+        logger.experiment_started(node.addr)
+        node.learner.set_epochs(node.epochs)
+        node.learner.set_addr(node.addr)
+
+        # wait for initial weights: the initiator's event was set by
+        # set_start_learning(); everyone else blocks until init_model arrives
+        # (reference blocks on model_initialized_lock, start_learning_stage.py:78)
+        if not state.model_initialized_event.wait(timeout=Settings.AGGREGATION_TIMEOUT):
+            raise TimeoutError("initial model never arrived")
+        if node.pending_init_update is not None:
+            node.learner.set_parameters(node.pending_init_update.params)
+            node.pending_init_update = None
+
+        # push init weights to peers that haven't announced initialization
+        # (reference start_learning_stage.py:80,94-136)
+        def candidates() -> list[str]:
+            neis = node.protocol.get_neighbors(only_direct=True)
+            return [n for n in neis if state.nei_status.get(n, 0) != -1]
+
+        def model_fn(nei: str):
+            update = node.learner.get_model_update()
+            return node.protocol.build_weights("init_model", 0, update)
+
+        node.protocol.gossip_weights(
+            early_stopping_fn=node.learning_interrupted,
+            get_candidates_fn=candidates,
+            status_fn=lambda: sorted(candidates()),
+            model_fn=model_fn,
+        )
+        if node.learning_interrupted():
+            return None
+
+        # let heartbeats flood so the full membership is known before voting
+        time.sleep(Settings.WAIT_HEARTBEATS_CONVERGENCE)
+        return VoteTrainSetStage
+
+
+class VoteTrainSetStage(Stage):
+    """Elect the train set by weighted random voting (§2.2 VoteTrainSetStage)."""
+
+    name = "VoteTrainSetStage"
+
+    @staticmethod
+    def execute(node: "Node") -> Optional[Type[Stage]]:
+        state = node.state
+        candidates = list(node.protocol.get_neighbors(only_direct=False)) + [node.addr]
+
+        # cast: up to TRAIN_SET_SIZE random picks, weight ~ floor(U(0,1000)/(i+1))
+        # (reference vote_train_set_stage.py:78-81 — random weights by design)
+        samples = min(Settings.TRAIN_SET_SIZE, len(candidates))
+        picks = random.sample(candidates, samples)
+        my_votes = {n: math.floor(random.randint(0, 1000) / (i + 1)) for i, n in enumerate(picks)}
+        with state.train_set_votes_lock:
+            state.train_set_votes[node.addr] = dict(my_votes)
+        flat: list[str] = []
+        for n, w in my_votes.items():
+            flat += [n, str(w)]
+        node.protocol.broadcast(
+            node.protocol.build_msg("vote_train_set", flat, round=state.round or 0)
+        )
+
+        # collect until every candidate voted or VOTE_TIMEOUT
+        # (reference poll loop :107-165)
+        deadline = time.monotonic() + Settings.VOTE_TIMEOUT
+        while not node.learning_interrupted():
+            with state.train_set_votes_lock:
+                voted = set(state.train_set_votes)
+            if set(candidates) <= voted:
+                break
+            if time.monotonic() >= deadline:
+                logger.info(
+                    node.addr,
+                    f"Vote timeout — proceeding with {len(voted)}/{len(candidates)} votes",
+                )
+                break
+            state.votes_ready_event.wait(timeout=2)
+            state.votes_ready_event.clear()
+        if node.learning_interrupted():
+            return None
+
+        # tally with deterministic tie-break (votes desc, then name desc —
+        # reference :152-155) so every node elects the same set
+        with state.train_set_votes_lock:
+            all_votes = {v: dict(w) for v, w in state.train_set_votes.items()}
+        results: dict[str, int] = {}
+        for votes in all_votes.values():
+            for n, w in votes.items():
+                results[n] = results.get(n, 0) + int(w)
+        ranked = sorted(results.items(), key=lambda kv: (kv[1], kv[0]), reverse=True)
+        train_set = [n for n, _ in ranked[: Settings.TRAIN_SET_SIZE]]
+
+        # drop elected nodes that died since (reference :167-178)
+        live = set(node.protocol.get_neighbors(only_direct=False)) | {node.addr}
+        state.train_set = [n for n in train_set if n in live]
+        logger.info(node.addr, f"Train set: {state.train_set}")
+
+        return TrainStage if node.addr in state.train_set else WaitAggregatedModelsStage
+
+
+class TrainStage(Stage):
+    """Local training + partial-aggregation gossip within the train set."""
+
+    name = "TrainStage"
+
+    @staticmethod
+    def execute(node: "Node") -> Optional[Type[Stage]]:
+        state = node.state
+        node.aggregator.set_nodes_to_aggregate(state.train_set)
+
+        # evaluate current model, share metrics (reference train_stage.py:59-60,95-112)
+        TrainStage._evaluate(node)
+        if node.learning_interrupted():
+            return None
+
+        # local training — the hot loop; one jitted train step per batch
+        node.learner.fit()
+        if node.learning_interrupted():
+            return None
+
+        # contribute own model
+        own = node.learner.get_model_update()
+        covered = node.aggregator.add_model(own)
+        node.protocol.broadcast(
+            node.protocol.build_msg("models_aggregated", covered, round=state.round or 0)
+        )
+
+        TrainStage._gossip_partial_aggregations(node)
+        if node.learning_interrupted():
+            return None
+        return GossipModelStage
+
+    @staticmethod
+    def _evaluate(node: "Node") -> None:
+        metrics = node.learner.evaluate()
+        if metrics:
+            flat: list[str] = []
+            for k, v in metrics.items():
+                flat += [k, str(float(v))]
+            node.protocol.broadcast(
+                node.protocol.build_msg("metrics", flat, round=node.state.round or 0)
+            )
+
+    @staticmethod
+    def _gossip_partial_aggregations(node: "Node") -> None:
+        """Push partials to train-set peers until everyone has full coverage.
+
+        Reference ``train_stage.py:83,114-177``: candidates are train-set
+        peers whose announced coverage is incomplete; each gets exactly the
+        contributions it misses; ad-hoc connections are allowed because
+        train-set members may not be direct neighbors.
+        """
+        state = node.state
+        train = set(state.train_set)
+
+        def early_stop() -> bool:
+            return node.learning_interrupted()
+
+        def candidates() -> list[str]:
+            out = []
+            for n in train - {node.addr}:
+                if set(state.models_aggregated.get(n, [])) != train:
+                    out.append(n)
+            return out
+
+        def status():
+            return {n: tuple(sorted(state.models_aggregated.get(n, []))) for n in sorted(train)}
+
+        def model_fn(nei: str):
+            peer_has = state.models_aggregated.get(nei, [])
+            partial = node.aggregator.get_partial_aggregation(peer_has)
+            if partial is None:
+                return None
+            return node.protocol.build_weights("add_model", state.round or 0, partial)
+
+        node.protocol.gossip_weights(
+            early_stopping_fn=early_stop,
+            get_candidates_fn=candidates,
+            status_fn=status,
+            model_fn=model_fn,
+            create_connection=True,
+        )
+
+
+class WaitAggregatedModelsStage(Stage):
+    """Non-train-set path: wait for the aggregated model to be pushed to us."""
+
+    name = "WaitAggregatedModelsStage"
+
+    @staticmethod
+    def execute(node: "Node") -> Optional[Type[Stage]]:
+        node.aggregator.set_waiting_aggregated_model(node.state.train_set)
+        return GossipModelStage
+
+
+class GossipModelStage(Stage):
+    """Close the round's aggregation and diffuse the result outward."""
+
+    name = "GossipModelStage"
+
+    @staticmethod
+    def execute(node: "Node") -> Optional[Type[Stage]]:
+        state = node.state
+        agg = node.aggregator.wait_and_get_aggregation()
+        node.learner.set_parameters(agg.params)
+        if node.learning_interrupted():
+            return None
+        node.protocol.broadcast(
+            node.protocol.build_msg("models_ready", [], round=state.round or 0)
+        )
+
+        # diffusion: push the aggregated model to direct neighbors that are
+        # behind on this round (reference gossip_model_stage.py:100-124)
+        def candidates() -> list[str]:
+            neis = node.protocol.get_neighbors(only_direct=True)
+            return [n for n in neis if state.nei_status.get(n, -1) < (state.round or 0)]
+
+        def model_fn(nei: str):
+            update = node.learner.get_model_update()
+            update.contributors = list(state.train_set)
+            return node.protocol.build_weights("add_model", state.round or 0, update)
+
+        node.protocol.gossip_weights(
+            early_stopping_fn=node.learning_interrupted,
+            get_candidates_fn=candidates,
+            status_fn=lambda: sorted(candidates()),
+            model_fn=model_fn,
+        )
+        if node.learning_interrupted():
+            return None
+        return RoundFinishedStage
+
+
+class RoundFinishedStage(Stage):
+    """Advance or finish.
+
+    NOTE: next round skips voting — the round-0 train set is reused for all
+    rounds, replicating the reference (``round_finished_stage.py:69-70``).
+    Documented divergence: the reference sends *every* node (train-set or
+    not) to TrainStage on rounds ≥ 1, so non-elected nodes burn a full local
+    fit whose contribution the aggregator then rejects as foreign; here
+    non-elected nodes return to WaitAggregatedModelsStage, preserving the
+    round-0 split and round outcomes while skipping the dead work.
+    """
+
+    name = "RoundFinishedStage"
+
+    @staticmethod
+    def execute(node: "Node") -> Optional[Type[Stage]]:
+        state = node.state
+        if node.learning_interrupted():
+            logger.info(node.addr, "Early stopping.")
+            return None
+        node.aggregator.clear()
+        state.increase_round()
+        logger.round_finished(node.addr)
+        if state.round is not None and state.total_rounds is not None and state.round < state.total_rounds:
+            return TrainStage if node.addr in state.train_set else WaitAggregatedModelsStage
+        # experiment over: final evaluation, clear state
+        metrics = node.learner.evaluate()
+        for k, v in (metrics or {}).items():
+            logger.log_metric(node.addr, k, float(v), round=state.round, experiment=state.experiment_name)
+        logger.experiment_finished(node.addr)
+        state.clear()
+        return None
