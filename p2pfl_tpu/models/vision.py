@@ -1,0 +1,154 @@
+"""Vision models: MLP, CNN (reference parity) and ResNet-18/50 (BASELINE).
+
+Compute runs in bfloat16 (MXU-friendly), parameters and logits stay float32
+— the standard TPU mixed-precision recipe. Reference shapes:
+MLP 784-256-128-10 (``mlp.py:53-56``), 2-conv CNN (``cnn.py:55-71``).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from p2pfl_tpu.models.base import FlaxModel
+
+
+class MLP(nn.Module):
+    """784-256-128-10 MLP, the reference's default MNIST model."""
+
+    hidden: Sequence[int] = (256, 128)
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        for h in self.hidden:
+            x = nn.Dense(h, dtype=self.dtype)(x)
+            x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+class CNN(nn.Module):
+    """Two-conv CNN over 28x28x1, matching the reference CNN's capability."""
+
+    channels: Sequence[int] = (32, 64)
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        for ch in self.channels:
+            x = nn.Conv(ch, (3, 3), padding="SAME", dtype=self.dtype)(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+class ResBlock(nn.Module):
+    filters: int
+    strides: tuple[int, int] = (1, 1)
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(self.filters, (3, 3), self.strides, padding="SAME", use_bias=False, dtype=self.dtype)(x)
+        y = nn.GroupNorm(num_groups=8, dtype=self.dtype)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), padding="SAME", use_bias=False, dtype=self.dtype)(y)
+        y = nn.GroupNorm(num_groups=8, dtype=self.dtype)(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(
+                self.filters, (1, 1), self.strides, use_bias=False, dtype=self.dtype
+            )(residual)
+            residual = nn.GroupNorm(num_groups=8, dtype=self.dtype)(residual)
+        return nn.relu(y + residual)
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: tuple[int, int] = (1, 1)
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype)(x)
+        y = nn.GroupNorm(num_groups=8, dtype=self.dtype)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), self.strides, padding="SAME", use_bias=False, dtype=self.dtype)(y)
+        y = nn.GroupNorm(num_groups=8, dtype=self.dtype)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters * 4, (1, 1), use_bias=False, dtype=self.dtype)(y)
+        y = nn.GroupNorm(num_groups=8, dtype=self.dtype)(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(
+                self.filters * 4, (1, 1), self.strides, use_bias=False, dtype=self.dtype
+            )(residual)
+            residual = nn.GroupNorm(num_groups=8, dtype=self.dtype)(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """ResNet for CIFAR-scale inputs.
+
+    GroupNorm instead of BatchNorm: federated averaging of BatchNorm running
+    statistics is ill-defined across non-IID shards (a known FL failure
+    mode); GroupNorm keeps every parameter a plain weight that FedAvg can
+    average soundly — and avoids mutable state in the train step.
+    """
+
+    stage_sizes: Sequence[int] = (2, 2, 2, 2)
+    bottleneck: bool = False
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        x = nn.Conv(64, (3, 3), padding="SAME", use_bias=False, dtype=self.dtype)(x)
+        x = nn.GroupNorm(num_groups=8, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        block = BottleneckBlock if self.bottleneck else ResBlock
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = block(64 * 2**i, strides, dtype=self.dtype)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+# ---- constructors (bound to concrete params) ----
+
+
+def mlp(seed: int = 0, num_classes: int = 10, input_shape=(28, 28, 1)) -> FlaxModel:
+    return FlaxModel.create(MLP(num_classes=num_classes), input_shape, seed, num_classes)
+
+
+def cnn(seed: int = 0, num_classes: int = 10, input_shape=(28, 28, 1)) -> FlaxModel:
+    return FlaxModel.create(CNN(num_classes=num_classes), input_shape, seed, num_classes)
+
+
+def resnet18(seed: int = 0, num_classes: int = 10, input_shape=(32, 32, 3)) -> FlaxModel:
+    return FlaxModel.create(
+        ResNet(stage_sizes=(2, 2, 2, 2), num_classes=num_classes), input_shape, seed, num_classes
+    )
+
+
+def resnet50(seed: int = 0, num_classes: int = 100, input_shape=(32, 32, 3)) -> FlaxModel:
+    return FlaxModel.create(
+        ResNet(stage_sizes=(3, 4, 6, 3), bottleneck=True, num_classes=num_classes),
+        input_shape,
+        seed,
+        num_classes,
+    )

@@ -32,6 +32,10 @@ class NodeState:
         self.train_set: List[str] = []
         self.train_set_votes: Dict[str, Dict[str, int]] = {}
 
+        # monotonically counts experiments entered; lets harnesses distinguish
+        # "never started" from "finished" (both have round None)
+        self.experiment_epoch = 0
+
         # synchronization (reference: four lock-latches, node_state.py:77-81)
         self.train_set_votes_lock = threading.Lock()
         self.start_thread_lock = threading.Lock()
@@ -44,6 +48,7 @@ class NodeState:
         self.experiment_name = exp_name
         self.total_rounds = total_rounds
         self.round = 0
+        self.experiment_epoch += 1
 
     def increase_round(self) -> None:
         """Advance the round; clears per-round caches (``node_state.py:97``)."""

@@ -42,7 +42,12 @@ class StartLearningStage(Stage):
         if not state.model_initialized_event.wait(timeout=Settings.AGGREGATION_TIMEOUT):
             raise TimeoutError("initial model never arrived")
         if node.pending_init_update is not None:
-            node.learner.set_parameters(node.pending_init_update.params)
+            try:
+                node.learner.set_parameters(node.pending_init_update.params)
+            except Exception as exc:  # noqa: BLE001 — mismatched init stops the node (reference :106-117)
+                logger.error(node.addr, f"Initial model does not match architecture: {exc} — stopping")
+                node.stop_async()
+                return None
             node.pending_init_update = None
 
         # push init weights to peers that haven't announced initialization

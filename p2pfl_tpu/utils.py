@@ -43,16 +43,23 @@ def connect_line(nodes: list[Node]) -> None:
         a.connect(b.addr)
 
 
-def wait_to_finish(nodes: Iterable[Node], timeout: float = 120.0) -> None:
-    """Poll until every node's round is ``None`` (reference ``wait_4_results``)."""
+def wait_to_finish(nodes: Iterable[Node], timeout: float = 120.0, min_experiments: int = 1) -> None:
+    """Poll until every node has run ``min_experiments`` and is idle again.
+
+    Reference ``wait_4_results`` polls ``round is None`` only — which is
+    also true *before* learning threads start, a race this version closes
+    via ``NodeState.experiment_epoch``.
+    """
     deadline = time.monotonic() + timeout
     nodes = list(nodes)
     while time.monotonic() < deadline:
-        if all(n.state.round is None for n in nodes):
+        if all(
+            n.state.experiment_epoch >= min_experiments and n.state.round is None for n in nodes
+        ):
             return
         time.sleep(0.1)
-    rounds = {n.addr: n.state.round for n in nodes}
-    raise AssertionError(f"Nodes did not finish in {timeout}s: {rounds}")
+    status = {n.addr: (n.state.experiment_epoch, n.state.round) for n in nodes}
+    raise AssertionError(f"Nodes did not finish in {timeout}s: (epoch, round)={status}")
 
 
 # reference-parity alias

@@ -1,15 +1,24 @@
-"""Test config: force an 8-device virtual CPU mesh BEFORE jax initializes.
+"""Test config: force an 8-device virtual CPU mesh BEFORE the backend initializes.
 
 This replaces the reference's "multi-node without a cluster" approach
 (real gRPC on loopback) with a virtual device mesh, per SURVEY.md §4.
+
+NOTE: this environment pre-imports jax via a sitecustomize hook with
+``JAX_PLATFORMS=axon`` (one real TPU chip behind a tunnel), so setting the
+env var here is too late — ``jax.config.update`` still works as long as no
+backend has been initialized yet. XLA_FLAGS is read at backend init, so
+setting it here is still in time.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
@@ -19,4 +28,7 @@ from p2pfl_tpu.settings import set_test_settings  # noqa: E402
 @pytest.fixture(autouse=True)
 def _fast_settings():
     set_test_settings()
+    from p2pfl_tpu.management.logger import logger
+
+    logger.set_level("DEBUG")
     yield

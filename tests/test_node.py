@@ -1,0 +1,133 @@
+"""End-to-end learning tests (reference ``test/node_test.py`` scenarios):
+
+convergence to equal models, interrupt mid-learning, node death mid-learning,
+architecture mismatch must not hang the network — all with real Node objects
+over the in-memory transport in one process (SURVEY §4).
+"""
+
+import time
+
+import pytest
+
+from p2pfl_tpu.communication.memory import MemoryRegistry
+from p2pfl_tpu.learning.dataset import FederatedDataset
+from p2pfl_tpu.learning.learner import DummyLearner, JaxLearner
+from p2pfl_tpu.models import cnn, mlp
+from p2pfl_tpu.node import Node
+from p2pfl_tpu.settings import Settings
+from p2pfl_tpu.utils import (
+    check_equal_models,
+    connect_line,
+    full_connection,
+    wait_convergence,
+    wait_to_finish,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    MemoryRegistry.reset()
+    yield
+    MemoryRegistry.reset()
+
+
+def _data(i, n, n_train=512, n_test=128):
+    full = FederatedDataset.synthetic_mnist(n_train=n_train, n_test=n_test)
+    return full.partition(i, n)
+
+
+def _mk_ml_nodes(n, model_fn=mlp, epochs_data=None):
+    nodes = []
+    for i in range(n):
+        model = model_fn(seed=i)
+        learner = JaxLearner(model, _data(i, n), batch_size=64)
+        nodes.append(Node(learner=learner))
+    for node in nodes:
+        node.start()
+    return nodes
+
+
+def _stop_all(nodes):
+    for n in nodes:
+        n.stop()
+
+
+@pytest.mark.parametrize("rounds", [1, 2])
+def test_convergence_two_nodes(rounds):
+    """Reference ``test_node_test.py:74-100`` — its CI anchor scenario."""
+    nodes = _mk_ml_nodes(2)
+    nodes[0].connect(nodes[1].addr)
+    wait_convergence(nodes, 1, only_direct=True)
+    nodes[0].set_start_learning(rounds=rounds, epochs=0)
+    wait_to_finish(nodes, timeout=60)
+    check_equal_models(nodes)
+    _stop_all(nodes)
+
+
+def test_convergence_four_nodes_line_with_training():
+    """4 nodes on a line topology, one epoch of real training each round."""
+    nodes = _mk_ml_nodes(4)
+    connect_line(nodes)
+    wait_convergence(nodes, 3, only_direct=False)
+    nodes[0].set_start_learning(rounds=2, epochs=1)
+    wait_to_finish(nodes, timeout=120)
+    check_equal_models(nodes)
+    _stop_all(nodes)
+
+
+def test_dummy_learner_federation():
+    """FSM correctness without ML: dummy learners converge to one value."""
+    nodes = [Node(learner=DummyLearner(value=float(i))) for i in range(3)]
+    for n in nodes:
+        n.start()
+    for n in nodes:
+        full_connection(n, nodes)
+    wait_convergence(nodes, 2, only_direct=True)
+    nodes[0].set_start_learning(rounds=1, epochs=1)
+    wait_to_finish(nodes, timeout=30)
+    check_equal_models(nodes, atol=1e-6)
+    _stop_all(nodes)
+
+
+def test_interrupt_learning():
+    nodes = _mk_ml_nodes(2)
+    nodes[0].connect(nodes[1].addr)
+    wait_convergence(nodes, 1, only_direct=True)
+    nodes[0].set_start_learning(rounds=10, epochs=1)
+    time.sleep(0.5)
+    nodes[0].set_stop_learning()
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if all(n.state.round is None for n in nodes):
+            break
+        time.sleep(0.1)
+    assert all(n.state.round is None for n in nodes)
+    _stop_all(nodes)
+
+
+def test_node_down_on_learning():
+    """Kill a node mid-learning; the rest must still finish (reference :126-152)."""
+    nodes = _mk_ml_nodes(4)
+    for n in nodes:
+        full_connection(n, nodes)
+    wait_convergence(nodes, 3, only_direct=True)
+    nodes[0].set_start_learning(rounds=2, epochs=1)
+    time.sleep(1)
+    nodes[-1].stop()
+    wait_to_finish(nodes[:-1], timeout=120)
+    _stop_all(nodes[:-1])
+
+
+def test_wrong_model_does_not_hang():
+    """MLP vs CNN (reference :155-176): mismatched node stops, net finishes."""
+    Settings.VOTE_TIMEOUT = 3.0
+    Settings.AGGREGATION_TIMEOUT = 3.0
+    n1 = Node(learner=JaxLearner(mlp(seed=0), _data(0, 2), batch_size=64))
+    n2 = Node(learner=JaxLearner(cnn(seed=1), _data(1, 2), batch_size=64))
+    n1.start()
+    n2.start()
+    n1.connect(n2.addr)
+    wait_convergence([n1, n2], 1, only_direct=True)
+    n1.set_start_learning(rounds=1, epochs=0)
+    wait_to_finish([n1], timeout=60)
+    _stop_all([n1, n2])
