@@ -1,0 +1,16 @@
+"""TPU-native parallel runtime.
+
+This is where the rebuild departs most from the reference: instead of N
+OS processes exchanging pickled weights over gRPC
+(``p2pfl/communication/grpc/``), an entire federation runs as **one SPMD
+program** over a ``jax.sharding.Mesh`` — one logical node per mesh slot,
+local training as per-slot batched compute, FedAvg as a masked weighted
+reduction that XLA lowers to an all-reduce over ICI. Control decisions
+(election, round count) stay on host; nothing crosses the host↔device
+boundary inside a round.
+"""
+
+from p2pfl_tpu.parallel.mesh import federation_mesh
+from p2pfl_tpu.parallel.spmd import SpmdFederation
+
+__all__ = ["SpmdFederation", "federation_mesh"]
