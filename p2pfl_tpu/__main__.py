@@ -1,0 +1,7 @@
+"""``python -m p2pfl_tpu`` entry point (reference ``p2pfl/__main__.py``)."""
+
+import sys
+
+from p2pfl_tpu.cli import main
+
+sys.exit(main())

@@ -330,6 +330,18 @@ class SpmdFederation:
             "per_node_acc": np.asarray(acc).tolist(),
         }
 
+    # ---- checkpoint / resume (absent in the reference; SURVEY §5) ----
+
+    def save(self, directory: str) -> None:
+        from p2pfl_tpu.learning.checkpoint import save_federation
+
+        save_federation(directory, self)
+
+    def restore(self, directory: str, step: Optional[int] = None) -> None:
+        from p2pfl_tpu.learning.checkpoint import restore_federation
+
+        restore_federation(directory, self, step)
+
     # ---- interop ----
 
     def node_params(self, i: int) -> Pytree:

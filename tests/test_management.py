@@ -1,0 +1,118 @@
+"""Management layer tests: CLI discovery, monitor, checkpointing, web client."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+
+def test_cli_experiment_list(capsys):
+    from p2pfl_tpu.cli import main
+
+    assert main(["experiment", "list"]) == 0
+    out = capsys.readouterr().out
+    assert "mnist" in out and "spmd_mnist" in out
+
+
+def test_cli_unknown_experiment():
+    from p2pfl_tpu.cli import main
+
+    assert main(["experiment", "run", "nope"]) == 1
+
+
+def test_node_monitor_reports():
+    from p2pfl_tpu.management.node_monitor import NodeMonitor
+    from p2pfl_tpu.settings import Settings
+
+    Settings.RESOURCE_MONITOR_PERIOD = 0.05
+    seen = []
+    mon = NodeMonitor("test-node", report_fn=lambda n, m, v: seen.append((m, v)))
+    mon.start()
+    time.sleep(0.4)
+    mon.stop()
+    metrics = {m for m, _ in seen}
+    assert "cpu_percent" in metrics and "ram_percent" in metrics
+
+
+def test_web_services_swallow_failures():
+    """A dead dashboard must never raise into the caller."""
+    from p2pfl_tpu.management.web_services import WebServices
+
+    ws = WebServices("http://127.0.0.1:1", "key", timeout=0.2)
+    ws.register_node("n1")  # nothing listening — must not raise
+    ws.send_global_metric("e", 0, "acc", "n1", 0.5)
+
+
+def test_web_services_posts(tmp_path):
+    """Round-trip against a local HTTP server: headers + payloads correct."""
+    import http.server
+    import json
+
+    received = []
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            body = self.rfile.read(int(self.headers["Content-Length"]))
+            received.append((self.path, self.headers.get("x-api-key"), json.loads(body)))
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            self.wfile.write(b'{"node_key": "k1"}')
+
+        def log_message(self, *a):  # silence
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        from p2pfl_tpu.management.web_services import WebServices
+
+        ws = WebServices(f"http://127.0.0.1:{srv.server_port}", "secret")
+        ws.register_node("n1", is_simulated=True)
+        ws.send_local_metric("exp", 1, "loss", "n1", 5, 0.25)
+        assert received[0][0] == "/node" and received[0][1] == "secret"
+        assert received[1][2]["metric"] == "loss" and received[1][2]["step"] == 5
+        assert ws._node_key == "k1"
+    finally:
+        srv.shutdown()
+
+
+def test_learner_checkpoint_roundtrip(tmp_path):
+    from p2pfl_tpu.learning.checkpoint import restore_learner, save_learner
+    from p2pfl_tpu.learning.dataset import FederatedDataset
+    from p2pfl_tpu.learning.learner import JaxLearner
+    from p2pfl_tpu.models import mlp
+
+    data = FederatedDataset.synthetic_mnist(n_train=256, n_test=64)
+    learner = JaxLearner(mlp(), data, batch_size=64)
+    learner.fit()
+    import jax
+
+    want = jax.tree.leaves(learner.params)
+
+    other = JaxLearner(mlp(seed=9), data, batch_size=64)
+    save_learner(str(tmp_path / "ckpt"), learner, round=3)
+    restore_learner(str(tmp_path / "ckpt"), other)
+    got = jax.tree.leaves(other.params)
+    for a, b in zip(want, got):
+        np.testing.assert_array_equal(np.asarray(a, np.float32), np.asarray(b, np.float32))
+
+
+def test_federation_checkpoint_roundtrip(tmp_path):
+    import jax
+
+    from p2pfl_tpu.learning.dataset import FederatedDataset
+    from p2pfl_tpu.models import mlp
+    from p2pfl_tpu.parallel import SpmdFederation
+
+    data = FederatedDataset.synthetic_mnist(n_train=1024, n_test=128)
+    fed = SpmdFederation.from_dataset(mlp(), data, n_nodes=4, batch_size=64, vote=False)
+    fed.run_round()
+    fed.save(str(tmp_path / "fed"))
+
+    fed2 = SpmdFederation.from_dataset(mlp(seed=5), data, n_nodes=4, batch_size=64, vote=False)
+    fed2.restore(str(tmp_path / "fed"))
+    assert fed2.round == 1
+    for a, b in zip(jax.tree.leaves(fed.params), jax.tree.leaves(fed2.params)):
+        np.testing.assert_array_equal(np.asarray(a, np.float32), np.asarray(b, np.float32))
