@@ -70,6 +70,40 @@ class FederatedDataset:
         return cls(x_tr, y_tr, x_te, y_te, num_classes)
 
     @classmethod
+    def synthetic_lm(
+        cls,
+        vocab_size: int = 2048,
+        seq_len: int = 128,
+        n_train: int = 2048,
+        n_test: int = 256,
+        determinism: float = 0.9,
+        seed: int = 17,
+    ) -> "FederatedDataset":
+        """Next-token prediction over a near-deterministic Markov chain.
+
+        Each token maps to a fixed successor with probability ``determinism``
+        (uniform otherwise), so a causal LM can approach ``determinism``
+        next-token accuracy — a learnable, download-free LM task. x = tokens,
+        y = tokens shifted left (teacher forcing).
+        """
+        rng = np.random.default_rng(seed)
+        succ = rng.permutation(vocab_size)  # deterministic successor table
+
+        def make(n: int, split_seed: int):
+            r = np.random.default_rng(seed + split_seed)
+            toks = np.empty((n, seq_len + 1), dtype=np.int32)
+            toks[:, 0] = r.integers(0, vocab_size, size=n)
+            for t in range(seq_len):
+                follow = r.random(n) < determinism
+                rand = r.integers(0, vocab_size, size=n)
+                toks[:, t + 1] = np.where(follow, succ[toks[:, t]], rand)
+            return toks[:, :-1], toks[:, 1:].astype(np.int32)
+
+        x_tr, y_tr = make(n_train, 1)
+        x_te, y_te = make(n_test, 2)
+        return cls(x_tr, y_tr, x_te, y_te, vocab_size)
+
+    @classmethod
     def mnist(cls, data_dir: Optional[str] = None, **kwargs) -> "FederatedDataset":
         """Real MNIST if IDX files are present in ``data_dir``, else synthetic."""
         if data_dir and os.path.isdir(data_dir):
@@ -134,7 +168,7 @@ class FederatedDataset:
         take = min(nb * batch_size, n)
         perm = rng.permutation(n)[:take]
         xs = self.x_train[perm].reshape(nb, -1, *self.x_train.shape[1:])
-        ys = self.y_train[perm].reshape(nb, -1)
+        ys = self.y_train[perm].reshape(nb, -1, *self.y_train.shape[1:])
         return xs, ys
 
     def test_arrays(self) -> tuple[np.ndarray, np.ndarray]:

@@ -1,0 +1,178 @@
+"""Decoder-only transformer (TinyLlama-style) with LoRA adapters.
+
+BASELINE config 5: federated LoRA fine-tuning — nodes train and exchange
+ONLY the low-rank adapters, so a round's gossip payload drops from the full
+model to a few MB. Architecture follows the Llama recipe (RMSNorm → GQA
+attention with RoPE → SwiGLU), all matmuls in bfloat16 on the MXU, norms and
+softmax statistics in float32.
+
+Long context: set ``attn_impl="ring"`` and provide a mesh — attention runs
+as ring attention over the ``model`` mesh axis (``ops/attention.py``),
+sequence sharded across chips.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from p2pfl_tpu.models.base import FlaxModel
+from p2pfl_tpu.ops.attention import causal_attention
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 2048
+    dim: int = 256
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 4
+    ffn_hidden: int = 688  # ~8/3 * dim rounded
+    rope_theta: float = 10000.0
+    lora_rank: int = 8
+    lora_alpha: float = 16.0
+    lora_mlp: bool = False
+    dtype: Any = jnp.bfloat16
+
+
+class RMSNorm(nn.Module):
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        xf = x.astype(jnp.float32)
+        norm = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + 1e-6)
+        return (norm * scale).astype(self.dtype)
+
+
+class LoRADense(nn.Module):
+    """Dense with optional low-rank adapter: ``y = xW + (alpha/r)·xAB``.
+
+    ``A`` is normal-initialized, ``B`` zeros — adapters start as identity.
+    Param names carry the ``lora_`` prefix the federated layer filters on.
+    """
+
+    features: int
+    rank: int = 0
+    alpha: float = 16.0
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(), (x.shape[-1], self.features)
+        )
+        y = jnp.dot(x.astype(self.dtype), kernel.astype(self.dtype))
+        if self.rank > 0:
+            a = self.param(
+                "lora_a", nn.initializers.normal(0.02), (x.shape[-1], self.rank)
+            )
+            b = self.param("lora_b", nn.initializers.zeros, (self.rank, self.features))
+            y = y + jnp.dot(
+                jnp.dot(x.astype(self.dtype), a.astype(self.dtype)), b.astype(self.dtype)
+            ) * (self.alpha / self.rank)
+        return y
+
+
+def rope(x: jax.Array, theta: float) -> jax.Array:
+    """Rotary position embedding over [B, T, H, D] (D even)."""
+    b, t, h, d = x.shape
+    half = d // 2
+    freqs = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    ang = jnp.arange(t, dtype=jnp.float32)[:, None] * freqs[None, :]  # [T, half]
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    cos, sin = cos[None, :, None, :], sin[None, :, None, :]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1).astype(x.dtype)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+    attn_fn: Optional[Callable] = None  # (q, k, v) -> out; default fused causal
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        head_dim = cfg.dim // cfg.n_heads
+        dense = partial(LoRADense, rank=cfg.lora_rank, alpha=cfg.lora_alpha, dtype=cfg.dtype)
+        q = dense(cfg.n_heads * head_dim, name="wq")(x)
+        k = dense(cfg.n_kv_heads * head_dim, name="wk")(x)
+        v = dense(cfg.n_kv_heads * head_dim, name="wv")(x)
+        b, t = x.shape[:2]
+        q = rope(q.reshape(b, t, cfg.n_heads, head_dim), cfg.rope_theta)
+        k = rope(k.reshape(b, t, cfg.n_kv_heads, head_dim), cfg.rope_theta)
+        v = v.reshape(b, t, cfg.n_kv_heads, head_dim)
+        # GQA: repeat K/V heads to match Q heads
+        rep = cfg.n_heads // cfg.n_kv_heads
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+        attend = self.attn_fn or causal_attention
+        out = attend(q, k, v).reshape(b, t, cfg.dim)
+        return dense(cfg.dim, name="wo")(out)
+
+
+class MLP(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        rank = cfg.lora_rank if cfg.lora_mlp else 0
+        dense = partial(LoRADense, rank=rank, alpha=cfg.lora_alpha, dtype=cfg.dtype)
+        gate = dense(cfg.ffn_hidden, name="w1")(x)
+        up = dense(cfg.ffn_hidden, name="w3")(x)
+        return dense(cfg.dim, name="w2")(nn.silu(gate) * up)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+    attn_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x):
+        x = x + Attention(self.cfg, self.attn_fn, name="attn")(
+            RMSNorm(self.cfg.dtype, name="attn_norm")(x)
+        )
+        x = x + MLP(self.cfg, name="mlp")(RMSNorm(self.cfg.dtype, name="mlp_norm")(x))
+        return x
+
+
+class CausalLM(nn.Module):
+    cfg: TransformerConfig
+    attn_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, tokens):  # [B, T] int32 -> [B, T, vocab] f32 logits
+        cfg = self.cfg
+        emb = self.param(
+            "embed", nn.initializers.normal(0.02), (cfg.vocab_size, cfg.dim)
+        )
+        x = emb[tokens].astype(cfg.dtype)
+        for i in range(cfg.n_layers):
+            x = Block(cfg, self.attn_fn, name=f"layer_{i}")(x)
+        x = RMSNorm(cfg.dtype, name="final_norm")(x)
+        logits = jnp.dot(x, emb.T.astype(cfg.dtype))  # tied embeddings
+        return logits.astype(jnp.float32)
+
+
+def tiny_transformer(
+    seq_len: int = 128,
+    seed: int = 0,
+    cfg: Optional[TransformerConfig] = None,
+    attn_fn: Optional[Callable] = None,
+) -> FlaxModel:
+    """A small LoRA-ready causal LM bound to concrete params."""
+    cfg = cfg or TransformerConfig()
+    module = CausalLM(cfg, attn_fn)
+    rng = jax.random.PRNGKey(seed)
+    dummy = jnp.zeros((1, seq_len), dtype=jnp.int32)
+    variables = module.init(rng, dummy)
+    model = FlaxModel(module, variables["params"], (seq_len,), cfg.vocab_size)
+    model.extra["config"] = cfg
+    return model
