@@ -13,4 +13,12 @@ boundary inside a round.
 from p2pfl_tpu.parallel.mesh import federation_mesh
 from p2pfl_tpu.parallel.spmd import SpmdFederation
 
-__all__ = ["SpmdFederation", "federation_mesh"]
+__all__ = ["SpmdFederation", "SpmdLoraFederation", "federation_mesh"]
+
+
+def __getattr__(name):
+    if name == "SpmdLoraFederation":  # lazy: avoid importing optax paths eagerly
+        from p2pfl_tpu.parallel.spmd_lora import SpmdLoraFederation
+
+        return SpmdLoraFederation
+    raise AttributeError(name)

@@ -210,22 +210,24 @@ class SpmdFederation:
         self._shard = NamedSharding(self.mesh, P(axis))  # shard axis 0 over nodes
         self._repl = NamedSharding(self.mesh, P())
 
-        # node-stacked state: every node starts from the same params
-        # (reference: initiator's weights seed the network, §3.3)
-        stack = lambda t: jax.device_put(  # noqa: E731
-            jnp.broadcast_to(t[None], (self.n, *t.shape)), self._shard
-        )
-        self.params = jax.tree.map(stack, model.params)
-        self.opt_state = jax.vmap(self.tx.init)(self.params)
-
         # device-resident data, truncated to common per-node sizes
         self._stage_data()
+        # node-stacked state: every node starts from the same params
+        # (reference: initiator's weights seed the network, §3.3)
+        self._stage_state()
 
         # election state (round-0 vote, reused thereafter — reference quirk)
         self.train_mask = np.ones(self.n, dtype=np.float32)
         self._vote = vote
         self.round = 0
         self.history: list[dict] = []
+
+    def _stage_state(self) -> None:
+        stack = lambda t: jax.device_put(  # noqa: E731
+            jnp.broadcast_to(t[None], (self.n, *t.shape)), self._shard
+        )
+        self.params = jax.tree.map(stack, self.model.params)
+        self.opt_state = jax.vmap(self.tx.init)(self.params)
 
     def _default_mesh(self) -> Mesh:
         from p2pfl_tpu.parallel.mesh import federation_mesh
@@ -279,9 +281,7 @@ class SpmdFederation:
 
     # ---- round driver ----
 
-    def run_round(self, epochs: int = 1) -> dict:
-        if self.round == 0 and self._vote:
-            self.train_mask = self.elect_train_set()
+    def _make_perm(self, epochs: int):
         perm = np.stack(
             [
                 np.stack(
@@ -295,7 +295,12 @@ class SpmdFederation:
                 for _ in range(self.n)
             ]
         ).astype(np.int32)
-        perm = jax.device_put(perm, self._shard)
+        return jax.device_put(perm, self._shard)
+
+    def run_round(self, epochs: int = 1) -> dict:
+        if self.round == 0 and self._vote:
+            self.train_mask = self.elect_train_set()
+        perm = self._make_perm(epochs)
         mask = jax.device_put(jnp.asarray(self.train_mask), self._shard)
         self.params, self.opt_state, loss = spmd_round(
             self.params,

@@ -1,0 +1,80 @@
+"""SPMD LoRA federation + TP sharding rules tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from p2pfl_tpu.learning.dataset import FederatedDataset
+from p2pfl_tpu.models.transformer import TransformerConfig, tiny_transformer
+from p2pfl_tpu.parallel import SpmdLoraFederation
+
+CFG = TransformerConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2, ffn_hidden=128)
+
+
+def _data():
+    return FederatedDataset.synthetic_lm(vocab_size=CFG.vocab_size, seq_len=32, n_train=512, n_test=64)
+
+
+def test_spmd_lora_learns_and_diffuses():
+    # wider adapters + higher lr: the frozen base is random (not pretrained),
+    # so the adapters carry all the learning in this test
+    cfg = TransformerConfig(
+        vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        ffn_hidden=128, lora_rank=16, lora_mlp=True,
+    )
+    model = tiny_transformer(seq_len=32, cfg=cfg)
+    fed = SpmdLoraFederation.from_dataset(
+        model, _data(), n_nodes=4, batch_size=8, vote=False, learning_rate=1e-2
+    )
+    before = fed.evaluate()["test_acc"]
+    fed.run(rounds=4, epochs=1)
+    after = fed.evaluate()["test_acc"]
+    assert after > max(before, 0.1)
+    # all nodes hold the same adapters after diffusion
+    a = jax.tree.leaves(fed.node_params(0))
+    b = jax.tree.leaves(fed.node_params(3))
+    for x, y in zip(a, b):
+        np.testing.assert_allclose(np.asarray(x, np.float32), np.asarray(y, np.float32), atol=1e-6)
+
+
+def test_spmd_lora_state_is_adapters_only():
+    model = tiny_transformer(seq_len=32, cfg=CFG)
+    fed = SpmdLoraFederation.from_dataset(model, _data(), n_nodes=4, batch_size=8, vote=False)
+    stacked = sum(x.size for x in jax.tree.leaves(fed.params))
+    base = sum(x.size for x in jax.tree.leaves(fed.base))
+    full = sum(x.size for x in jax.tree.leaves(model.params))
+    assert stacked == 4 * (full - base)  # adapters only, stacked N times
+    assert stacked < base  # federation state is smaller than one base model
+
+
+def test_tp_sharding_rules():
+    from p2pfl_tpu.parallel.mesh import federation_mesh
+    from p2pfl_tpu.parallel.sharding import partition_spec_for, transformer_shardings
+    from jax.sharding import PartitionSpec as P
+
+    assert partition_spec_for("layer_0/attn/wq/kernel") == P(None, "model")
+    assert partition_spec_for("layer_0/attn/wo/kernel") == P("model", None)
+    assert partition_spec_for("layer_1/mlp/w2/kernel") == P("model", None)
+    assert partition_spec_for("layer_0/attn/wq/lora_a") == P()
+    assert partition_spec_for("final_norm/scale") == P()
+
+    mesh = federation_mesh(model_parallel=4, devices=jax.devices()[:4])
+    model = tiny_transformer(seq_len=16, cfg=CFG)
+    shardings = transformer_shardings(mesh, model.params)
+    wq = shardings["layer_0"]["attn"]["wq"]["kernel"]
+    assert wq.spec == P(None, "model")
+
+
+def test_tp_sharded_forward_matches_replicated():
+    """Forward pass with TP-sharded base == replicated base."""
+    from p2pfl_tpu.parallel.mesh import federation_mesh
+    from p2pfl_tpu.parallel.sharding import shard_transformer
+
+    mesh = federation_mesh(model_parallel=4, devices=jax.devices()[:4])
+    model = tiny_transformer(seq_len=16, cfg=CFG)
+    toks = jnp.arange(16, dtype=jnp.int32)[None] % CFG.vocab_size
+    want = model.apply(model.params, toks)
+    sharded = shard_transformer(mesh, model.params)
+    got = jax.jit(lambda p, t: model.module.apply({"params": p}, t))(sharded, toks)
+    # bf16 matmuls accumulate in a different order when sharded
+    np.testing.assert_allclose(np.asarray(want), np.asarray(got), atol=2e-2)
