@@ -52,6 +52,10 @@ class Settings:
     # Mesh axis names used by the parallel runtime.
     MESH_NODES_AXIS: str = "nodes"
     MESH_MODEL_AXIS: str = "model"
+    # Wire compression for network transports: "none" | "int8"
+    # (int8 = symmetric per-tensor quantization, 4x smaller gossip payloads,
+    # native C++ hot loop when p2pfl_tpu/native is built).
+    WIRE_COMPRESSION: str = "none"
 
 
 def set_test_settings() -> None:
