@@ -1,0 +1,132 @@
+"""Pallas flash attention (single chip).
+
+Blockwise causal attention with online softmax: O(T·D) VMEM per program
+instead of the O(T²) logits matrix. Grid is (batch, heads, q-blocks); each
+program streams K/V blocks up to its causal frontier, keeping running
+(max, denom, accumulator) statistics in fp32 while the matmuls feed the MXU
+in the input dtype.
+
+Training: ``flash_attention`` carries a custom VJP whose backward pass
+recomputes attention with the standard XLA path (rematerialization — the
+fused forward is where the memory win matters; the backward stays
+compiler-scheduled). Inference/eval uses the kernel alone.
+
+Playbook: /opt/skills/guides/pallas_guide.md (grid/BlockSpec, online
+softmax accumulation, broadcasted_iota masking, @pl.when).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k, causal, scale):
+    qi = pl.program_id(2)
+    t = k_ref.shape[0]
+    q = q_ref[:].astype(jnp.float32) * scale  # [BQ, D]
+
+    acc = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+    m = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q, 1), jnp.float32)
+
+    n_blocks = t // block_k
+    if causal:
+        # only stream K/V blocks that intersect the causal frontier
+        n_blocks = lax.div((qi + 1) * block_q + block_k - 1, block_k)
+
+    def body(j, carry):
+        acc, m, l = carry
+        k = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [BQ, BK]
+        if causal:
+            rows = qi * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(m_new <= NEG_INF / 2, 0.0, p)
+        alpha = jnp.where(m <= NEG_INF / 2, 0.0, jnp.exp(m - m_new))
+        acc = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        return acc, m_new, l
+
+    acc, m, l = lax.fori_loop(0, n_blocks, body, (acc, m, l))
+    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_bthd(q, k, v, *, block_q, block_k, causal, interpret):
+    """q,k,v: [B, H, T, D] → [B, H, T, D]."""
+    b, h, t, d = q.shape
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    assert t % block_q == 0 and t % block_k == 0, "T must divide the block sizes"
+    scale = d ** -0.5
+    grid = (b, h, t // block_q)
+    # None-squeezed leading dims: kernel refs arrive 2D ([BQ, D] / [T, D]).
+    # (.at[] ref views are rejected by this environment's Mosaic compiler.)
+    qspec = pl.BlockSpec((None, None, block_q, d), lambda bi, hi, i: (bi, hi, i, 0))
+    kvspec = pl.BlockSpec((None, None, t, d), lambda bi, hi, i: (bi, hi, 0, 0))
+
+    kernel = partial(
+        _flash_kernel, block_q=block_q, block_k=block_k, causal=causal, scale=scale
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[qspec, kvspec, kvspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(q, k, v)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(
+    q, k, v, causal: bool = True, block_q: int = 128, block_k: int = 128, interpret: bool = False
+):
+    """Flash attention. q,k,v: [B, T, H, D] (GQA heads pre-repeated)."""
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    out = _flash_bthd(
+        qt, kt, vt, block_q=block_q, block_k=block_k, causal=causal, interpret=interpret
+    )
+    return out.transpose(0, 2, 1, 3)
+
+
+def _fwd(q, k, v, causal, block_q, block_k, interpret):
+    return flash_attention(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+
+
+def _bwd(causal, block_q, block_k, interpret, res, g):
+    """Rematerialized backward through the reference XLA attention."""
+    from p2pfl_tpu.ops.attention import causal_attention
+
+    q, k, v = res
+    if causal:
+        _, vjp = jax.vjp(causal_attention, q, k, v)
+    else:
+
+        def dense(q_, k_, v_):
+            d = q_.shape[-1]
+            s = jnp.einsum("bqhd,bkhd->bhqk", q_, k_, preferred_element_type=jnp.float32)
+            p = jax.nn.softmax(s * (d ** -0.5), axis=-1).astype(q_.dtype)
+            return jnp.einsum("bhqk,bkhd->bqhd", p, v_)
+
+        _, vjp = jax.vjp(dense, q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)

@@ -1,0 +1,70 @@
+"""Flash-attention kernel tests (pallas interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2pfl_tpu.ops.attention import causal_attention
+from p2pfl_tpu.ops.flash_attention import flash_attention
+
+
+def _qkv(b=2, t=128, h=4, d=32, seed=0, dtype=jnp.float32):
+    keys = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return tuple(jax.random.normal(k, (b, t, h, d), dtype) for k in keys)
+
+
+def test_flash_matches_dense_causal():
+    q, k, v = _qkv()
+    want = causal_attention(q, k, v)
+    got = flash_attention(q, k, v, True, 32, 32, True)  # interpret mode
+    np.testing.assert_allclose(np.asarray(want), np.asarray(got), atol=2e-5)
+
+
+def test_flash_non_causal():
+    q, k, v = _qkv(t=64)
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (d**-0.5)
+    p = jax.nn.softmax(s, axis=-1)
+    want = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    got = flash_attention(q, k, v, False, 32, 32, True)
+    np.testing.assert_allclose(np.asarray(want), np.asarray(got), atol=2e-5)
+
+
+def test_flash_uneven_blocks():
+    """block_q != block_k and T not equal to block sizes."""
+    q, k, v = _qkv(t=96)
+    want = causal_attention(q, k, v)
+    got = flash_attention(q, k, v, True, 32, 48, True)
+    np.testing.assert_allclose(np.asarray(want), np.asarray(got), atol=2e-5)
+
+
+def test_flash_gradient_matches_dense():
+    q, k, v = _qkv(b=1, t=32, h=2, d=16)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, True, 16, 16, True) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(causal_attention(q, k, v) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_flash_in_transformer():
+    """Wire the kernel in as the model's attention implementation."""
+    from functools import partial
+
+    from p2pfl_tpu.models.transformer import TransformerConfig, tiny_transformer
+
+    cfg = TransformerConfig(vocab_size=64, dim=32, n_layers=1, n_heads=2, n_kv_heads=2, ffn_hidden=64)
+    attn = partial(flash_attention, causal=True, block_q=16, block_k=16, interpret=True)
+    m_flash = tiny_transformer(seq_len=32, cfg=cfg, attn_fn=attn, seed=4)
+    m_dense = tiny_transformer(seq_len=32, cfg=cfg, seed=4)
+    toks = (jnp.arange(32, dtype=jnp.int32) % 64)[None]
+    a = m_flash.apply(m_flash.params, toks)
+    b = m_dense.apply(m_dense.params, toks)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-2)
