@@ -107,7 +107,7 @@ def _aggregate(p_used, mask, weights, agg: str, trim: int):
 
 @partial(
     jax.jit,
-    static_argnames=("module", "tx", "agg", "trim"),
+    static_argnames=("module", "tx", "agg", "trim", "out_sharding"),
     donate_argnums=(0, 1),
 )
 def spmd_round(
@@ -123,6 +123,7 @@ def spmd_round(
     tx,
     agg: str = "fedavg",
     trim: int = 0,
+    out_sharding=None,
 ):
     """One federated round for all N nodes. Returns (params', opt', mean loss)."""
     n = mask.shape[0]
@@ -152,6 +153,12 @@ def spmd_round(
     # diffusion: every node receives the aggregate; optimizer state resets
     # (reference parity: set_parameters → fresh Trainer per round)
     out_params = jax.tree.map(lambda a: jnp.broadcast_to(a[None], (n, *a.shape)), agg_params)
+    if out_sharding is not None:
+        # pin the node-stacked layout so round k+1 reuses round k's executable
+        # (otherwise the broadcast's replicated layout forces a relayout+retrace)
+        out_params = jax.tree.map(
+            lambda a: jax.lax.with_sharding_constraint(a, out_sharding), out_params
+        )
     out_opt = jax.vmap(tx.init)(out_params)
     return out_params, out_opt, jnp.mean(losses, where=mask.astype(bool))
 
@@ -221,6 +228,19 @@ class SpmdFederation:
         self._vote = vote
         self.round = 0
         self.history: list[dict] = []
+
+    def reset(self, seed: int = 0) -> None:
+        """Back to round 0 with fresh state, keeping mesh/data/executables.
+
+        Use this (not a new federation) to measure or restart: a new object
+        builds a new Mesh and misses every jit cache.
+        """
+        self._rng = np.random.default_rng(seed)
+        self._py_rng = random.Random(seed)
+        self.train_mask = np.ones(self.n, dtype=np.float32)
+        self.round = 0
+        self.history = []
+        self._stage_state()
 
     def _stage_state(self) -> None:
         stack = lambda t: jax.device_put(  # noqa: E731
@@ -314,9 +334,12 @@ class SpmdFederation:
             tx=self.tx,
             agg=self.aggregator,
             trim=self.trim,
+            out_sharding=self._shard,
         )
         self.round += 1
-        entry = {"round": self.round, "train_loss": float(loss)}
+        # keep the loss as a device scalar: rounds pipeline back-to-back with
+        # no host sync; it coerces to float lazily (e.g. when printed)
+        entry = {"round": self.round, "train_loss": loss}
         self.history.append(entry)
         return entry
 

@@ -28,7 +28,9 @@ from p2pfl_tpu.parallel.spmd import SpmdFederation, _aggregate
 Pytree = Any
 
 
-@partial(jax.jit, static_argnames=("module", "tx", "agg", "trim"), donate_argnums=(0, 1))
+@partial(
+    jax.jit, static_argnames=("module", "tx", "agg", "trim", "out_sharding"), donate_argnums=(0, 1)
+)
 def spmd_lora_round(
     stacked_lora,  # [N, ...] adapters
     opt_states,  # [N, ...]
@@ -43,6 +45,7 @@ def spmd_lora_round(
     tx,
     agg: str = "fedavg",
     trim: int = 0,
+    out_sharding=None,
 ):
     import optax
 
@@ -81,6 +84,8 @@ def spmd_lora_round(
     used = jax.tree.map(sel, trained, stacked_lora)
     agg_lora = _aggregate(used, mask, weights, agg, trim)
     out = jax.tree.map(lambda a: jnp.broadcast_to(a[None], (n, *a.shape)), agg_lora)
+    if out_sharding is not None:
+        out = jax.tree.map(lambda a: jax.lax.with_sharding_constraint(a, out_sharding), out)
     out_opt = jax.vmap(tx.init)(out)
     return out, out_opt, jnp.mean(losses, where=mask.astype(bool))
 
@@ -146,9 +151,10 @@ class SpmdLoraFederation(SpmdFederation):
             tx=self.tx,
             agg=self.aggregator,
             trim=self.trim,
+            out_sharding=self._shard,
         )
         self.round += 1
-        entry = {"round": self.round, "train_loss": float(loss)}
+        entry = {"round": self.round, "train_loss": loss}
         self.history.append(entry)
         return entry
 
