@@ -1,0 +1,59 @@
+"""Tracing / profiling helpers.
+
+The reference has no tracing at all (SURVEY §5: wall-clock prints only);
+``jax.profiler`` integration is the idiomatic TPU upgrade: traces capture
+XLA op timelines, collective latencies and host↔device transfers, viewable
+in TensorBoard/Perfetto.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Optional
+
+import jax
+
+from p2pfl_tpu.management.logger import logger
+
+
+@contextlib.contextmanager
+def trace(log_dir: str = "/tmp/p2pfl_tpu_trace") -> Iterator[None]:
+    """Capture a jax.profiler trace for the enclosed block."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        logger.info("profiler", f"trace written to {log_dir}")
+
+
+@contextlib.contextmanager
+def annotate(name: str, step: Optional[int] = None) -> Iterator[None]:
+    """Label the enclosed device work in the trace timeline."""
+    with jax.profiler.StepTraceAnnotation(name, step_num=step or 0):
+        yield
+
+
+class Stopwatch:
+    """Cheap wall-clock section timing (the reference's --measure_time,
+    generalized): ``with sw.section("fit"): ...`` then ``sw.summary()``."""
+
+    def __init__(self) -> None:
+        self.totals: dict[str, float] = {}
+        self.counts: dict[str, int] = {}
+
+    @contextlib.contextmanager
+    def section(self, name: str) -> Iterator[None]:
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self.totals[name] = self.totals.get(name, 0.0) + time.monotonic() - t0
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    def summary(self) -> dict[str, dict[str, float]]:
+        return {
+            k: {"total_s": round(v, 4), "calls": self.counts[k], "mean_s": round(v / self.counts[k], 4)}
+            for k, v in self.totals.items()
+        }
