@@ -226,6 +226,11 @@ class SpmdFederation:
         # election state (round-0 vote, reused thereafter — reference quirk)
         self.train_mask = np.ones(self.n, dtype=np.float32)
         self._vote = vote
+        # failure semantics on a mesh (SURVEY §7 "failure semantics on a
+        # pod"): chips don't crash independently, so node failure is modeled
+        # by masking slots out of training AND aggregation — the collective
+        # analogue of heartbeat eviction
+        self.active_mask = np.ones(self.n, dtype=np.float32)
         self.round = 0
         self.history: list[dict] = []
 
@@ -238,6 +243,7 @@ class SpmdFederation:
         self._rng = np.random.default_rng(seed)
         self._py_rng = random.Random(seed)
         self.train_mask = np.ones(self.n, dtype=np.float32)
+        self.active_mask = np.ones(self.n, dtype=np.float32)
         self.round = 0
         self.history = []
         self._stage_state()
@@ -317,11 +323,22 @@ class SpmdFederation:
         ).astype(np.int32)
         return jax.device_put(perm, self._shard)
 
+    def drop_node(self, i: int) -> None:
+        """Mark a logical node failed: it stops training and contributing
+        (the reference's heartbeat-eviction outcome, ``heartbeater.py:91-101``)."""
+        self.active_mask[i] = 0.0
+
+    def restore_node(self, i: int) -> None:
+        self.active_mask[i] = 1.0
+
     def run_round(self, epochs: int = 1) -> dict:
-        if self.round == 0 and self._vote:
+        if self._vote and (self.round == 0 or Settings.VOTE_EVERY_ROUND):
             self.train_mask = self.elect_train_set()
         perm = self._make_perm(epochs)
-        mask = jax.device_put(jnp.asarray(self.train_mask), self._shard)
+        effective = self.train_mask * self.active_mask
+        if effective.sum() == 0:
+            raise RuntimeError("no active train-set nodes left")
+        mask = jax.device_put(jnp.asarray(effective), self._shard)
         self.params, self.opt_state, loss = spmd_round(
             self.params,
             self.opt_state,

@@ -134,10 +134,15 @@ class SpmdLoraFederation(SpmdFederation):
             self.base = jax.device_put(self._base_template, self._repl)
 
     def run_round(self, epochs: int = 1) -> dict:
-        if self.round == 0 and self._vote:
+        from p2pfl_tpu.settings import Settings
+
+        if self._vote and (self.round == 0 or Settings.VOTE_EVERY_ROUND):
             self.train_mask = self.elect_train_set()
         perm = self._make_perm(epochs)
-        mask = jax.device_put(jnp.asarray(self.train_mask), self._shard)
+        effective = self.train_mask * self.active_mask
+        if effective.sum() == 0:
+            raise RuntimeError("no active train-set nodes left")
+        mask = jax.device_put(jnp.asarray(effective), self._shard)
         self.params, self.opt_state, loss = spmd_lora_round(
             self.params,
             self.opt_state,

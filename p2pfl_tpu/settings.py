@@ -38,6 +38,10 @@ class Settings:
     VOTE_TIMEOUT: float = 60.0
     AGGREGATION_TIMEOUT: float = 300.0
     WAIT_HEARTBEATS_CONVERGENCE: float = 1.0
+    # The reference votes only in round 0 and reuses that train set forever
+    # (``round_finished_stage.py:69-70``). False replicates that; True
+    # re-elects every round (recommended when nodes churn).
+    VOTE_EVERY_ROUND: bool = False
 
     # --- monitoring ---
     RESOURCE_MONITOR_PERIOD: float = 1.0

@@ -293,6 +293,8 @@ class RoundFinishedStage(Stage):
         state.increase_round()
         logger.round_finished(node.addr)
         if state.round is not None and state.total_rounds is not None and state.round < state.total_rounds:
+            if Settings.VOTE_EVERY_ROUND:
+                return VoteTrainSetStage
             return TrainStage if node.addr in state.train_set else WaitAggregatedModelsStage
         # experiment over: final evaluation, clear state
         metrics = node.learner.evaluate()
