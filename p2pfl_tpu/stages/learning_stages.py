@@ -208,7 +208,13 @@ class TrainStage(Stage):
             peer_has = state.models_aggregated.get(nei, [])
             partial = node.aggregator.get_partial_aggregation(peer_has)
             if partial is None:
-                return None
+                # robust strategies (SUPPORTS_PARTIALS=False) ship individual
+                # models instead of a pre-average; one per tick, the peer's
+                # coverage broadcasts advance the queue
+                todo = node.aggregator.get_models_to_send(peer_has)
+                if not todo:
+                    return None
+                partial = todo[0]
             return node.protocol.build_weights("add_model", state.round or 0, partial)
 
         node.protocol.gossip_weights(

@@ -1,0 +1,42 @@
+"""Robust aggregation over the gossip transport: individual-model shipping.
+
+FedMedian/Krum must not be fed pre-averaged partials
+(``SUPPORTS_PARTIALS=False``); in gossip mode nodes ship individual models
+one per tick. This covers the reference's ``get_partial_aggregation`` /
+models-to-send seam (``aggregator.py:249-281``) for the robust family.
+"""
+
+import pytest
+
+from p2pfl_tpu.communication.memory import MemoryRegistry
+from p2pfl_tpu.learning.aggregators import FedMedian
+from p2pfl_tpu.learning.dataset import FederatedDataset
+from p2pfl_tpu.learning.learner import JaxLearner
+from p2pfl_tpu.models import mlp
+from p2pfl_tpu.node import Node
+from p2pfl_tpu.utils import full_connection, wait_convergence, wait_to_finish, check_equal_models
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    MemoryRegistry.reset()
+    yield
+    MemoryRegistry.reset()
+
+
+def test_fedmedian_gossip_three_nodes():
+    full = FederatedDataset.synthetic_mnist(n_train=768, n_test=128)
+    nodes = []
+    for i in range(3):
+        learner = JaxLearner(mlp(seed=i), full.partition(i, 3), batch_size=64)
+        nodes.append(Node(learner=learner, aggregator=FedMedian()))
+    for n in nodes:
+        n.start()
+    for n in nodes:
+        full_connection(n, nodes)
+    wait_convergence(nodes, 2, only_direct=True)
+    nodes[0].set_start_learning(rounds=1, epochs=0)
+    wait_to_finish(nodes, timeout=90)
+    check_equal_models(nodes)
+    for n in nodes:
+        n.stop()
