@@ -38,8 +38,14 @@ def main(argv=None) -> int:
     run.add_argument("extra", nargs=argparse.REMAINDER, help="arguments passed to the experiment")
 
     sub.add_parser("bench", help="run the headline benchmark")
+    # remote-management verbs are stubs in the reference too (cli.py:71-95)
+    for stub in ("login", "remote", "launch"):
+        sub.add_parser(stub, help="(coming soon)")
 
     args = parser.parse_args(argv)
+    if args.command in ("login", "remote", "launch"):
+        print(f"{args.command}: coming soon (stub — reference parity, cli.py:71-95)")
+        return 0
     if args.command == "experiment":
         if args.action == "list":
             for name, doc in sorted(_discover().items()):

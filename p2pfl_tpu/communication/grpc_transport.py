@@ -18,7 +18,6 @@ materialized against the receiving learner's parameter structure
 from __future__ import annotations
 
 import json
-import socket
 import threading
 from concurrent import futures
 from typing import Optional
@@ -134,13 +133,9 @@ class GrpcProtocol(CommunicationProtocol):
     """
 
     def __init__(self, address: Optional[str] = None) -> None:
-        address = address or "127.0.0.1:0"
-        host, _, port = address.rpartition(":")
-        if not port.isdigit():
-            raise ValueError(f"address must be host:port, got {address!r}")
-        if int(port) == 0:
-            port = str(_free_port(host or "127.0.0.1"))
-        super().__init__(f"{host}:{port}")
+        from p2pfl_tpu.communication.address import parse_address
+
+        super().__init__(parse_address(address).target)
         self._server: Optional[grpc.Server] = None
         self._lock = threading.Lock()
 
@@ -228,8 +223,3 @@ class _Handler(grpc.GenericRpcHandler):
         return grpc.unary_unary_rpc_method_handler(fn)
 
 
-def _free_port(host: str) -> int:
-    """OS-assigned free port (reference ``address.py:60-63``)."""
-    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
-        s.bind((host, 0))
-        return s.getsockname()[1]

@@ -64,6 +64,10 @@ class P2pflLogger:
         # addr -> (node_state, simulation_flag)
         self._nodes: Dict[str, Tuple[Any, bool]] = {}
         self._nodes_lock = threading.Lock()
+        # optional web dashboard (reference logger.py:264-300): when attached,
+        # metrics mirror to REST and a NodeMonitor runs per registered node
+        self._web: Any = None
+        self._monitors: Dict[str, Any] = {}
 
     # ---- setup ----
 
@@ -79,6 +83,21 @@ class P2pflLogger:
         fh.setFormatter(logging.Formatter("%(asctime)s | %(levelname)s | %(message)s"))
         self._logger.addHandler(fh)
         self._file_handler = fh
+
+    def connect_web_services(self, web: Any) -> None:
+        """Attach a :class:`~p2pfl_tpu.management.web_services.WebServices`.
+
+        Mirrors the reference's ``init_p2pfl_web_services``: subsequent
+        node registrations and metrics are pushed to the dashboard, and a
+        resource monitor starts per node (``logger.py:504-511``).
+        """
+        self._web = web
+
+    def disconnect_web_services(self) -> None:
+        for monitor in self._monitors.values():
+            monitor.stop()
+        self._monitors.clear()
+        self._web = None
 
     # ---- leveled logging, keyed by node addr ----
 
@@ -118,8 +137,12 @@ class P2pflLogger:
             round = 0  # noqa: A001
         if step is None:
             self.global_metrics.add_log(exp, round, metric, node, value)
+            if self._web is not None:
+                self._web.send_global_metric(exp, round, metric, node, value)
         else:
             self.local_metrics.add_log(exp, round, metric, node, value, step)
+            if self._web is not None:
+                self._web.send_local_metric(exp, round, metric, node, step, value)
 
     def get_local_logs(self):
         return self.local_metrics.get_all_logs()
@@ -132,10 +155,29 @@ class P2pflLogger:
     def register_node(self, node: str, state: Any = None, simulation: bool = False) -> None:
         with self._nodes_lock:
             self._nodes[node] = (state, simulation)
+        if self._web is not None:
+            self._web.register_node(node, is_simulated=simulation)
+            import time as _time
+
+            from p2pfl_tpu.management.node_monitor import NodeMonitor
+
+            monitor = NodeMonitor(
+                node,
+                report_fn=lambda n, m, v: self._web.send_system_metric(
+                    n, m, v, _time.strftime("%Y-%m-%d %H:%M:%S")
+                ),
+            )
+            monitor.start()
+            self._monitors[node] = monitor
 
     def unregister_node(self, node: str) -> None:
         with self._nodes_lock:
             self._nodes.pop(node, None)
+        monitor = self._monitors.pop(node, None)
+        if monitor is not None:
+            monitor.stop()
+        if self._web is not None:
+            self._web.unregister_node(node)
 
     def _experiment_for(self, node: str) -> Optional[str]:
         with self._nodes_lock:

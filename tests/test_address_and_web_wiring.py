@@ -1,0 +1,86 @@
+"""Address parsing + logger↔dashboard wiring tests."""
+
+import threading
+import time
+
+import pytest
+
+from p2pfl_tpu.communication.address import parse_address
+
+
+def test_parse_ipv4_with_port():
+    a = parse_address("10.0.0.1:9000")
+    assert a.kind == "ipv4" and a.host == "10.0.0.1" and a.port == 9000
+    assert a.target == "10.0.0.1:9000"
+
+
+def test_parse_assigns_free_port():
+    a = parse_address("127.0.0.1")
+    assert a.port and a.port > 0
+    b = parse_address(None)
+    assert b.host == "127.0.0.1" and b.port
+
+
+def test_parse_ipv6():
+    a = parse_address("[::1]:8000")
+    assert a.kind == "ipv6" and a.host == "::1" and a.port == 8000
+
+
+def test_parse_unix_socket():
+    a = parse_address("unix:/tmp/x.sock")
+    assert a.kind == "unix" and a.target == "unix:/tmp/x.sock"
+
+
+def test_parse_invalid():
+    with pytest.raises(ValueError):
+        parse_address("[broken")
+
+
+def test_logger_web_wiring():
+    """register_node + log_metric mirror to the dashboard; monitor runs."""
+    import http.server
+    import json
+
+    from p2pfl_tpu.management.logger import logger
+    from p2pfl_tpu.management.web_services import WebServices
+    from p2pfl_tpu.settings import Settings
+
+    Settings.RESOURCE_MONITOR_PERIOD = 0.05
+    received = []
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            body = self.rfile.read(int(self.headers["Content-Length"]))
+            received.append((self.path, json.loads(body)))
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(b"{}")
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        logger.connect_web_services(WebServices(f"http://127.0.0.1:{srv.server_port}", "k"))
+        logger.register_node("web-node-1", simulation=True)
+        logger.log_metric("web-node-1", "acc", 0.9, round=1, experiment="e1")
+        logger.log_metric("web-node-1", "loss", 1.0, step=3, round=1, experiment="e1")
+        time.sleep(0.3)  # let the monitor tick
+        logger.unregister_node("web-node-1")
+        paths = [p for p, _ in received]
+        assert "/node" in paths
+        assert "/node-metric/global" in paths
+        assert "/node-metric/local" in paths
+        assert "/node-metric/system" in paths  # monitor samples
+        assert "/node-stop" in paths
+    finally:
+        logger.disconnect_web_services()
+        srv.shutdown()
+
+
+def test_cli_stubs():
+    from p2pfl_tpu.cli import main
+
+    assert main(["login"]) == 0
+    assert main(["remote"]) == 0
