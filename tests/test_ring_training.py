@@ -1,0 +1,76 @@
+"""Sequence-parallel training: gradients flow through ring attention.
+
+The long-context path must be trainable, not just a forward op: autodiff
+through ``shard_map`` + ``ppermute`` gives the reverse ring automatically.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from p2pfl_tpu.models.transformer import TransformerConfig, tiny_transformer
+from p2pfl_tpu.ops.attention import ring_attention
+from p2pfl_tpu.parallel.mesh import federation_mesh
+
+CFG = TransformerConfig(vocab_size=128, dim=64, n_layers=2, n_heads=4, n_kv_heads=4, ffn_hidden=128)
+
+
+def _loss_fn(model):
+    def loss(params, x, y):
+        logits = model.module.apply({"params": params}, x)
+        return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+    return loss
+
+
+def test_ring_attention_gradients_match_dense():
+    mesh = federation_mesh(model_parallel=4, devices=jax.devices()[:4])
+    attn = partial(ring_attention, mesh=mesh, axis_name="model")
+    seq = 64
+
+    m_ring = tiny_transformer(seq_len=seq, cfg=CFG, attn_fn=attn, seed=11)
+    m_dense = tiny_transformer(seq_len=seq, cfg=CFG, seed=11)
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, CFG.vocab_size, size=(2, seq)), jnp.int32)
+    y = jnp.asarray(rng.integers(0, CFG.vocab_size, size=(2, seq)), jnp.int32)
+
+    g_ring = jax.grad(_loss_fn(m_ring))(m_ring.params, x, y)
+    g_dense = jax.grad(_loss_fn(m_dense))(m_dense.params, x, y)
+    leaves_r, leaves_d = jax.tree.leaves(g_ring), jax.tree.leaves(g_dense)
+    assert len(leaves_r) == len(leaves_d)
+    for a, b in zip(leaves_r, leaves_d):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32), atol=5e-3
+        )
+
+
+def test_ring_transformer_train_step_reduces_loss():
+    mesh = federation_mesh(model_parallel=8)
+    attn = partial(ring_attention, mesh=mesh, axis_name="model")
+    seq = 64
+    model = tiny_transformer(seq_len=seq, cfg=CFG, attn_fn=attn, seed=1)
+    loss_fn = _loss_fn(model)
+
+    tx = optax.adam(1e-2)
+    params = model.params
+    opt = tx.init(params)
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.integers(0, CFG.vocab_size, size=(4, seq)), jnp.int32)
+    # learnable: predict the same token (copy task on constant targets)
+    y = jnp.tile(jnp.arange(seq, dtype=jnp.int32)[None] % CFG.vocab_size, (4, 1))
+
+    @jax.jit
+    def step(params, opt):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        updates, opt = tx.update(grads, opt, params)
+        return optax.apply_updates(params, updates), opt, loss
+
+    first = None
+    for _ in range(10):
+        params, opt, loss = step(params, opt)
+        first = float(loss) if first is None else first
+    assert float(loss) < first * 0.7
