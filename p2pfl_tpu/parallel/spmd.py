@@ -107,7 +107,7 @@ def _aggregate(p_used, mask, weights, agg: str, trim: int):
 
 @partial(
     jax.jit,
-    static_argnames=("module", "tx", "agg", "trim", "out_sharding"),
+    static_argnames=("module", "tx", "agg", "trim", "out_sharding", "keep_opt_state"),
     donate_argnums=(0, 1),
 )
 def spmd_round(
@@ -124,6 +124,7 @@ def spmd_round(
     agg: str = "fedavg",
     trim: int = 0,
     out_sharding=None,
+    keep_opt_state: bool = False,
 ):
     """One federated round for all N nodes. Returns (params', opt', mean loss)."""
     n = mask.shape[0]
@@ -150,8 +151,7 @@ def spmd_round(
     p_used = jax.tree.map(sel, trained_p, stacked_params)
     agg_params = _aggregate(p_used, mask, weights, agg, trim)
 
-    # diffusion: every node receives the aggregate; optimizer state resets
-    # (reference parity: set_parameters → fresh Trainer per round)
+    # diffusion: every node receives the aggregate
     out_params = jax.tree.map(lambda a: jnp.broadcast_to(a[None], (n, *a.shape)), agg_params)
     if out_sharding is not None:
         # pin the node-stacked layout so round k+1 reuses round k's executable
@@ -159,7 +159,13 @@ def spmd_round(
         out_params = jax.tree.map(
             lambda a: jax.lax.with_sharding_constraint(a, out_sharding), out_params
         )
-    out_opt = jax.vmap(tx.init)(out_params)
+    if keep_opt_state:
+        # documented improvement over the reference: carry Adam moments
+        # across rounds (the reference rebuilds its Trainer per round,
+        # losing them — slower convergence)
+        out_opt = trained_o
+    else:
+        out_opt = jax.vmap(tx.init)(out_params)
     return out_params, out_opt, jnp.mean(losses, where=mask.astype(bool))
 
 
@@ -197,6 +203,7 @@ class SpmdFederation:
         aggregator: str = "fedavg",
         trim: int = 0,
         vote: bool = True,
+        keep_opt_state: bool = False,
         seed: int = 0,
     ) -> None:
         self.model = model
@@ -209,6 +216,7 @@ class SpmdFederation:
         self.tx = adam(learning_rate)
         self.aggregator = aggregator
         self.trim = trim
+        self.keep_opt_state = keep_opt_state
         self._rng = np.random.default_rng(seed)
         self._py_rng = random.Random(seed)
 
@@ -352,6 +360,7 @@ class SpmdFederation:
             agg=self.aggregator,
             trim=self.trim,
             out_sharding=self._shard,
+            keep_opt_state=self.keep_opt_state,
         )
         self.round += 1
         # keep the loss as a device scalar: rounds pipeline back-to-back with

@@ -29,7 +29,9 @@ Pytree = Any
 
 
 @partial(
-    jax.jit, static_argnames=("module", "tx", "agg", "trim", "out_sharding"), donate_argnums=(0, 1)
+    jax.jit,
+    static_argnames=("module", "tx", "agg", "trim", "out_sharding", "keep_opt_state"),
+    donate_argnums=(0, 1),
 )
 def spmd_lora_round(
     stacked_lora,  # [N, ...] adapters
@@ -46,6 +48,7 @@ def spmd_lora_round(
     agg: str = "fedavg",
     trim: int = 0,
     out_sharding=None,
+    keep_opt_state: bool = False,
 ):
     import optax
 
@@ -73,7 +76,7 @@ def spmd_lora_round(
         (lora, opt_state), losses = jax.lax.scan(epoch_body, (lora, opt_state), idx)
         return lora, opt_state, jnp.mean(losses)
 
-    trained, _opt, losses = jax.vmap(node_fn, in_axes=(0, 0, 0, 0, 0))(
+    trained, trained_opt, losses = jax.vmap(node_fn, in_axes=(0, 0, 0, 0, 0))(
         stacked_lora, opt_states, x_all, y_all, perm
     )
 
@@ -86,7 +89,7 @@ def spmd_lora_round(
     out = jax.tree.map(lambda a: jnp.broadcast_to(a[None], (n, *a.shape)), agg_lora)
     if out_sharding is not None:
         out = jax.tree.map(lambda a: jax.lax.with_sharding_constraint(a, out_sharding), out)
-    out_opt = jax.vmap(tx.init)(out)
+    out_opt = trained_opt if keep_opt_state else jax.vmap(tx.init)(out)
     return out, out_opt, jnp.mean(losses, where=mask.astype(bool))
 
 
@@ -157,6 +160,7 @@ class SpmdLoraFederation(SpmdFederation):
             agg=self.aggregator,
             trim=self.trim,
             out_sharding=self._shard,
+            keep_opt_state=self.keep_opt_state,
         )
         self.round += 1
         entry = {"round": self.round, "train_loss": loss}
