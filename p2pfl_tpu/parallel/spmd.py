@@ -125,8 +125,15 @@ def spmd_round(
     trim: int = 0,
     out_sharding=None,
     keep_opt_state: bool = False,
+    x_test=None,
+    y_test=None,
 ):
-    """One federated round for all N nodes. Returns (params', opt', mean loss)."""
+    """One federated round for all N nodes.
+
+    Returns (params', opt', mean loss[, test acc]) — the accuracy of the
+    aggregated model is fused into the same program when test data is given
+    (one device dispatch for train + aggregate + diffuse + eval).
+    """
     n = mask.shape[0]
 
     # gather per-epoch batches: idx [epochs, nb, bs] → x[idx] [epochs, nb, bs, ...]
@@ -166,7 +173,22 @@ def spmd_round(
         out_opt = trained_o
     else:
         out_opt = jax.vmap(tx.init)(out_params)
-    return out_params, out_opt, jnp.mean(losses, where=mask.astype(bool))
+    if out_sharding is not None:
+        # vmap(tx.init) outputs otherwise come back replicated, flipping the
+        # opt-state layout between rounds and forcing a recompile per variant
+        out_opt = jax.tree.map(
+            lambda a: jax.lax.with_sharding_constraint(a, out_sharding), out_opt
+        )
+    mean_loss = jnp.mean(losses, where=mask.astype(bool))
+    if x_test is None:
+        return out_params, out_opt, mean_loss
+
+    def node_acc(x, y):
+        logits = module.apply({"params": agg_params}, x)
+        return jnp.mean((jnp.argmax(logits, axis=-1) == y).astype(jnp.float32))
+
+    acc = jnp.mean(jax.vmap(node_acc)(x_test, y_test))
+    return out_params, out_opt, mean_loss, acc
 
 
 @partial(jax.jit, static_argnames=("module",))
@@ -257,11 +279,17 @@ class SpmdFederation:
         self._stage_state()
 
     def _stage_state(self) -> None:
-        stack = lambda t: jax.device_put(  # noqa: E731
-            jnp.broadcast_to(t[None], (self.n, *t.shape)), self._shard
-        )
-        self.params = jax.tree.map(stack, self.model.params)
-        self.opt_state = jax.vmap(self.tx.init)(self.params)
+        # jitted with out_shardings: the broadcast + init run ON DEVICE and
+        # land directly in the mesh layout (a host-side device_put would
+        # re-upload N x model_size through the host link)
+        n = self.n
+
+        @partial(jax.jit, out_shardings=(self._shard, self._shard))
+        def stage(tree):
+            stacked = jax.tree.map(lambda x: jnp.broadcast_to(x[None], (n, *x.shape)), tree)
+            return stacked, jax.vmap(self.tx.init)(stacked)
+
+        self.params, self.opt_state = stage(self.model.params)
 
     def _default_mesh(self) -> Mesh:
         from p2pfl_tpu.parallel.mesh import federation_mesh
@@ -339,7 +367,7 @@ class SpmdFederation:
     def restore_node(self, i: int) -> None:
         self.active_mask[i] = 1.0
 
-    def run_round(self, epochs: int = 1) -> dict:
+    def run_round(self, epochs: int = 1, eval: bool = False) -> dict:  # noqa: A002
         if self._vote and (self.round == 0 or Settings.VOTE_EVERY_ROUND):
             self.train_mask = self.elect_train_set()
         perm = self._make_perm(epochs)
@@ -347,7 +375,7 @@ class SpmdFederation:
         if effective.sum() == 0:
             raise RuntimeError("no active train-set nodes left")
         mask = jax.device_put(jnp.asarray(effective), self._shard)
-        self.params, self.opt_state, loss = spmd_round(
+        result = spmd_round(
             self.params,
             self.opt_state,
             self.x_all,
@@ -361,11 +389,16 @@ class SpmdFederation:
             trim=self.trim,
             out_sharding=self._shard,
             keep_opt_state=self.keep_opt_state,
+            x_test=self.x_test if eval else None,
+            y_test=self.y_test if eval else None,
         )
+        self.params, self.opt_state, loss = result[:3]
         self.round += 1
         # keep the loss as a device scalar: rounds pipeline back-to-back with
         # no host sync; it coerces to float lazily (e.g. when printed)
         entry = {"round": self.round, "train_loss": loss}
+        if eval:
+            entry["test_acc"] = result[3]
         self.history.append(entry)
         return entry
 

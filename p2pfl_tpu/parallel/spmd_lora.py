@@ -90,6 +90,10 @@ def spmd_lora_round(
     if out_sharding is not None:
         out = jax.tree.map(lambda a: jax.lax.with_sharding_constraint(a, out_sharding), out)
     out_opt = trained_opt if keep_opt_state else jax.vmap(tx.init)(out)
+    if out_sharding is not None:
+        out_opt = jax.tree.map(
+            lambda a: jax.lax.with_sharding_constraint(a, out_sharding), out_opt
+        )
     return out, out_opt, jnp.mean(losses, where=mask.astype(bool))
 
 
@@ -124,11 +128,14 @@ class SpmdLoraFederation(SpmdFederation):
 
     # node-stacked state = adapters only; base placed separately
     def _stage_state(self) -> None:
-        stack = lambda t: jax.device_put(  # noqa: E731
-            jnp.broadcast_to(t[None], (self.n, *t.shape)), self._shard
-        )
-        self.params = jax.tree.map(stack, self._lora_template)
-        self.opt_state = jax.vmap(self.tx.init)(self.params)
+        n = self.n
+
+        @partial(jax.jit, out_shardings=(self._shard, self._shard))
+        def stage(tree):
+            stacked = jax.tree.map(lambda x: jnp.broadcast_to(x[None], (n, *x.shape)), tree)
+            return stacked, jax.vmap(self.tx.init)(stacked)
+
+        self.params, self.opt_state = stage(self._lora_template)
         if self._mp_base:
             from p2pfl_tpu.parallel.sharding import shard_transformer
 
