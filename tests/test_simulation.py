@@ -36,10 +36,17 @@ def test_simulation_metrics_flow():
     sim.start().learn(rounds=1, epochs=0, timeout=90)
     evals = sim.evaluate()
     assert all("test_acc" in m for m in evals.values())
-    # the metrics command routed peers' broadcast metrics into the store
+    # the metrics command routed peers' broadcast metrics into the store;
+    # the store is a process singleton, so search across all experiments
     logs = sim.metrics()
     assert logs, "global metric store is empty"
-    exp = next(iter(logs.values()))
-    metric_names = {name for node_metrics in exp.values() for name in node_metrics}
+    node_addrs = {n.addr for n in sim.nodes}
+    metric_names = {
+        name
+        for exp in logs.values()
+        for node, node_metrics in exp.items()
+        if node in node_addrs
+        for name in node_metrics
+    }
     assert "test_acc" in metric_names
     sim.stop()
