@@ -226,6 +226,7 @@ class SpmdFederation:
         trim: int = 0,
         vote: bool = True,
         keep_opt_state: bool = False,
+        participation: float = 1.0,
         seed: int = 0,
     ) -> None:
         self.model = model
@@ -239,6 +240,9 @@ class SpmdFederation:
         self.aggregator = aggregator
         self.trim = trim
         self.keep_opt_state = keep_opt_state
+        if not 0.0 < participation <= 1.0:
+            raise ValueError("participation must be in (0, 1]")
+        self.participation = participation
         self._rng = np.random.default_rng(seed)
         self._py_rng = random.Random(seed)
 
@@ -359,6 +363,21 @@ class SpmdFederation:
         ).astype(np.int32)
         return jax.device_put(perm, self._shard)
 
+    def _effective_mask(self) -> np.ndarray:
+        """Train-set ∩ active nodes, optionally client-sampled per round."""
+        effective = self.train_mask * self.active_mask
+        if self.participation < 1.0:
+            # FedAvg-style client sampling: each round a random fraction of
+            # the eligible nodes trains (McMahan et al. 2017 C-fraction)
+            eligible = np.flatnonzero(effective)
+            k = max(1, round(self.participation * len(eligible)))
+            chosen = self._rng.choice(eligible, size=k, replace=False)
+            effective = np.zeros_like(effective)
+            effective[chosen] = 1.0
+        if effective.sum() == 0:
+            raise RuntimeError("no active train-set nodes left")
+        return effective
+
     def drop_node(self, i: int) -> None:
         """Mark a logical node failed: it stops training and contributing
         (the reference's heartbeat-eviction outcome, ``heartbeater.py:91-101``)."""
@@ -371,10 +390,7 @@ class SpmdFederation:
         if self._vote and (self.round == 0 or Settings.VOTE_EVERY_ROUND):
             self.train_mask = self.elect_train_set()
         perm = self._make_perm(epochs)
-        effective = self.train_mask * self.active_mask
-        if effective.sum() == 0:
-            raise RuntimeError("no active train-set nodes left")
-        mask = jax.device_put(jnp.asarray(effective), self._shard)
+        mask = jax.device_put(jnp.asarray(self._effective_mask()), self._shard)
         result = spmd_round(
             self.params,
             self.opt_state,

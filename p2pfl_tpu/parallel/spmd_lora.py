@@ -149,10 +149,7 @@ class SpmdLoraFederation(SpmdFederation):
         if self._vote and (self.round == 0 or Settings.VOTE_EVERY_ROUND):
             self.train_mask = self.elect_train_set()
         perm = self._make_perm(epochs)
-        effective = self.train_mask * self.active_mask
-        if effective.sum() == 0:
-            raise RuntimeError("no active train-set nodes left")
-        mask = jax.device_put(jnp.asarray(effective), self._shard)
+        mask = jax.device_put(jnp.asarray(self._effective_mask()), self._shard)
         self.params, self.opt_state, loss = spmd_lora_round(
             self.params,
             self.opt_state,
