@@ -5,9 +5,19 @@ accuracy in <60 s wall-clock with zero gRPC traffic (weights over ICI).
 The reference publishes no numbers (SURVEY §6); the target is the driver's
 BASELINE.json bound, so ``vs_baseline = 60 / measured_seconds`` (>1 beats it).
 
+Honesty notes (VERDICT r1 #2):
+- the JSON records data provenance (``data``: "idx" = real MNIST files,
+  "synthetic-hard" = the Gaussian-mixture stand-in);
+- the synthetic task uses 8 prototype modes per class at prototype scale
+  0.5 / noise 0.7 — measured to need ~12 federated rounds to 98% (see
+  ``accuracy_curve``), so "time-to-98%" measures convergence, not the
+  latency of one dispatch;
+- ``mfu`` is model-FLOPs-utilization of the steady-state round (compiled
+  XLA FLOPs / wall-clock / chip peak), null off-TPU.
+
 Runs the SPMD federation on whatever devices are available (the real TPU
 chip under the driver; the virtual CPU mesh under tests). One compile
-warm-up round runs first and is excluded — state is fully reset afterwards.
+warm-up phase runs first and is excluded — state is fully reset afterwards.
 
 Prints exactly ONE JSON line on stdout; progress goes to stderr.
 """
@@ -15,6 +25,7 @@ Prints exactly ONE JSON line on stdout; progress goes to stderr.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
@@ -27,6 +38,8 @@ TARGET_ACC = 0.98
 TARGET_SECONDS = 60.0
 MAX_ROUNDS = 30
 BATCH = 64
+# Gaussian-mixture difficulty (measured: ~12 rounds to 98% at this setting)
+HARD_TASK = {"modes": 8, "noise": 0.7, "proto_scale": 0.5}
 
 
 def log(msg: str) -> None:
@@ -35,11 +48,14 @@ def log(msg: str) -> None:
 
 def main() -> None:
     from p2pfl_tpu.learning.dataset import FederatedDataset
+    from p2pfl_tpu.management.profiling import mfu
     from p2pfl_tpu.models import mlp
     from p2pfl_tpu.parallel import SpmdFederation
 
     log(f"devices: {jax.devices()}")
-    data = FederatedDataset.mnist()  # real MNIST if present on disk, else synthetic
+    data = FederatedDataset.mnist(os.environ.get("P2PFL_MNIST_DIR"), **HARD_TASK)
+    provenance = "idx" if data.source == "idx" else "synthetic-hard"
+    log(f"data: {provenance}")
     model = mlp()
 
     fed = SpmdFederation.from_dataset(
@@ -65,9 +81,11 @@ def main() -> None:
     t0 = time.monotonic()
     elapsed = float("nan")
     acc = 0.0
+    curve = []
     for r in range(MAX_ROUNDS):
         entry = fed.run_round(epochs=1, eval=True)  # eval fused into the round
         acc = float(entry["test_acc"])
+        curve.append(round(acc, 4))
         elapsed = time.monotonic() - t0
         log(f"round {r + 1}: acc={acc:.4f} elapsed={elapsed:.2f}s")
         if acc >= TARGET_ACC:
@@ -84,6 +102,10 @@ def main() -> None:
     jax.block_until_ready(jax.tree.leaves(fed.params)[0])
     sec_per_round = (time.monotonic() - t1) / 5
 
+    # MFU of the steady-state round (train only, no eval)
+    flops = fed.round_flops()
+    round_mfu = mfu(flops, sec_per_round, n_devices=len(set(fed.mesh.devices.flat)))
+
     print(
         json.dumps(
             {
@@ -92,7 +114,12 @@ def main() -> None:
                 "unit": "s",
                 "vs_baseline": round(TARGET_SECONDS / elapsed, 3) if np.isfinite(elapsed) else 0.0,
                 "reached_acc": round(acc, 4),
+                "rounds_to_target": len(curve),
+                "accuracy_curve": curve,
                 "sec_per_round": round(sec_per_round, 4),
+                "flops_per_round": flops,
+                "mfu": round(round_mfu, 4) if round_mfu is not None else None,
+                "data": provenance,
                 "n_nodes": N_NODES,
                 "devices": len(jax.devices()),
             }

@@ -161,6 +161,12 @@ class CommunicationProtocol(ABC):
     def _dispatch(
         self, cmd: str, source: str, round: int, args: list[str], update: Optional[ModelUpdate]
     ) -> CommandResult:
+        from p2pfl_tpu.settings import Settings
+
+        if cmd != "beat" or not Settings.EXCLUDE_BEAT_LOGS:
+            # beat floods at 1/HEARTBEAT_PERIOD per neighbor — excluded from
+            # logs by default, same knob as the reference
+            logger.debug(self._address, f"Received '{cmd}' from {source}")
         handler = self._commands.get(cmd)
         if handler is None:
             logger.error(self._address, f"Unknown command '{cmd}' from {source}")

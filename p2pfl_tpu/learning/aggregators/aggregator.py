@@ -95,7 +95,19 @@ class Aggregator:
             return []
         with self._lock:
             if self._waiting:
-                if self._models:  # first update wins
+                # only a FULL-train-set aggregate is acceptable while waiting
+                # (reference aggregator.py:139-146 requires
+                # set(contributors) == set(train_set)); accepting a stray
+                # partial would make one node's single model this node's
+                # "aggregated model" — a poisoning hole
+                if contributors != frozenset(self._train_set):
+                    logger.debug(
+                        self.node_name,
+                        f"Rejecting model while waiting: coverage {sorted(contributors)} "
+                        f"!= train set {sorted(self._train_set)}",
+                    )
+                    return []
+                if self._models:  # first full update wins
                     logger.debug(self.node_name, "Rejecting model: already received while waiting")
                     return []
                 self._models = {contributors: update}

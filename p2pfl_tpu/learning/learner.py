@@ -134,6 +134,7 @@ class JaxLearner(NodeLearner):
         batch_size: int = 128,
         learning_rate: float = 1e-3,
         seed: int = 0,
+        keep_opt_state: bool = False,
     ) -> None:
         self.model = model
         self.data = data
@@ -141,6 +142,7 @@ class JaxLearner(NodeLearner):
         self.epochs = epochs
         self.batch_size = batch_size
         self.tx = adam(learning_rate)
+        self.keep_opt_state = keep_opt_state
         self.params: Pytree = model.params
         self.opt_state = self.tx.init(self.params)
         self._rng = np.random.default_rng(seed)
@@ -156,7 +158,12 @@ class JaxLearner(NodeLearner):
 
             raise ModelNotMatchingError("incoming params do not match model structure")
         self.params = params
-        self.opt_state = self.tx.init(params)
+        if not self.keep_opt_state:
+            # reference behavior: a fresh Trainer (and optimizer) per round
+            # (lightning_learner.py:180-198). keep_opt_state=True carries the
+            # Adam moments across rounds instead — the same documented
+            # improvement knob as SpmdFederation(keep_opt_state=True)
+            self.opt_state = self.tx.init(params)
 
     def get_parameters(self) -> Pytree:
         return self.params

@@ -37,6 +37,31 @@ class _ColorFormatter(logging.Formatter):
         return super().format(record)
 
 
+class _WebLogHandler(logging.Handler):
+    """Ships every log line to the dashboard (reference logger.py:224-232).
+
+    Always placed behind a ``QueueListener`` so a slow/dead dashboard never
+    blocks the thread that logged (the reference decouples via a
+    multiprocessing queue; a thread-side queue is the right scope here —
+    nothing crosses process boundaries).
+    """
+
+    def __init__(self, web: Any) -> None:
+        super().__init__()
+        self._web = web
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            import time as _time
+
+            node = getattr(record, "node", "unknown")
+            self._web.send_log(
+                _time.strftime("%Y-%m-%d %H:%M:%S"), node, record.levelname, record.getMessage()
+            )
+        except Exception:  # noqa: BLE001 — dashboard failures never break training
+            pass
+
+
 class P2pflLogger:
     """Singleton. Use the module-level ``logger`` instance."""
 
@@ -65,9 +90,11 @@ class P2pflLogger:
         self._nodes: Dict[str, Tuple[Any, bool]] = {}
         self._nodes_lock = threading.Lock()
         # optional web dashboard (reference logger.py:264-300): when attached,
-        # metrics mirror to REST and a NodeMonitor runs per registered node
+        # log lines + metrics mirror to REST and a NodeMonitor runs per node
         self._web: Any = None
         self._monitors: Dict[str, Any] = {}
+        self._web_listener: Any = None
+        self._web_queue_handler: Optional[logging.Handler] = None
 
     # ---- setup ----
 
@@ -88,21 +115,36 @@ class P2pflLogger:
         """Attach a :class:`~p2pfl_tpu.management.web_services.WebServices`.
 
         Mirrors the reference's ``init_p2pfl_web_services``: subsequent
-        node registrations and metrics are pushed to the dashboard, and a
-        resource monitor starts per node (``logger.py:504-511``).
+        node registrations, log lines and metrics are pushed to the
+        dashboard, and a resource monitor starts per node (``logger.py:504-511``).
         """
+        import queue
+        from logging.handlers import QueueHandler, QueueListener
+
+        self.disconnect_web_services()
         self._web = web
+        q: "queue.SimpleQueue[logging.LogRecord]" = queue.SimpleQueue()
+        self._web_queue_handler = QueueHandler(q)
+        self._web_listener = QueueListener(q, _WebLogHandler(web))
+        self._web_listener.start()
+        self._logger.addHandler(self._web_queue_handler)
 
     def disconnect_web_services(self) -> None:
         for monitor in self._monitors.values():
             monitor.stop()
         self._monitors.clear()
+        if self._web_queue_handler is not None:
+            self._logger.removeHandler(self._web_queue_handler)
+            self._web_queue_handler = None
+        if self._web_listener is not None:
+            self._web_listener.stop()
+            self._web_listener = None
         self._web = None
 
     # ---- leveled logging, keyed by node addr ----
 
     def log(self, level: int, node: str, message: str) -> None:
-        self._logger.log(level, f"[{node}] {message}")
+        self._logger.log(level, f"[{node}] {message}", extra={"node": node})
 
     def debug(self, node: str, message: str) -> None:
         self.log(logging.DEBUG, node, message)

@@ -35,6 +35,50 @@ def annotate(name: str, step: Optional[int] = None) -> Iterator[None]:
         yield
 
 
+# bf16 peak matmul FLOP/s per chip by device kind (public spec sheets);
+# used to turn achieved FLOP/s into model-FLOPs-utilization
+_PEAK_FLOPS = {
+    "TPU v2": 45e12,
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def peak_flops(device=None) -> Optional[float]:
+    """Peak FLOP/s for a device (None when unknown, e.g. CPU)."""
+    device = device or jax.devices()[0]
+    kind = getattr(device, "device_kind", "")
+    for name, peak in _PEAK_FLOPS.items():
+        if kind.lower().startswith(name.lower()):
+            return peak
+    return None
+
+
+def compiled_flops(jitted, *args, **kwargs) -> Optional[float]:
+    """Total FLOPs of one execution, from the compiled XLA cost analysis."""
+    try:
+        cost = jitted.lower(*args, **kwargs).compile().cost_analysis()
+        if isinstance(cost, list):  # older jax returns one dict per device
+            cost = cost[0]
+        return float(cost["flops"])
+    except Exception:  # noqa: BLE001 — cost analysis is best-effort
+        return None
+
+
+def mfu(flops: Optional[float], seconds: float, n_devices: int = 1) -> Optional[float]:
+    """Model-FLOPs-utilization: achieved FLOP/s over aggregate peak FLOP/s."""
+    peak = peak_flops()
+    if flops is None or peak is None or seconds <= 0:
+        return None
+    return flops / seconds / (peak * n_devices)
+
+
 class Stopwatch:
     """Cheap wall-clock section timing (the reference's --measure_time,
     generalized): ``with sw.section("fit"): ...`` then ``sw.summary()``."""

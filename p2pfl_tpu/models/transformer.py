@@ -6,9 +6,15 @@ model to a few MB. Architecture follows the Llama recipe (RMSNorm → GQA
 attention with RoPE → SwiGLU), all matmuls in bfloat16 on the MXU, norms and
 softmax statistics in float32.
 
-Long context: set ``attn_impl="ring"`` and provide a mesh — attention runs
-as ring attention over the ``model`` mesh axis (``ops/attention.py``),
-sequence sharded across chips.
+Attention backends — pick with ``tiny_transformer(attn=...)``:
+
+- ``"dense"`` (default): fused XLA causal attention (``ops/attention.py``);
+- ``"flash"``: the Pallas flash kernel with its Pallas backward
+  (``ops/flash_attention.py``) — O(T·D) memory in both directions;
+- ``"ring"``: ring attention over a mesh axis (pass ``mesh=``) — the
+  sequence is sharded across chips, K/V rotate via ``ppermute``.
+
+Power users can instead pass any ``attn_fn(q, k, v) -> out`` directly.
 """
 
 from __future__ import annotations
@@ -161,14 +167,60 @@ class CausalLM(nn.Module):
         return logits.astype(jnp.float32)
 
 
+def resolve_attention(
+    attn: str,
+    mesh: Any = None,
+    axis_name: str = "model",
+    block: int = 128,
+) -> Optional[Callable]:
+    """Map an attention backend name to an ``(q, k, v) -> out`` callable."""
+    if attn == "dense":
+        return None  # Attention falls back to the fused causal path
+    if attn == "flash":
+        from p2pfl_tpu.ops.flash_attention import flash_attention
+
+        # Pallas runs natively on TPU; anywhere else use interpret mode
+        interpret = jax.default_backend() != "tpu"
+        return partial(
+            flash_attention, causal=True, block_q=block, block_k=block, interpret=interpret
+        )
+    if attn == "ring":
+        if mesh is None:
+            raise ValueError("attn='ring' needs a mesh (sequence is sharded over it)")
+        from p2pfl_tpu.ops.attention import ring_attention
+
+        return partial(ring_attention, mesh=mesh, axis_name=axis_name)
+    raise ValueError(f"unknown attention backend {attn!r} (dense|flash|ring)")
+
+
 def tiny_transformer(
     seq_len: int = 128,
     seed: int = 0,
     cfg: Optional[TransformerConfig] = None,
     attn_fn: Optional[Callable] = None,
+    attn: str = "dense",
+    mesh: Any = None,
 ) -> FlaxModel:
-    """A small LoRA-ready causal LM bound to concrete params."""
+    """A small LoRA-ready causal LM bound to concrete params.
+
+    ``attn`` selects the attention backend (``"dense" | "flash" | "ring"``);
+    ``attn_fn`` overrides it with an explicit callable.
+    """
     cfg = cfg or TransformerConfig()
+    if attn_fn is None:
+        if seq_len <= 128:
+            block = seq_len  # block == T always satisfies the TPU tiling rule
+        else:
+            # blocks must divide T and (on TPU Mosaic) be a multiple of 8
+            block = next(
+                (b for b in range(128, 7, -1) if seq_len % b == 0 and b % 8 == 0), None
+            )
+            if block is None and attn == "flash":
+                raise ValueError(
+                    f"attn='flash' needs seq_len with a divisor <=128 that is a "
+                    f"multiple of 8; seq_len={seq_len} has none (use attn='dense')"
+                )
+        attn_fn = resolve_attention(attn, mesh=mesh, block=block)
     module = CausalLM(cfg, attn_fn)
     rng = jax.random.PRNGKey(seed)
     dummy = jnp.zeros((1, seq_len), dtype=jnp.int32)

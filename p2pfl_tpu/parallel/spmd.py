@@ -52,8 +52,14 @@ Pytree = Any
 # ---- pure round program (module-level => one jit cache for all federations) ----
 
 
-def _local_epoch(params, opt_state, xs, ys, module, tx):
-    """One node's epoch: scan of SGD steps (identical math to JaxLearner)."""
+def _local_epoch(params, opt_state, xs, ys, module, tx, remat: bool = False):
+    """One node's epoch: scan of SGD steps (identical math to JaxLearner).
+
+    ``remat=True`` wraps the loss in :func:`jax.checkpoint`: the backward
+    pass recomputes activations instead of the scan storing every batch's —
+    the HBM↔FLOPs trade that lets big models (ResNet-50 × many nodes) train
+    on one chip.
+    """
     import optax
 
     def step(carry, batch):
@@ -64,6 +70,8 @@ def _local_epoch(params, opt_state, xs, ys, module, tx):
             logits = module.apply({"params": p_}, x)
             return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
 
+        if remat:
+            loss_fn = jax.checkpoint(loss_fn)
         loss, grads = jax.value_and_grad(loss_fn)(p)
         updates, o = tx.update(grads, o, p)
         p = optax.apply_updates(p, updates)
@@ -73,8 +81,17 @@ def _local_epoch(params, opt_state, xs, ys, module, tx):
     return params, opt_state, jnp.mean(losses)
 
 
-def _aggregate(p_used, mask, weights, agg: str, trim: int):
-    """Combine node-stacked params [N, ...] into one model (fp32 accumulate)."""
+def _aggregate(p_used, mask, weights, sel_idx, agg: str, trim: int):
+    """Combine node-stacked params [N, ...] into one model (fp32 accumulate).
+
+    ``sel_idx`` is the [K] array of train-set ∩ active node indices
+    (host-computed, K static per trace). The robust aggregators operate on
+    the gathered [K, ...] stack only — non-elected / dropped slots hold
+    stale copies of the previous aggregate and would otherwise dominate the
+    coordinate-wise median and win Krum's distance score, silently freezing
+    training (mirrors host Node mode, where robust aggregators only ever
+    see train-set models).
+    """
     from p2pfl_tpu.ops import aggregation as ops
 
     if agg == "fedavg":
@@ -84,30 +101,35 @@ def _aggregate(p_used, mask, weights, agg: str, trim: int):
             lambda x: jnp.tensordot(wn, x.astype(jnp.float32), axes=(0, 0)).astype(x.dtype),
             p_used,
         )
+    k = sel_idx.shape[0]
+    p_sel = jax.tree.map(lambda x: jnp.take(x, sel_idx, axis=0), p_used)
     if agg == "median":
         return jax.tree.map(
-            lambda x: jnp.median(x.astype(jnp.float32), axis=0).astype(x.dtype), p_used
+            lambda x: jnp.median(x.astype(jnp.float32), axis=0).astype(x.dtype), p_sel
         )
     if agg == "trimmed_mean":
+        # clamp like the host-side TrimmedMean class: 2*trim must leave >=1 row
+        t = min(trim, (k - 1) // 2)
+
         def tm(x):
             xs = jnp.sort(x.astype(jnp.float32), axis=0)
-            kept = jax.lax.slice_in_dim(xs, trim, x.shape[0] - trim, axis=0)
+            kept = jax.lax.slice_in_dim(xs, t, k - t, axis=0)
             return jnp.mean(kept, axis=0).astype(x.dtype)
 
-        return jax.tree.map(tm, p_used)
+        return jax.tree.map(tm, p_sel)
     if agg == "krum":
-        idx = ops.krum_select(p_used, n_byzantine=trim, multi=1)
+        idx = ops.krum_select(p_sel, n_byzantine=trim, multi=1)
 
         def pick(x):
             return jnp.take(x, idx, axis=0).astype(jnp.float32).mean(axis=0).astype(x.dtype)
 
-        return jax.tree.map(pick, p_used)
+        return jax.tree.map(pick, p_sel)
     raise ValueError(f"unknown aggregator {agg}")
 
 
 @partial(
     jax.jit,
-    static_argnames=("module", "tx", "agg", "trim", "out_sharding", "keep_opt_state"),
+    static_argnames=("module", "tx", "agg", "trim", "out_sharding", "keep_opt_state", "remat"),
     donate_argnums=(0, 1),
 )
 def spmd_round(
@@ -118,6 +140,7 @@ def spmd_round(
     perm,  # [N, epochs, nb, bs] int32 shuffle indices (host-generated)
     mask,  # [N] 1.0 = in train set
     weights,  # [N] sample counts
+    sel_idx,  # [K] int32 indices of mask==1 rows (robust aggregation support)
     *,
     module,
     tx,
@@ -125,6 +148,7 @@ def spmd_round(
     trim: int = 0,
     out_sharding=None,
     keep_opt_state: bool = False,
+    remat: bool = False,
     x_test=None,
     y_test=None,
 ):
@@ -142,7 +166,7 @@ def spmd_round(
             p, o = carry
             xs = jnp.take(x, ep_idx, axis=0)  # [nb, bs, ...]
             ys = jnp.take(y, ep_idx, axis=0)
-            p, o, loss = _local_epoch(p, o, xs, ys, module, tx)
+            p, o, loss = _local_epoch(p, o, xs, ys, module, tx, remat)
             return (p, o), loss
 
         (params, opt_state), losses = jax.lax.scan(epoch_body, (params, opt_state), idx)
@@ -156,7 +180,7 @@ def spmd_round(
         return new * m + old * (1 - m)
 
     p_used = jax.tree.map(sel, trained_p, stacked_params)
-    agg_params = _aggregate(p_used, mask, weights, agg, trim)
+    agg_params = _aggregate(p_used, mask, weights, sel_idx, agg, trim)
 
     # diffusion: every node receives the aggregate
     out_params = jax.tree.map(lambda a: jnp.broadcast_to(a[None], (n, *a.shape)), agg_params)
@@ -226,6 +250,7 @@ class SpmdFederation:
         trim: int = 0,
         vote: bool = True,
         keep_opt_state: bool = False,
+        remat: bool = False,
         participation: float = 1.0,
         seed: int = 0,
     ) -> None:
@@ -240,6 +265,7 @@ class SpmdFederation:
         self.aggregator = aggregator
         self.trim = trim
         self.keep_opt_state = keep_opt_state
+        self.remat = remat
         if not 0.0 < participation <= 1.0:
             raise ValueError("participation must be in (0, 1]")
         self.participation = participation
@@ -305,15 +331,28 @@ class SpmdFederation:
         return federation_mesh(n_nodes=slots, devices=devices[:slots])
 
     def _stage_data(self) -> None:
-        tr_min = min(d.num_samples for d in self.datasets)
+        # node shards are padded (wrap-around) to a common static length so
+        # they stack into one [N, S, ...] array, but each node's per-round
+        # shuffle indices are drawn from its OWN sample range (``_make_perm``)
+        # — so the FedAvg sample-count weights match the data each node
+        # actually trains on (over rounds, every node covers its full shard)
+        sizes = [d.num_samples for d in self.datasets]
+        tr_min, tr_max = min(sizes), max(sizes)
         te_min = min(len(d.y_test) for d in self.datasets)
         if tr_min < self.batch_size:
             raise ValueError(f"smallest shard ({tr_min}) < batch size ({self.batch_size})")
+
+        def wrap(a: np.ndarray, target: int) -> np.ndarray:
+            if len(a) == target:
+                return a
+            reps = -(-target // len(a))
+            return np.concatenate([a] * reps, axis=0)[:target]
+
         self.x_all = jax.device_put(
-            np.stack([d.x_train[:tr_min] for d in self.datasets]), self._shard
+            np.stack([wrap(d.x_train, tr_max) for d in self.datasets]), self._shard
         )
         self.y_all = jax.device_put(
-            np.stack([d.y_train[:tr_min] for d in self.datasets]), self._shard
+            np.stack([wrap(d.y_train, tr_max) for d in self.datasets]), self._shard
         )
         self.x_test = jax.device_put(
             np.stack([d.x_test[:te_min] for d in self.datasets]), self._shard
@@ -322,9 +361,10 @@ class SpmdFederation:
             np.stack([d.y_test[:te_min] for d in self.datasets]), self._shard
         )
         self._samples = jax.device_put(
-            jnp.asarray([float(d.num_samples) for d in self.datasets]), self._shard
+            jnp.asarray([float(s) for s in sizes]), self._shard
         )
-        self._tr_size = tr_min
+        self._sizes = sizes
+        self._tr_size = tr_max
         self._nb = tr_min // self.batch_size
 
     # ---- election (host control plane — reference vote semantics) ----
@@ -348,17 +388,18 @@ class SpmdFederation:
     # ---- round driver ----
 
     def _make_perm(self, epochs: int):
+        take = self._nb * self.batch_size  # always <= min shard size
         perm = np.stack(
             [
                 np.stack(
                     [
-                        self._rng.permutation(self._tr_size)[: self._nb * self.batch_size].reshape(
+                        self._rng.permutation(self._sizes[i])[:take].reshape(
                             self._nb, self.batch_size
                         )
                         for _ in range(epochs)
                     ]
                 )
-                for _ in range(self.n)
+                for i in range(self.n)
             ]
         ).astype(np.int32)
         return jax.device_put(perm, self._shard)
@@ -390,7 +431,11 @@ class SpmdFederation:
         if self._vote and (self.round == 0 or Settings.VOTE_EVERY_ROUND):
             self.train_mask = self.elect_train_set()
         perm = self._make_perm(epochs)
-        mask = jax.device_put(jnp.asarray(self._effective_mask()), self._shard)
+        eff = self._effective_mask()
+        mask = jax.device_put(jnp.asarray(eff), self._shard)
+        # robust aggregators see only the [K] selected rows; K is static per
+        # mask pattern, so the executable is reused as long as K is stable
+        sel_idx = jax.device_put(np.flatnonzero(eff).astype(np.int32), self._repl)
         result = spmd_round(
             self.params,
             self.opt_state,
@@ -399,12 +444,14 @@ class SpmdFederation:
             perm,
             mask,
             self._samples,
+            sel_idx,
             module=self.module,
             tx=self.tx,
             agg=self.aggregator,
             trim=self.trim,
             out_sharding=self._shard,
             keep_opt_state=self.keep_opt_state,
+            remat=self.remat,
             x_test=self.x_test if eval else None,
             y_test=self.y_test if eval else None,
         )
@@ -424,6 +471,27 @@ class SpmdFederation:
             if eval_every and (r + 1) % eval_every == 0:
                 entry.update(self.evaluate())
         return self.history
+
+    def round_flops(self, epochs: int = 1) -> Optional[float]:
+        """Compiled FLOPs of one no-eval round (XLA cost analysis).
+
+        Used by the benchmarks for MFU; returns None when the backend
+        exposes no cost analysis.
+        """
+        from p2pfl_tpu.management.profiling import compiled_flops
+
+        perm = self._make_perm(epochs)
+        eff = self._effective_mask()
+        mask = jax.device_put(jnp.asarray(eff), self._shard)
+        sel_idx = jax.device_put(np.flatnonzero(eff).astype(np.int32), self._repl)
+        return compiled_flops(
+            spmd_round,
+            self.params, self.opt_state, self.x_all, self.y_all, perm, mask,
+            self._samples, sel_idx,
+            module=self.module, tx=self.tx, agg=self.aggregator, trim=self.trim,
+            out_sharding=self._shard, keep_opt_state=self.keep_opt_state,
+            remat=self.remat,
+        )
 
     def evaluate(self) -> dict:
         loss, acc = spmd_eval(self.params, self.x_test, self.y_test, module=self.module)

@@ -30,7 +30,7 @@ Pytree = Any
 
 @partial(
     jax.jit,
-    static_argnames=("module", "tx", "agg", "trim", "out_sharding", "keep_opt_state"),
+    static_argnames=("module", "tx", "agg", "trim", "out_sharding", "keep_opt_state", "remat"),
     donate_argnums=(0, 1),
 )
 def spmd_lora_round(
@@ -42,6 +42,7 @@ def spmd_lora_round(
     perm,  # [N, epochs, nb, bs]
     mask,  # [N]
     weights,  # [N]
+    sel_idx,  # [K] int32 indices of mask==1 rows
     *,
     module,
     tx,
@@ -49,6 +50,7 @@ def spmd_lora_round(
     trim: int = 0,
     out_sharding=None,
     keep_opt_state: bool = False,
+    remat: bool = False,
 ):
     import optax
 
@@ -63,8 +65,16 @@ def spmd_lora_round(
             def step(c, batch):
                 lo_, o_ = c
                 bx, by = batch
-                (loss, _), grads = jax.value_and_grad(_lm_loss, has_aux=True)(
-                    lo_, base, module, bx, by
+
+                def loss_of(lo__, bx_, by_):
+                    return _lm_loss(lo__, base, module, bx_, by_)
+
+                if remat:
+                    # recompute transformer activations in the backward
+                    # instead of the scan storing every batch's (HBM↔FLOPs)
+                    loss_of = jax.checkpoint(loss_of)
+                (loss, _), grads = jax.value_and_grad(loss_of, has_aux=True)(
+                    lo_, bx, by
                 )
                 updates, o_ = tx.update(grads, o_, lo_)
                 lo_ = optax.apply_updates(lo_, updates)
@@ -85,7 +95,7 @@ def spmd_lora_round(
         return new * m + old * (1 - m)
 
     used = jax.tree.map(sel, trained, stacked_lora)
-    agg_lora = _aggregate(used, mask, weights, agg, trim)
+    agg_lora = _aggregate(used, mask, weights, sel_idx, agg, trim)
     out = jax.tree.map(lambda a: jnp.broadcast_to(a[None], (n, *a.shape)), agg_lora)
     if out_sharding is not None:
         out = jax.tree.map(lambda a: jax.lax.with_sharding_constraint(a, out_sharding), out)
@@ -149,7 +159,9 @@ class SpmdLoraFederation(SpmdFederation):
         if self._vote and (self.round == 0 or Settings.VOTE_EVERY_ROUND):
             self.train_mask = self.elect_train_set()
         perm = self._make_perm(epochs)
-        mask = jax.device_put(jnp.asarray(self._effective_mask()), self._shard)
+        eff = self._effective_mask()
+        mask = jax.device_put(jnp.asarray(eff), self._shard)
+        sel_idx = jax.device_put(np.flatnonzero(eff).astype(np.int32), self._repl)
         self.params, self.opt_state, loss = spmd_lora_round(
             self.params,
             self.opt_state,
@@ -159,12 +171,14 @@ class SpmdLoraFederation(SpmdFederation):
             perm,
             mask,
             self._samples,
+            sel_idx,
             module=self.module,
             tx=self.tx,
             agg=self.aggregator,
             trim=self.trim,
             out_sharding=self._shard,
             keep_opt_state=self.keep_opt_state,
+            remat=self.remat,
         )
         self.round += 1
         entry = {"round": self.round, "train_loss": loss}

@@ -118,9 +118,12 @@ class VoteTrainSetStage(Stage):
             return None
 
         # tally with deterministic tie-break (votes desc, then name desc —
-        # reference :152-155) so every node elects the same set
+        # reference :152-155) so every node elects the same set; consume the
+        # votes atomically (reference resets to {} at :160) so a later
+        # election never tallies this round's stale entries
         with state.train_set_votes_lock:
             all_votes = {v: dict(w) for v, w in state.train_set_votes.items()}
+            state.train_set_votes.clear()
         results: dict[str, int] = {}
         for votes in all_votes.values():
             for n, w in votes.items():

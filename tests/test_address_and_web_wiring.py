@@ -66,7 +66,8 @@ def test_logger_web_wiring():
         logger.register_node("web-node-1", simulation=True)
         logger.log_metric("web-node-1", "acc", 0.9, round=1, experiment="e1")
         logger.log_metric("web-node-1", "loss", 1.0, step=3, round=1, experiment="e1")
-        time.sleep(0.3)  # let the monitor tick
+        logger.info("web-node-1", "hello dashboard")
+        time.sleep(0.3)  # let the monitor tick + the async log queue drain
         logger.unregister_node("web-node-1")
         paths = [p for p, _ in received]
         assert "/node" in paths
@@ -74,6 +75,12 @@ def test_logger_web_wiring():
         assert "/node-metric/local" in paths
         assert "/node-metric/system" in paths  # monitor samples
         assert "/node-stop" in paths
+        # every log line ships to the dashboard (reference logger.py:224-232),
+        # asynchronously via the queue listener
+        logs = [b for p, b in received if p == "/node-log"]
+        assert any(
+            b["address"] == "web-node-1" and "hello dashboard" in b["message"] for b in logs
+        )
     finally:
         logger.disconnect_web_services()
         srv.shutdown()

@@ -55,16 +55,55 @@ def test_flash_gradient_matches_dense():
 
 
 def test_flash_in_transformer():
-    """Wire the kernel in as the model's attention implementation."""
-    from functools import partial
-
+    """The attn="flash" selector wires the kernel into the model."""
     from p2pfl_tpu.models.transformer import TransformerConfig, tiny_transformer
 
     cfg = TransformerConfig(vocab_size=64, dim=32, n_layers=1, n_heads=2, n_kv_heads=2, ffn_hidden=64)
-    attn = partial(flash_attention, causal=True, block_q=16, block_k=16, interpret=True)
-    m_flash = tiny_transformer(seq_len=32, cfg=cfg, attn_fn=attn, seed=4)
+    m_flash = tiny_transformer(seq_len=32, cfg=cfg, attn="flash", seed=4)
     m_dense = tiny_transformer(seq_len=32, cfg=cfg, seed=4)
     toks = (jnp.arange(32, dtype=jnp.int32) % 64)[None]
     a = m_flash.apply(m_flash.params, toks)
     b = m_dense.apply(m_dense.params, toks)
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-2)
+
+
+def test_flash_transformer_training_grads_match_dense():
+    """Training the transformer with flash attention: full LM-loss gradients
+    match the dense model's (pattern of test_ring_training.py)."""
+    import optax
+
+    from p2pfl_tpu.models.transformer import TransformerConfig, tiny_transformer
+
+    cfg = TransformerConfig(
+        vocab_size=64, dim=32, n_layers=2, n_heads=2, n_kv_heads=2, ffn_hidden=64,
+        dtype=jnp.float32,
+    )
+    seq = 32
+    m_flash = tiny_transformer(seq_len=seq, cfg=cfg, attn="flash", seed=9)
+    m_dense = tiny_transformer(seq_len=seq, cfg=cfg, seed=9)
+
+    def loss_fn(model):
+        def loss(params, x, y):
+            logits = model.module.apply({"params": params}, x)
+            return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+        return loss
+
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(2, seq)), jnp.int32)
+    y = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(2, seq)), jnp.int32)
+    g_flash = jax.grad(loss_fn(m_flash))(m_flash.params, x, y)
+    g_dense = jax.grad(loss_fn(m_dense))(m_dense.params, x, y)
+    for a, b in zip(jax.tree.leaves(g_flash), jax.tree.leaves(g_dense)):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32), atol=5e-3
+        )
+
+
+def test_flash_resolver_rejects_unknown():
+    from p2pfl_tpu.models.transformer import resolve_attention
+
+    with pytest.raises(ValueError):
+        resolve_attention("nope")
+    with pytest.raises(ValueError):
+        resolve_attention("ring")  # needs a mesh

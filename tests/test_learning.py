@@ -173,6 +173,40 @@ def test_waiting_mode_first_update_wins():
     assert tree_allclose(out.params, params_like(3.0), atol=1e-6)
 
 
+def test_waiting_mode_rejects_partial_coverage():
+    """While waiting, only a full-train-set aggregate is acceptable
+    (reference aggregator.py:139-146) — a stray single-model partial must
+    not become the node's "aggregated model" (poisoning hole)."""
+    agg = FedAvg("n0")
+    agg.set_waiting_aggregated_model(["a", "b"])
+    assert agg.add_model(ModelUpdate(params_like(9.0), ["a"])) == []
+    assert agg.add_model(ModelUpdate(params_like(3.0), ["a", "b"], num_samples=2)) == ["a", "b"]
+    out = agg.wait_and_get_aggregation(timeout=1)
+    assert tree_allclose(out.params, params_like(3.0), atol=1e-6)
+
+
+def test_jax_learner_keep_opt_state():
+    """Node-mode twin of SpmdFederation(keep_opt_state=True): Adam moments
+    survive set_parameters instead of being reset each round."""
+    from p2pfl_tpu.learning.dataset import FederatedDataset
+    from p2pfl_tpu.learning.learner import JaxLearner
+    from p2pfl_tpu.models import mlp
+
+    data = FederatedDataset.synthetic_mnist(n_train=256, n_test=64)
+
+    keeper = JaxLearner(mlp(), data, epochs=1, batch_size=64, keep_opt_state=True)
+    keeper.fit()
+    trained_opt = keeper.opt_state
+    keeper.set_parameters(keeper.get_parameters())
+    assert keeper.opt_state is trained_opt  # moments carried across the round
+
+    resetter = JaxLearner(mlp(), data, epochs=1, batch_size=64)
+    resetter.fit()
+    resetter.set_parameters(resetter.get_parameters())
+    mu = jax.tree.leaves(resetter.opt_state[0].mu)
+    assert all(float(jnp.abs(m).max()) == 0.0 for m in mu)  # reference reset
+
+
 def test_robust_aggregator_rejects_partials():
     agg = FedMedian("n0")
     agg.set_nodes_to_aggregate(["a", "b", "c"])

@@ -75,6 +75,49 @@ def test_convergence_four_nodes_line_with_training():
     _stop_all(nodes)
 
 
+def test_eight_node_training_improves_accuracy_memory():
+    """8-node gossip federation, epochs=1: accuracy must actually improve,
+    not just end equal (VERDICT r1 #10) — over the in-memory transport."""
+    nodes = []
+    for i in range(8):
+        learner = JaxLearner(mlp(seed=i), _data(i, 8, n_train=4096, n_test=1024), batch_size=64)
+        node = Node(learner=learner)
+        node.start()
+        nodes.append(node)
+    for n in nodes:
+        full_connection(n, nodes)
+    wait_convergence(nodes, 7, only_direct=True)
+    before = nodes[0].learner.evaluate()["test_acc"]
+    nodes[0].set_start_learning(rounds=2, epochs=1)
+    wait_to_finish(nodes, timeout=240)
+    check_equal_models(nodes)
+    after = nodes[0].learner.evaluate()["test_acc"]
+    assert after > before and after > 0.85, (before, after)
+    _stop_all(nodes)
+
+
+def test_eight_node_training_improves_accuracy_grpc():
+    """Same as above over real gRPC sockets (wire-encoded weights)."""
+    from p2pfl_tpu.communication.grpc_transport import GrpcProtocol
+
+    nodes = []
+    for i in range(8):
+        learner = JaxLearner(mlp(seed=i), _data(i, 8, n_train=4096, n_test=1024), batch_size=64)
+        node = Node(learner=learner, protocol=GrpcProtocol("127.0.0.1:0"))
+        node.start()
+        nodes.append(node)
+    for n in nodes:
+        full_connection(n, nodes)
+    wait_convergence(nodes, 7, only_direct=True)
+    before = nodes[0].learner.evaluate()["test_acc"]
+    nodes[0].set_start_learning(rounds=2, epochs=1)
+    wait_to_finish(nodes, timeout=240)
+    check_equal_models(nodes)
+    after = nodes[0].learner.evaluate()["test_acc"]
+    assert after > before and after > 0.85, (before, after)
+    _stop_all(nodes)
+
+
 def test_dummy_learner_federation():
     """FSM correctness without ML: dummy learners converge to one value."""
     nodes = [Node(learner=DummyLearner(value=float(i))) for i in range(3)]

@@ -87,6 +87,51 @@ def test_spmd_robust_aggregators_resist_byzantine(agg):
     assert acc > 0.5  # fedavg would collapse to ~0.1 here
 
 
+def test_spmd_robust_agg_with_partial_mask_trains():
+    """Regression (ADVICE r1 high): with TRAIN_SET_SIZE < N, robust
+    aggregators must see elected rows only — stale non-elected copies
+    would otherwise dominate the coordinate-wise median and freeze training."""
+    from p2pfl_tpu.settings import Settings
+
+    Settings.TRAIN_SET_SIZE = 4
+    fed = SpmdFederation.from_dataset(
+        mlp(), _dataset(), n_nodes=8, batch_size=64, vote=True, aggregator="median"
+    )
+    before = [np.asarray(x, np.float32) for x in jax.tree.leaves(fed.node_params(0))]
+    fed.run(rounds=3)
+    after = [np.asarray(x, np.float32) for x in jax.tree.leaves(fed.node_params(0))]
+    delta = max(float(np.max(np.abs(a - b))) for a, b in zip(before, after))
+    assert delta > 0.0, "aggregate never moved — robust agg saw stale slots"
+    assert fed.evaluate()["test_acc"] > 0.5
+
+
+def test_spmd_trimmed_mean_trim_clamped():
+    """Regression (ADVICE r1): 2*trim >= K must clamp, not produce NaN params."""
+    fed = SpmdFederation.from_dataset(
+        mlp(), _dataset(), n_nodes=4, batch_size=64, vote=False,
+        aggregator="trimmed_mean", trim=3,
+    )
+    fed.run_round()
+    assert all(np.isfinite(np.asarray(x, np.float32)).all() for x in jax.tree.leaves(fed.params))
+
+
+def test_spmd_unequal_shards_sample_weighting():
+    """Regression (ADVICE r1): unequal shards shuffle over their OWN sample
+    range (not the truncated min), so FedAvg's sample-count weights match the
+    data each node actually trains on."""
+    data = _dataset()
+    shards = [data.partition(i, 4, strategy="dirichlet", alpha=0.3) for i in range(4)]
+    sizes = [s.num_samples for s in shards]
+    assert len(set(sizes)) > 1, "dirichlet partition should produce unequal shards"
+    fed = SpmdFederation(mlp(), shards, batch_size=16, vote=False)
+    assert fed._tr_size == max(sizes)
+    perm = np.asarray(jax.device_get(fed._make_perm(epochs=1)))
+    for i, size in enumerate(sizes):
+        assert perm[i].max() < size  # indices stay inside the node's own shard
+    fed.run(rounds=2)
+    assert fed.evaluate()["test_acc"] > 0.5
+
+
 def test_spmd_matches_node_mode_fedavg():
     """SPMD round == Node-mode round semantics: FedAvg of locally-trained models.
 
