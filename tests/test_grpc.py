@@ -133,7 +133,11 @@ def test_two_process_grpc_demo():
     port = s.getsockname()[1]
     s.close()
 
+    # the image's sitecustomize claims the real TPU chip in EVERY python
+    # process when PALLAS_AXON_POOL_IPS is set; two children fighting over
+    # the one chip abort with a C++ exception — scrub it so they run CPU-only
     env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     p1 = subprocess.Popen(
         [sys.executable, "-m", "p2pfl_tpu.examples.node1", str(port), "--n_train", "512"],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
