@@ -1,0 +1,44 @@
+# Container packaging for p2pfl_tpu (reference parity: /root/reference/Dockerfile:1).
+#
+# Two build modes:
+#   docker build -t p2pfl-tpu .                           # CPU (jax[cpu]) — simulation / CI
+#   docker build -t p2pfl-tpu --build-arg JAX_EXTRA=tpu . # Cloud TPU VM (libtpu via jax[tpu])
+#
+# The virtual multi-node simulation needs no accelerator:
+#   docker run -e JAX_PLATFORMS=cpu \
+#     -e XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+#     p2pfl-tpu python -m pytest tests/ -q
+
+FROM python:3.11-slim
+
+ARG JAX_EXTRA=cpu
+
+ENV PYTHONUNBUFFERED=1 \
+    PIP_DISABLE_PIP_VERSION_CHECK=on \
+    PIP_DEFAULT_TIMEOUT=100
+
+# g++ builds the optional native codec (p2pfl_tpu/native/codec.cpp);
+# everything degrades to the numpy fallback without it.
+RUN apt-get update && apt-get install -y --no-install-recommends g++ \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+COPY pyproject.toml README.md ./
+COPY p2pfl_tpu ./p2pfl_tpu
+COPY tests ./tests
+COPY bench.py bench_suite.py ./
+
+RUN pip install "jax[${JAX_EXTRA}]" && \
+    pip install -e ".[grpc,checkpoint,monitor,test]"
+
+# Pre-build the native codec so first use doesn't pay the compile
+# (quantize() builds the .so on first call when g++ is present). Drop any
+# host-built .so first — one compiled against the host's arch/glibc would
+# fail to dlopen here but its presence suppresses the rebuild.
+RUN rm -f p2pfl_tpu/native/*.so && \
+    python -c "import numpy as np; from p2pfl_tpu import native; \
+native.quantize(np.zeros(8, np.float32)); \
+assert native._load() is not None, 'native codec failed to build'; \
+print('native codec ready')"
+
+CMD ["python", "-m", "p2pfl_tpu.cli", "experiment", "list"]
