@@ -33,7 +33,7 @@ import optax
 from p2pfl_tpu.learning.dataset import FederatedDataset
 from p2pfl_tpu.learning.weights import ModelUpdate, decode_params, restore_like
 from p2pfl_tpu.management.logger import logger
-from p2pfl_tpu.models.base import FlaxModel
+from p2pfl_tpu.models.base import FlaxModel, apply_with_aux
 
 Pytree = Any
 
@@ -91,8 +91,10 @@ def adam(lr: float = 1e-3) -> optax.GradientTransformation:
 
 
 def _loss(params, module, x, y):
-    logits = module.apply({"params": params}, x)
-    return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean(), logits
+    """Training loss: CE + any sown auxiliary losses (MoE router balance)."""
+    logits, aux = apply_with_aux(module, params, x)
+    ce = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+    return ce + aux, logits
 
 
 @partial(jax.jit, static_argnames=("module", "tx"), donate_argnums=(1,))
@@ -117,7 +119,10 @@ def train_epoch(params, opt_state, xs, ys, module, tx):
 
 @partial(jax.jit, static_argnames=("module",))
 def eval_step(params, x, y, module):
-    loss, logits = _loss(params, module, x, y)
+    # pure CE, no aux regularizers: reported test_loss stays comparable
+    # across MoE/dense models and across node/SPMD modes
+    logits = module.apply({"params": params}, x)
+    loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
     acc = jnp.mean((jnp.argmax(logits, axis=-1) == y).astype(jnp.float32))
     return loss, acc
 

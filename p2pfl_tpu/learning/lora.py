@@ -23,7 +23,7 @@ import optax
 from p2pfl_tpu.learning.dataset import FederatedDataset
 from p2pfl_tpu.learning.learner import NodeLearner, adam
 from p2pfl_tpu.management.logger import logger
-from p2pfl_tpu.models.base import FlaxModel
+from p2pfl_tpu.models.base import FlaxModel, apply_with_aux
 
 Pytree = Any
 
@@ -61,9 +61,11 @@ def merge_params(base: dict, overlay: dict) -> dict:
 
 
 def _lm_loss(lora, base, module, x, y):
+    """Training loss: CE + any sown auxiliary losses (MoE router balance)."""
     params = merge_params(base, lora)
-    logits = module.apply({"params": params}, x)
-    return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean(), logits
+    logits, aux = apply_with_aux(module, params, x)
+    ce = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+    return ce + aux, logits
 
 
 @partial(jax.jit, static_argnames=("module", "tx"), donate_argnums=(1,))
@@ -84,7 +86,9 @@ def lora_train_epoch(lora, opt_state, base, xs, ys, module, tx):
 
 @partial(jax.jit, static_argnames=("module",))
 def lora_eval(lora, base, x, y, module):
-    loss, logits = _lm_loss(lora, base, module, x, y)
+    # pure CE (no aux regularizers) so test_loss is comparable everywhere
+    logits = module.apply({"params": merge_params(base, lora)}, x)
+    loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
     acc = jnp.mean((jnp.argmax(logits, axis=-1) == y).astype(jnp.float32))
     return loss, acc
 

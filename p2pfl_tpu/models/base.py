@@ -18,6 +18,21 @@ import jax.numpy as jnp
 Pytree = Any
 
 
+def apply_with_aux(module: Any, params: Pytree, x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Apply + total auxiliary loss sown into the ``"moe_losses"`` collection.
+
+    MoE layers sow their load-balance/z-loss scalars there
+    (``models/transformer.py:MoEMLP``); training losses must include the
+    sum or the router never learns to balance. For models without sown
+    losses the collection is empty and the aux term is 0 — the extra
+    ``mutable`` plumbing is free under jit.
+    """
+    out, mut = module.apply({"params": params}, x, mutable=["moe_losses"])
+    leaves = jax.tree.leaves(mut)
+    aux = sum(leaves) if leaves else jnp.zeros((), jnp.float32)
+    return out, aux
+
+
 @dataclass
 class FlaxModel:
     """A flax module bound to a concrete parameter pytree."""
@@ -43,6 +58,9 @@ class FlaxModel:
 
     def apply(self, params: Pytree, x: jax.Array) -> jax.Array:
         return self.module.apply({"params": params}, x)
+
+    def apply_with_aux(self, params: Pytree, x: jax.Array) -> tuple[jax.Array, jax.Array]:
+        return apply_with_aux(self.module, params, x)
 
     @property
     def param_count(self) -> int:

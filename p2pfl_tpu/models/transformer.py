@@ -44,6 +44,14 @@ class TransformerConfig:
     lora_alpha: float = 16.0
     lora_mlp: bool = False
     dtype: Any = jnp.bfloat16
+    # Mixture-of-experts FFN (n_experts=0 => dense SwiGLU everywhere).
+    # Experts stack on a leading [E, ...] axis that shards over the mesh's
+    # model axis for expert parallelism (parallel/sharding.py EP rules).
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity: float = 1.25  # capacity factor: C = ceil(k*S/E * factor)
+    moe_aux_coef: float = 1e-2  # Switch load-balance loss coefficient
+    moe_zloss_coef: float = 1e-3  # router z-loss coefficient
 
 
 class RMSNorm(nn.Module):
@@ -136,6 +144,96 @@ class MLP(nn.Module):
         return dense(cfg.dim, name="w2")(nn.silu(gate) * up)
 
 
+class MoEMLP(nn.Module):
+    """Mixture-of-experts SwiGLU FFN with capacity-based dense dispatch.
+
+    The GShard/Switch formulation: routing becomes two einsums against a
+    [S, E, C] dispatch tensor, so the whole layer is MXU matmuls with
+    static shapes — no gather/scatter, no dynamic shapes, nothing XLA
+    can't tile. Expert weights stack on a leading [E, ...] axis; sharding
+    that axis over the ``model`` mesh axis is expert parallelism (XLA
+    turns the dispatch/combine einsums into the token all-to-alls).
+
+    Tokens beyond an expert's capacity ``C = ceil(k·S/E · capacity)`` are
+    dropped (their combine weight is zero — the residual stream carries
+    them unchanged, the standard Switch behavior).
+
+    Two auxiliary scalars are sown into the ``"moe_losses"`` collection
+    (read back via :func:`p2pfl_tpu.models.base.apply_with_aux`):
+    the Switch load-balance loss ``E · Σ_e f_e · p̄_e`` and the router
+    z-loss ``mean(logsumexp(logits)²)``.
+
+    The reference has no MoE anywhere (its models are MLP/CNN,
+    SURVEY §2.7) — this extends the transformer family for the
+    expert-parallel axis of the multi-chip design.
+    """
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        e, k = cfg.n_experts, cfg.moe_top_k
+        b, t, d = x.shape
+        s = b * t
+        f = cfg.ffn_hidden
+        xs = x.reshape(s, d)
+
+        router = self.param("router", nn.initializers.normal(0.02), (d, e))
+        logits = jnp.dot(xs.astype(jnp.float32), router.astype(jnp.float32))  # [S, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+
+        capacity = max(1, int(-(-k * s // e) * cfg.moe_capacity))
+
+        # iterative top-k dispatch with a running per-expert fill count
+        combine = jnp.zeros((s, e, capacity), jnp.float32)
+        counts = jnp.zeros((e,), jnp.float32)
+        p = probs
+        top1_onehot = None
+        for _ in range(k):
+            idx = jnp.argmax(p, axis=-1)  # [S]
+            onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)  # [S, E]
+            if top1_onehot is None:
+                top1_onehot = onehot
+            gate = jnp.sum(p * onehot, axis=-1)  # [S]
+            # position of each token within its chosen expert's buffer
+            pos = jnp.cumsum(onehot, axis=0) - onehot + counts[None, :]  # [S, E]
+            pos_in_e = jnp.sum(pos * onehot, axis=-1)  # [S]
+            keep = (pos_in_e < capacity).astype(jnp.float32)
+            slot = jax.nn.one_hot(jnp.minimum(pos_in_e, capacity - 1), capacity,
+                                  dtype=jnp.float32)  # [S, C]
+            combine = combine + (gate * keep)[:, None, None] * onehot[:, :, None] * slot[:, None, :]
+            counts = counts + jnp.sum(onehot, axis=0)
+            p = p * (1.0 - onehot)  # mask the chosen expert for the next pass
+
+        # renormalize the selected gates so each routed token's weights sum to 1
+        total = jnp.sum(combine, axis=(1, 2), keepdims=True)
+        combine = combine / jnp.maximum(total, 1e-9)
+        dispatch = (combine > 0.0).astype(cfg.dtype)  # [S, E, C]
+
+        w1 = self.param("w1", nn.initializers.lecun_normal(), (e, d, f))
+        w3 = self.param("w3", nn.initializers.lecun_normal(), (e, d, f))
+        w2 = self.param("w2", nn.initializers.lecun_normal(), (e, f, d))
+
+        xe = jnp.einsum("sec,sd->ecd", dispatch, xs.astype(cfg.dtype))  # [E, C, D]
+        gate_h = jnp.einsum("ecd,edf->ecf", xe, w1.astype(cfg.dtype))
+        up_h = jnp.einsum("ecd,edf->ecf", xe, w3.astype(cfg.dtype))
+        ye = jnp.einsum("ecf,efd->ecd", nn.silu(gate_h) * up_h, w2.astype(cfg.dtype))
+        out = jnp.einsum("sec,ecd->sd", combine.astype(cfg.dtype), ye)  # [S, D]
+
+        # Switch load-balance loss: E · Σ_e (top-1 token fraction · mean prob)
+        frac = jnp.mean(top1_onehot, axis=0)  # [E]
+        mean_p = jnp.mean(probs, axis=0)  # [E]
+        balance = e * jnp.sum(frac * mean_p)
+        zloss = jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2)
+        self.sow(
+            "moe_losses",
+            "aux",
+            cfg.moe_aux_coef * balance + cfg.moe_zloss_coef * zloss,
+        )
+        return out.reshape(b, t, d)
+
+
 class Block(nn.Module):
     cfg: TransformerConfig
     attn_fn: Optional[Callable] = None
@@ -145,7 +243,8 @@ class Block(nn.Module):
         x = x + Attention(self.cfg, self.attn_fn, name="attn")(
             RMSNorm(self.cfg.dtype, name="attn_norm")(x)
         )
-        x = x + MLP(self.cfg, name="mlp")(RMSNorm(self.cfg.dtype, name="mlp_norm")(x))
+        ffn = MoEMLP if self.cfg.n_experts > 0 else MLP
+        x = x + ffn(self.cfg, name="mlp")(RMSNorm(self.cfg.dtype, name="mlp_norm")(x))
         return x
 
 

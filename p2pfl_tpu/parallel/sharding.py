@@ -35,6 +35,11 @@ _RULES: list[tuple[str, tuple]] = [
     (r"attn/wo/kernel", ("model", None)),  # row-parallel
     (r"mlp/(w1|w3)/kernel", (None, "model")),  # column-parallel
     (r"mlp/w2/kernel", ("model", None)),  # row-parallel
+    # expert parallelism: MoE expert stacks [E, ...] shard the expert axis;
+    # XLA turns the dispatch/combine einsums into token all-to-alls.
+    # Router replicates (every chip routes its own tokens).
+    (r"mlp/router$", ()),
+    (r"mlp/w[123]$", ("model", None, None)),
     (r"embed", ("model", None)),  # vocab-sharded embeddings
 ]
 

@@ -42,7 +42,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from p2pfl_tpu.learning.dataset import FederatedDataset
-from p2pfl_tpu.learning.learner import adam
+from p2pfl_tpu.learning.learner import _loss, adam
 from p2pfl_tpu.models.base import FlaxModel
 from p2pfl_tpu.settings import Settings
 
@@ -67,8 +67,7 @@ def _local_epoch(params, opt_state, xs, ys, module, tx, remat: bool = False):
         x, y = batch
 
         def loss_fn(p_):
-            logits = module.apply({"params": p_}, x)
-            return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+            return _loss(p_, module, x, y)[0]  # CE + sown aux (canonical definition)
 
         if remat:
             loss_fn = jax.checkpoint(loss_fn)
