@@ -117,12 +117,17 @@ def train_epoch(params, opt_state, xs, ys, module, tx):
     return params, opt_state, jnp.mean(losses)
 
 
+def ce_eval(params, module, x, y):
+    """Pure-CE eval loss + logits — NO sown aux regularizers, so reported
+    test_loss stays comparable across MoE/dense models and across
+    node/SPMD/LoRA modes. Every eval path funnels through this."""
+    logits = module.apply({"params": params}, x)
+    return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean(), logits
+
+
 @partial(jax.jit, static_argnames=("module",))
 def eval_step(params, x, y, module):
-    # pure CE, no aux regularizers: reported test_loss stays comparable
-    # across MoE/dense models and across node/SPMD modes
-    logits = module.apply({"params": params}, x)
-    loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+    loss, logits = ce_eval(params, module, x, y)
     acc = jnp.mean((jnp.argmax(logits, axis=-1) == y).astype(jnp.float32))
     return loss, acc
 

@@ -21,7 +21,7 @@ import numpy as np
 import optax
 
 from p2pfl_tpu.learning.dataset import FederatedDataset
-from p2pfl_tpu.learning.learner import NodeLearner, adam
+from p2pfl_tpu.learning.learner import NodeLearner, adam, ce_eval
 from p2pfl_tpu.management.logger import logger
 from p2pfl_tpu.models.base import FlaxModel, apply_with_aux
 
@@ -86,9 +86,7 @@ def lora_train_epoch(lora, opt_state, base, xs, ys, module, tx):
 
 @partial(jax.jit, static_argnames=("module",))
 def lora_eval(lora, base, x, y, module):
-    # pure CE (no aux regularizers) so test_loss is comparable everywhere
-    logits = module.apply({"params": merge_params(base, lora)}, x)
-    loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+    loss, logits = ce_eval(merge_params(base, lora), module, x, y)
     acc = jnp.mean((jnp.argmax(logits, axis=-1) == y).astype(jnp.float32))
     return loss, acc
 

@@ -200,8 +200,11 @@ class MoEMLP(nn.Module):
             pos = jnp.cumsum(onehot, axis=0) - onehot + counts[None, :]  # [S, E]
             pos_in_e = jnp.sum(pos * onehot, axis=-1)  # [S]
             keep = (pos_in_e < capacity).astype(jnp.float32)
-            slot = jax.nn.one_hot(jnp.minimum(pos_in_e, capacity - 1), capacity,
-                                  dtype=jnp.float32)  # [S, C]
+            slot = jax.nn.one_hot(
+                jnp.minimum(pos_in_e, capacity - 1).astype(jnp.int32),
+                capacity,
+                dtype=jnp.float32,
+            )  # [S, C]
             combine = combine + (gate * keep)[:, None, None] * onehot[:, :, None] * slot[:, None, :]
             counts = counts + jnp.sum(onehot, axis=0)
             p = p * (1.0 - onehot)  # mask the chosen expert for the next pass

@@ -11,9 +11,23 @@ boundary inside a round.
 """
 
 from p2pfl_tpu.parallel.mesh import federation_mesh
+from p2pfl_tpu.parallel.pipeline import (
+    pipeline_apply,
+    pipeline_mesh,
+    pipelined_lm_apply,
+    stack_layers,
+)
 from p2pfl_tpu.parallel.spmd import SpmdFederation
 
-__all__ = ["SpmdFederation", "SpmdLoraFederation", "federation_mesh"]
+__all__ = [
+    "SpmdFederation",
+    "SpmdLoraFederation",
+    "federation_mesh",
+    "pipeline_apply",
+    "pipeline_mesh",
+    "pipelined_lm_apply",
+    "stack_layers",
+]
 
 
 def __getattr__(name):

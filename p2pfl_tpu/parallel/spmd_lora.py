@@ -20,7 +20,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from p2pfl_tpu.learning.dataset import FederatedDataset
-from p2pfl_tpu.learning.learner import adam
+from p2pfl_tpu.learning.learner import adam, ce_eval
 from p2pfl_tpu.learning.lora import lora_train_epoch as _node_lora_epoch  # noqa: F401 (shared math)
 from p2pfl_tpu.learning.lora import _lm_loss, merge_params, split_lora
 from p2pfl_tpu.models.base import FlaxModel
@@ -111,9 +111,7 @@ def spmd_lora_round(
 @partial(jax.jit, static_argnames=("module",))
 def spmd_lora_eval(stacked_lora, base, x_test, y_test, *, module):
     def node_eval(lora, x, y):
-        # pure CE (no sown aux regularizers): matches lora_eval/eval_step
-        logits = module.apply({"params": merge_params(base, lora)}, x)
-        loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+        loss, logits = ce_eval(merge_params(base, lora), module, x, y)
         acc = jnp.mean((jnp.argmax(logits, axis=-1) == y).astype(jnp.float32))
         return loss, acc
 
