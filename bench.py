@@ -37,6 +37,7 @@ N_NODES = 64
 TARGET_ACC = 0.98
 TARGET_SECONDS = 60.0
 MAX_ROUNDS = 30
+CHUNK = 5  # rounds per fused dispatch (train + eval curve on device)
 BATCH = 64
 # Gaussian-mixture difficulty (measured: ~12 rounds to 98% at this setting)
 HARD_TASK = {"modes": 8, "noise": 0.7, "proto_scale": 0.5}
@@ -63,44 +64,51 @@ def main() -> None:
     )
 
     # compile warm-up, then reset state in place (same mesh → same
-    # executables; round 1 and rounds ≥2 have different input layouts and
-    # therefore separate executables, so warm both)
+    # executables). Both fused variants (eval curve + steady state) and the
+    # single-round program are warmed; a D2H fetch is the only thing that
+    # truly forces execution on some remote-attached platforms.
     t0 = time.monotonic()
-    # a D2H fetch is the only thing that truly forces execution on some
-    # remote-attached platforms (block_until_ready can return early), so
-    # materialize each warm round's accuracy
-    float(fed.run_round(eval=True)["test_acc"])
-    float(fed.run_round(eval=True)["test_acc"])
-    fed.run_round(epochs=1)  # also warm the no-eval variant (steady-state loop)
+    [float(e["test_acc"]) for e in fed.run_fused(CHUNK, epochs=1, eval=True)]
+    fed.run_fused(CHUNK, epochs=1)  # steady-state variant
     float(fed.evaluate()["test_acc"])
-    log(f"warm-up (compile, 3 rounds): {time.monotonic() - t0:.1f}s")
+    log(f"warm-up (compile, {2 * CHUNK} rounds): {time.monotonic() - t0:.1f}s")
     t0 = time.monotonic()
     fed.reset(seed=3)
     jax.block_until_ready(jax.tree.leaves(fed.params)[0])
     log(f"reset: {time.monotonic() - t0:.2f}s")
+
+    # convergence: fused chunks of CHUNK rounds, the whole chunk (train +
+    # per-round eval of the aggregated model) is ONE dispatch; the accuracy
+    # curve syncs once per chunk instead of once per round
     t0 = time.monotonic()
     elapsed = float("nan")
     acc = 0.0
     curve = []
-    for r in range(MAX_ROUNDS):
-        entry = fed.run_round(epochs=1, eval=True)  # eval fused into the round
-        acc = float(entry["test_acc"])
-        curve.append(round(acc, 4))
+    while len(curve) < MAX_ROUNDS:
+        entries = fed.run_fused(CHUNK, epochs=1, eval=True)
+        accs = [float(e["test_acc"]) for e in entries]
         elapsed = time.monotonic() - t0
-        log(f"round {r + 1}: acc={acc:.4f} elapsed={elapsed:.2f}s")
-        if acc >= TARGET_ACC:
+        curve.extend(round(a, 4) for a in accs)
+        log(f"rounds {len(curve) - CHUNK + 1}-{len(curve)}: acc={accs} elapsed={elapsed:.2f}s")
+        if max(accs) >= TARGET_ACC:
+            acc = max(accs)
             break
+        acc = accs[-1]
 
     if acc < TARGET_ACC:
         # did not reach target: report elapsed at best acc, flagged by value
         log(f"target {TARGET_ACC} not reached (best {acc:.4f})")
+    rounds_to_target = next(
+        (i + 1 for i, a in enumerate(curve) if a >= TARGET_ACC), len(curve)
+    )
 
-    # steady-state throughput: 5 more rounds, pipelined (no per-round sync)
+    # steady-state throughput: one more fused span, no eval (CHUNK-shaped —
+    # the only fused programs warm-up compiled; any other span length would
+    # put a fresh XLA compile inside the timer)
     t1 = time.monotonic()
-    for _ in range(5):
-        fed.run_round(epochs=1)
+    fed.run_fused(CHUNK, epochs=1)
     jax.block_until_ready(jax.tree.leaves(fed.params)[0])
-    sec_per_round = (time.monotonic() - t1) / 5
+    sec_per_round = (time.monotonic() - t1) / CHUNK
 
     # MFU of the steady-state round (train only, no eval)
     flops = fed.round_flops()
@@ -114,7 +122,7 @@ def main() -> None:
                 "unit": "s",
                 "vs_baseline": round(TARGET_SECONDS / elapsed, 3) if np.isfinite(elapsed) else 0.0,
                 "reached_acc": round(acc, 4),
-                "rounds_to_target": len(curve),
+                "rounds_to_target": rounds_to_target,
                 "accuracy_curve": curve,
                 "sec_per_round": round(sec_per_round, 4),
                 "flops_per_round": flops,

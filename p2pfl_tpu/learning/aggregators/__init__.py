@@ -3,7 +3,18 @@
 from p2pfl_tpu.learning.aggregators.aggregator import Aggregator
 from p2pfl_tpu.learning.aggregators.fedavg import FedAvg
 from p2pfl_tpu.learning.aggregators.fedmedian import FedMedian
+from p2pfl_tpu.learning.aggregators.fedopt import FedAdagrad, FedAdam, FedOpt, FedYogi
 from p2pfl_tpu.learning.aggregators.krum import Krum
 from p2pfl_tpu.learning.aggregators.trimmed_mean import TrimmedMean
 
-__all__ = ["Aggregator", "FedAvg", "FedMedian", "Krum", "TrimmedMean"]
+__all__ = [
+    "Aggregator",
+    "FedAdagrad",
+    "FedAdam",
+    "FedAvg",
+    "FedMedian",
+    "FedOpt",
+    "FedYogi",
+    "Krum",
+    "TrimmedMean",
+]

@@ -37,6 +37,10 @@ class Aggregator:
     #: False for strategies (Krum, median, ...) that need the individual
     #: models and therefore must not be fed pre-averaged partials.
     SUPPORTS_PARTIALS: bool = True
+    #: True for stateful strategies (FedOpt) whose :meth:`aggregate` must run
+    #: exactly once per round even when a single update covers the train set
+    #: (the single-model shortcut would skip the server step).
+    ALWAYS_AGGREGATE: bool = False
 
     def __init__(self, node_name: str = "unknown") -> None:
         self.node_name = node_name
@@ -164,6 +168,7 @@ class Aggregator:
         with self._lock:
             models = list(self._models.values())
             train = set(self._train_set)
+            waiting = self._waiting
             # close the collection window: late updates for this round are
             # rejected and the next set_nodes_to_aggregate() will not raise
             self._complete.set()
@@ -175,9 +180,22 @@ class Aggregator:
                 self.node_name,
                 f"Aggregation timeout — proceeding with partial coverage {sorted(covered)} of {sorted(train)}",
             )
-        if len(models) == 1:
-            return models[0]
+        # a single model is returned as-is when (a) this node is waiting,
+        # (b) the strategy is stateless, or (c) it is a full multi-node
+        # aggregate a faster train-set peer diffused (already
+        # server-stepped — re-aggregating would double-step); on_result
+        # lets stateful strategies resync to the consensus model
+        if len(models) == 1 and (
+            waiting or not self.ALWAYS_AGGREGATE or len(models[0].contributors) > 1
+        ):
+            return self.on_result(models[0])
         return self.aggregate(models)
+
+    def on_result(self, update: ModelUpdate) -> ModelUpdate:
+        """Hook: the round resolved to ``update`` WITHOUT this node running
+        :meth:`aggregate` (waiting mode, or a peer's finished aggregate
+        arrived first). Stateful strategies resync their server state here."""
+        return update
 
     def get_partial_aggregation(self, except_nodes: list[str]) -> Optional[ModelUpdate]:
         """Aggregate collected models not already covered by ``except_nodes``.

@@ -57,12 +57,23 @@ def restore_learner(directory: str, learner, step: Optional[int] = None) -> None
     learner.opt_state = state["opt_state"]
 
 
+def _federation_state(fed) -> dict:
+    """Everything a resumed federation needs: params + opt state + any
+    algorithm state (SCAFFOLD control variates, FedOpt server moments) —
+    dropping those on resume would silently degrade the algorithm."""
+    state = {"params": fed.params, "opt_state": fed.opt_state}
+    if getattr(fed, "scaffold", False):
+        state["c_global"] = fed.c_global
+        state["c_local"] = fed.c_local
+    if getattr(fed, "server_opt", ""):
+        state["opt_m"] = fed.opt_m
+        state["opt_v"] = fed.opt_v
+        state["server_t"] = fed._server_t
+    return state
+
+
 def save_federation(directory: str, fed) -> None:
-    save_state(
-        directory,
-        {"params": fed.params, "opt_state": fed.opt_state},
-        step=fed.round,
-    )
+    save_state(directory, _federation_state(fed), step=fed.round)
 
 
 def restore_federation(directory: str, fed, step: Optional[int] = None) -> None:
@@ -70,9 +81,14 @@ def restore_federation(directory: str, fed, step: Optional[int] = None) -> None:
         use = mgr.latest_step() if step is None else step
         if use is None:
             raise FileNotFoundError(f"no checkpoint under {directory}")
-        state = mgr.restore(
-            use, args=ocp.args.StandardRestore({"params": fed.params, "opt_state": fed.opt_state})
-        )
+        state = mgr.restore(use, args=ocp.args.StandardRestore(_federation_state(fed)))
     fed.params = state["params"]
     fed.opt_state = state["opt_state"]
+    if getattr(fed, "scaffold", False):
+        fed.c_global = state["c_global"]
+        fed.c_local = state["c_local"]
+    if getattr(fed, "server_opt", ""):
+        fed.opt_m = state["opt_m"]
+        fed.opt_v = state["opt_v"]
+        fed._server_t = int(state["server_t"])
     fed.round = use

@@ -90,6 +90,12 @@ def adam(lr: float = 1e-3) -> optax.GradientTransformation:
     return optax.adam(lr)
 
 
+@lru_cache(maxsize=None)
+def sgd(lr: float = 1e-3) -> optax.GradientTransformation:
+    """Cached like :func:`adam`. SCAFFOLD's variate update assumes SGD."""
+    return optax.sgd(lr)
+
+
 def _loss(params, module, x, y):
     """Training loss: CE + any sown auxiliary losses (MoE router balance)."""
     logits, aux = apply_with_aux(module, params, x)
@@ -97,18 +103,41 @@ def _loss(params, module, x, y):
     return ce + aux, logits
 
 
-@partial(jax.jit, static_argnames=("module", "tx"), donate_argnums=(1,))
-def train_epoch(params, opt_state, xs, ys, module, tx):
+def _prox_term(params, anchor, mu: float):
+    """FedProx penalty μ/2·‖w − anchor‖² — shared by node and SPMD modes so
+    their local-step math cannot desynchronize."""
+    sq = sum(
+        jnp.sum((a.astype(jnp.float32) - b.astype(jnp.float32)) ** 2)
+        for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(anchor))
+    )
+    return 0.5 * mu * sq
+
+
+@partial(jax.jit, static_argnames=("module", "tx", "prox_mu"), donate_argnums=(1,))
+def train_epoch(params, opt_state, xs, ys, module, tx, prox_mu: float = 0.0, anchor=None):
     """One full epoch: scan of SGD steps over [nb, bs, ...] batches.
 
     ``params`` is NOT donated: with the zero-copy in-memory transport other
     nodes' aggregators may hold references to these exact buffers.
+
+    ``prox_mu > 0`` adds the FedProx proximal term μ/2·‖w − anchor‖²
+    (Li et al. 2020) pulling local steps toward the round's global model
+    (``anchor``; defaults to the params this epoch starts from).
     """
+    if prox_mu > 0.0 and anchor is None:
+        anchor = params
 
     def step(carry, batch):
         p, o = carry
         x, y = batch
-        (loss, _), grads = jax.value_and_grad(_loss, has_aux=True)(p, module, x, y)
+
+        def full_loss(p_):
+            loss, logits = _loss(p_, module, x, y)
+            if prox_mu > 0.0:
+                loss = loss + _prox_term(p_, anchor, prox_mu)
+            return loss, logits
+
+        (loss, _), grads = jax.value_and_grad(full_loss, has_aux=True)(p)
         updates, o = tx.update(grads, o, p)
         p = optax.apply_updates(p, updates)
         return (p, o), loss
@@ -145,6 +174,7 @@ class JaxLearner(NodeLearner):
         learning_rate: float = 1e-3,
         seed: int = 0,
         keep_opt_state: bool = False,
+        prox_mu: float = 0.0,
     ) -> None:
         self.model = model
         self.data = data
@@ -153,6 +183,9 @@ class JaxLearner(NodeLearner):
         self.batch_size = batch_size
         self.tx = adam(learning_rate)
         self.keep_opt_state = keep_opt_state
+        # FedProx (Li et al. 2020): μ > 0 adds a proximal pull toward the
+        # round's incoming global model during local steps
+        self.prox_mu = float(prox_mu)
         self.params: Pytree = model.params
         self.opt_state = self.tx.init(self.params)
         self._rng = np.random.default_rng(seed)
@@ -187,13 +220,15 @@ class JaxLearner(NodeLearner):
         self._interrupt.clear()
         if self.epochs == 0:
             return  # test mode, like the reference's epochs=0 CI runs
+        anchor = self.params if self.prox_mu > 0.0 else None  # round's global
         for _ in range(self.epochs):
             if self._interrupt.is_set():
                 logger.info(self.addr, "Training interrupted")
                 return
             xs, ys = self.data.epoch_batches(self.batch_size, self._rng)
             self.params, self.opt_state, loss = train_epoch(
-                self.params, self.opt_state, jnp.asarray(xs), jnp.asarray(ys), self.model.module, self.tx
+                self.params, self.opt_state, jnp.asarray(xs), jnp.asarray(ys),
+                self.model.module, self.tx, prox_mu=self.prox_mu, anchor=anchor,
             )
             self._steps_done += xs.shape[0]
             logger.log_metric(self.addr, "train_loss", float(loss), step=self._steps_done)

@@ -93,3 +93,54 @@ def krum(stacked: Pytree, n_byzantine: int, multi: int = 1) -> Pytree:
         return jnp.mean(sel, axis=0).astype(x.dtype)
 
     return jax.tree.map(pick, stacked)
+
+
+@partial(jax.jit, static_argnames=("opt", "lr", "b1", "b2", "tau"))
+def fedopt_update(
+    prev: Pytree,
+    avg: Pytree,
+    m: Pytree,
+    v: Pytree,
+    t: jax.Array,
+    opt: str = "adam",
+    lr: float = 0.1,
+    b1: float = 0.9,
+    b2: float = 0.99,
+    tau: float = 1e-3,
+) -> tuple[Pytree, Pytree, Pytree]:
+    """FedOpt server step (Reddi et al. 2021): treat ``prev - avg`` as a
+    pseudo-gradient and apply a server-side adaptive optimizer to it.
+
+    ``opt``: ``"adam"`` (FedAdam), ``"yogi"`` (FedYogi) or ``"adagrad"``
+    (FedAdagrad). ``m``/``v`` are the server's first/second-moment pytrees;
+    ``t`` is the 1-based server step for Adam bias correction. Returns
+    ``(new_params, new_m, new_v)`` — one fused elementwise XLA program.
+    """
+
+    def one(p, a, mi, vi):
+        g = p.astype("float32") - a.astype("float32")  # pseudo-grad
+        mn = b1 * mi + (1.0 - b1) * g
+        g2 = g * g
+        if opt == "adam":
+            vn = b2 * vi + (1.0 - b2) * g2
+        elif opt == "yogi":
+            vn = vi - (1.0 - b2) * g2 * jnp.sign(vi - g2)
+        elif opt == "adagrad":
+            vn = vi + g2
+        else:
+            raise ValueError(f"unknown server opt {opt!r}")
+        if opt == "adam":
+            mhat = mn / (1.0 - b1 ** t)
+            vhat = vn / (1.0 - b2 ** t)
+        else:
+            mhat, vhat = mn, vn
+        new = p.astype("float32") - lr * mhat / (jnp.sqrt(vhat) + tau)
+        return new.astype(p.dtype), mn, vn
+
+    flat_p, tdef = jax.tree.flatten(prev)
+    flat_a = jax.tree.leaves(avg)
+    flat_m = jax.tree.leaves(m)
+    flat_v = jax.tree.leaves(v)
+    out = [one(p, a, mi, vi) for p, a, mi, vi in zip(flat_p, flat_a, flat_m, flat_v)]
+    news, ms, vs = zip(*out)
+    return tdef.unflatten(news), tdef.unflatten(ms), tdef.unflatten(vs)

@@ -42,7 +42,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from p2pfl_tpu.learning.dataset import FederatedDataset
-from p2pfl_tpu.learning.learner import _loss, adam
+from p2pfl_tpu.learning.learner import _loss, _prox_term, adam, sgd
 from p2pfl_tpu.models.base import FlaxModel
 from p2pfl_tpu.settings import Settings
 
@@ -52,13 +52,20 @@ Pytree = Any
 # ---- pure round program (module-level => one jit cache for all federations) ----
 
 
-def _local_epoch(params, opt_state, xs, ys, module, tx, remat: bool = False):
+def _local_epoch(
+    params, opt_state, xs, ys, module, tx, remat: bool = False,
+    prox_mu: float = 0.0, anchor=None, corr=None,
+):
     """One node's epoch: scan of SGD steps (identical math to JaxLearner).
 
     ``remat=True`` wraps the loss in :func:`jax.checkpoint`: the backward
     pass recomputes activations instead of the scan storing every batch's —
     the HBM↔FLOPs trade that lets big models (ResNet-50 × many nodes) train
     on one chip.
+
+    ``prox_mu``/``anchor``: FedProx proximal pull toward the round's global
+    model. ``corr``: SCAFFOLD control-variate correction ``c − c_i`` added
+    to every step's gradient.
     """
     import optax
 
@@ -67,11 +74,16 @@ def _local_epoch(params, opt_state, xs, ys, module, tx, remat: bool = False):
         x, y = batch
 
         def loss_fn(p_):
-            return _loss(p_, module, x, y)[0]  # CE + sown aux (canonical definition)
+            loss = _loss(p_, module, x, y)[0]  # CE + sown aux (canonical definition)
+            if prox_mu > 0.0:
+                loss = loss + _prox_term(p_, anchor, prox_mu)
+            return loss
 
         if remat:
             loss_fn = jax.checkpoint(loss_fn)
         loss, grads = jax.value_and_grad(loss_fn)(p)
+        if corr is not None:
+            grads = jax.tree.map(lambda g, c: g + c.astype(g.dtype), grads, corr)
         updates, o = tx.update(grads, o, p)
         p = optax.apply_updates(p, updates)
         return (p, o), loss
@@ -126,12 +138,7 @@ def _aggregate(p_used, mask, weights, sel_idx, agg: str, trim: int):
     raise ValueError(f"unknown aggregator {agg}")
 
 
-@partial(
-    jax.jit,
-    static_argnames=("module", "tx", "agg", "trim", "out_sharding", "keep_opt_state", "remat"),
-    donate_argnums=(0, 1),
-)
-def spmd_round(
+def _round_core(
     stacked_params,  # [N, ...] pytree
     opt_states,  # [N, ...] pytree
     x_all,  # [N, S, ...] node-resident datasets
@@ -148,30 +155,68 @@ def spmd_round(
     out_sharding=None,
     keep_opt_state: bool = False,
     remat: bool = False,
-    x_test=None,
-    y_test=None,
+    prox_mu: float = 0.0,
+    scaffold: bool = False,
+    local_lr: float = 1e-3,
+    c_global=None,  # SCAFFOLD server control variate (replicated pytree)
+    c_local=None,  # SCAFFOLD per-node control variates [N, ...]
+    server_opt: str = "",  # FedOpt: "adam" | "yogi" | "adagrad" ("" = plain)
+    server_lr: float = 0.1,
+    opt_m=None,  # FedOpt server first/second moments (replicated pytrees)
+    opt_v=None,
+    opt_t=None,  # FedOpt server step count (scalar, 1-based)
 ):
-    """One federated round for all N nodes.
+    """One federated round's device program (train → aggregate → diffuse).
 
-    Returns (params', opt', mean loss[, test acc]) — the accuracy of the
-    aggregated model is fused into the same program when test data is given
-    (one device dispatch for train + aggregate + diffuse + eval).
+    Pure trace-time function shared by :func:`spmd_round` (one jitted round)
+    and :func:`spmd_rounds_fused` (many rounds in one dispatch). Returns
+    ``(out_params, out_opt, mean_loss, scaffold_state, fedopt_state,
+    agg_params)`` where the two state tuples are ``()`` when the feature is
+    off. ``prox_mu`` enables FedProx; ``scaffold`` threads SCAFFOLD control
+    variates through local steps (Karimireddy et al. 2020); ``server_opt``
+    applies a FedOpt server step to the aggregate (Reddi et al. 2021).
     """
     n = mask.shape[0]
 
     # gather per-epoch batches: idx [epochs, nb, bs] → x[idx] [epochs, nb, bs, ...]
-    def node_fn(params, opt_state, x, y, idx):
+    def node_fn(params, opt_state, x, y, idx, ci):
+        anchor = params if (prox_mu > 0.0 or scaffold) else None
+        corr = (
+            jax.tree.map(lambda c, cl: c - cl, c_global, ci) if scaffold else None
+        )
+
         def epoch_body(carry, ep_idx):
             p, o = carry
             xs = jnp.take(x, ep_idx, axis=0)  # [nb, bs, ...]
             ys = jnp.take(y, ep_idx, axis=0)
-            p, o, loss = _local_epoch(p, o, xs, ys, module, tx, remat)
+            p, o, loss = _local_epoch(
+                p, o, xs, ys, module, tx, remat,
+                prox_mu=prox_mu, anchor=anchor, corr=corr,
+            )
             return (p, o), loss
 
         (params, opt_state), losses = jax.lax.scan(epoch_body, (params, opt_state), idx)
-        return params, opt_state, jnp.mean(losses)
+        if scaffold:
+            # c_i⁺ = c_i − c + (x_global − y_i)/(K·η)  (SCAFFOLD option II)
+            k_steps = idx.shape[0] * idx.shape[1]
+            ci_new = jax.tree.map(
+                lambda cl, c, a, p: cl
+                - c
+                + (a.astype(jnp.float32) - p.astype(jnp.float32)) / (k_steps * local_lr),
+                ci, c_global, anchor, params,
+            )
+        else:
+            ci_new = ci
+        return params, opt_state, jnp.mean(losses), ci_new
 
-    trained_p, trained_o, losses = jax.vmap(node_fn)(stacked_params, opt_states, x_all, y_all, perm)
+    if scaffold:
+        trained_p, trained_o, losses, ci_new = jax.vmap(
+            node_fn, in_axes=(0, 0, 0, 0, 0, 0)
+        )(stacked_params, opt_states, x_all, y_all, perm, c_local)
+    else:
+        trained_p, trained_o, losses, _ = jax.vmap(
+            node_fn, in_axes=(0, 0, 0, 0, 0, None)
+        )(stacked_params, opt_states, x_all, y_all, perm, None)
 
     # non-train-set nodes contribute their previous params (they don't train)
     def sel(new, old):
@@ -180,6 +225,20 @@ def spmd_round(
 
     p_used = jax.tree.map(sel, trained_p, stacked_params)
     agg_params = _aggregate(p_used, mask, weights, sel_idx, agg, trim)
+
+    fedopt_state = ()
+    if server_opt:
+        # FedOpt server step on the pseudo-gradient prev_global − aggregate
+        # (node slot 0's incoming params ARE the previous global — diffusion
+        # left every slot identical)
+        from p2pfl_tpu.ops.aggregation import fedopt_update
+
+        prev_global = jax.tree.map(lambda x: x[0], stacked_params)
+        agg_params, opt_m_out, opt_v_out = fedopt_update(
+            prev_global, agg_params, opt_m, opt_v, opt_t,
+            opt=server_opt, lr=server_lr,
+        )
+        fedopt_state = (opt_m_out, opt_v_out)
 
     # diffusion: every node receives the aggregate
     out_params = jax.tree.map(lambda a: jnp.broadcast_to(a[None], (n, *a.shape)), agg_params)
@@ -203,15 +262,116 @@ def spmd_round(
             lambda a: jax.lax.with_sharding_constraint(a, out_sharding), out_opt
         )
     mean_loss = jnp.mean(losses, where=mask.astype(bool))
-    if x_test is None:
-        return out_params, out_opt, mean_loss
+
+    scaffold_state = ()
+    if scaffold:
+        # only train-set nodes commit their new control variates; the server
+        # variate moves by |S|/N times the mean train-set delta
+        def selc(new, old):
+            m_ = mask.reshape((n,) + (1,) * (new.ndim - 1)).astype(new.dtype)
+            return new * m_ + old * (1 - m_)
+
+        c_local_out = jax.tree.map(selc, ci_new, c_local)
+        n_train = jnp.maximum(jnp.sum(mask), 1.0)
+        frac = n_train / n
+
+        def upd(c, cn, co):
+            m_ = mask.reshape((n,) + (1,) * (cn.ndim - 1))
+            delta = jnp.sum((cn - co) * m_, axis=0) / n_train
+            return c + frac * delta
+
+        c_global_out = jax.tree.map(upd, c_global, ci_new, c_local)
+        if out_sharding is not None:
+            c_local_out = jax.tree.map(
+                lambda a: jax.lax.with_sharding_constraint(a, out_sharding), c_local_out
+            )
+        scaffold_state = (c_global_out, c_local_out)
+
+    return out_params, out_opt, mean_loss, scaffold_state, fedopt_state, agg_params
+
+
+def _agg_acc(module, agg_params, x_test, y_test):
+    """Mean accuracy of the aggregated model over node-stacked test shards."""
 
     def node_acc(x, y):
         logits = module.apply({"params": agg_params}, x)
         return jnp.mean((jnp.argmax(logits, axis=-1) == y).astype(jnp.float32))
 
-    acc = jnp.mean(jax.vmap(node_acc)(x_test, y_test))
-    return out_params, out_opt, mean_loss, acc
+    return jnp.mean(jax.vmap(node_acc)(x_test, y_test))
+
+
+_ROUND_STATICS = (
+    "module", "tx", "agg", "trim", "out_sharding", "keep_opt_state", "remat",
+    "prox_mu", "scaffold", "local_lr", "server_opt", "server_lr",
+)
+
+
+@partial(jax.jit, static_argnames=_ROUND_STATICS, donate_argnums=(0, 1))
+def spmd_round(
+    stacked_params, opt_states, x_all, y_all, perm, mask, weights, sel_idx,
+    *, x_test=None, y_test=None, **kw,
+):
+    """One federated round for all N nodes.
+
+    Returns (params', opt', mean loss[, c_global', c_local'][, opt_m',
+    opt_v'][, test acc]) — the accuracy of the aggregated model is fused
+    into the same program when test data is given (one device dispatch for
+    train + aggregate + diffuse + eval). See :func:`_round_core` for the
+    algorithm knobs.
+    """
+    out_params, out_opt, mean_loss, scaffold_state, fedopt_state, agg_params = _round_core(
+        stacked_params, opt_states, x_all, y_all, perm, mask, weights, sel_idx, **kw
+    )
+    if x_test is None:
+        return (out_params, out_opt, mean_loss, *scaffold_state, *fedopt_state)
+    acc = _agg_acc(kw["module"], agg_params, x_test, y_test)
+    return (out_params, out_opt, mean_loss, *scaffold_state, *fedopt_state, acc)
+
+
+@partial(jax.jit, static_argnames=_ROUND_STATICS, donate_argnums=(0, 1))
+def spmd_rounds_fused(
+    stacked_params, opt_states, x_all, y_all, perms, mask, weights, sel_idx,
+    *,
+    c_global=None, c_local=None, opt_m=None, opt_v=None, opt_t=None,
+    x_test=None, y_test=None, **kw,
+):
+    """R federated rounds as ONE device dispatch: ``lax.scan`` over rounds.
+
+    ``perms``: [R, N, epochs, nb, bs] per-round shuffle indices. The mask
+    (train set) is fixed for the whole span — exactly the reference's
+    round semantics, where voting happens only in round 0
+    (``round_finished_stage.py:69-70``). At small model scale a federated
+    round is dispatch-dominated; fusing R rounds amortizes the host↔device
+    round-trip R×. With test data, each round's aggregated model is
+    evaluated in-program → accs [R] (an on-device convergence curve).
+
+    Returns (params', opt', losses [R][, c_global', c_local'][, opt_m',
+    opt_v'][, accs [R]]).
+    """
+    scaffold = kw.get("scaffold", False)
+    server_opt = kw.get("server_opt", "")
+    if opt_t is None:
+        opt_t = jnp.float32(0.0)
+
+    def body(carry, perm):
+        p, o, cg, cl, m_, v_, t_ = carry
+        t_next = t_ + 1.0
+        out_p, out_o, loss, sstate, fstate, agg_params = _round_core(
+            p, o, x_all, y_all, perm, mask, weights, sel_idx,
+            c_global=cg, c_local=cl, opt_m=m_, opt_v=v_, opt_t=t_next, **kw,
+        )
+        cg, cl = sstate if scaffold else (cg, cl)
+        m_, v_ = fstate if server_opt else (m_, v_)
+        ys = (loss,) if x_test is None else (loss, _agg_acc(kw["module"], agg_params, x_test, y_test))
+        return (out_p, out_o, cg, cl, m_, v_, t_next), ys
+
+    carry0 = (stacked_params, opt_states, c_global, c_local, opt_m, opt_v, opt_t)
+    (p, o, cg, cl, m_, v_, _), ys = jax.lax.scan(body, carry0, perms)
+    scaffold_state = (cg, cl) if scaffold else ()
+    fedopt_state = (m_, v_) if server_opt else ()
+    if x_test is None:
+        return (p, o, ys[0], *scaffold_state, *fedopt_state)
+    return (p, o, ys[0], *scaffold_state, *fedopt_state, ys[1])
 
 
 @partial(jax.jit, static_argnames=("module",))
@@ -252,6 +412,11 @@ class SpmdFederation:
         remat: bool = False,
         participation: float = 1.0,
         seed: int = 0,
+        prox_mu: float = 0.0,
+        scaffold: bool = False,
+        optimizer: str = "adam",
+        server_opt: str = "",
+        server_lr: float = 0.1,
     ) -> None:
         self.model = model
         self.module = model.module
@@ -260,7 +425,20 @@ class SpmdFederation:
             raise ValueError("need at least one dataset shard")
         self.datasets = datasets
         self.batch_size = batch_size
-        self.tx = adam(learning_rate)
+        if scaffold and optimizer != "sgd":
+            # the (x − y_i)/(K·η) variate update assumes η-scaled SGD steps;
+            # adaptive local steps break the correction's variance-reduction
+            raise ValueError("scaffold=True requires optimizer='sgd'")
+        self.tx = sgd(learning_rate) if optimizer == "sgd" else adam(learning_rate)
+        self.learning_rate = learning_rate
+        # FedProx proximal strength (0 = plain FedAvg local steps)
+        self.prox_mu = float(prox_mu)
+        self.scaffold = scaffold
+        # FedOpt server optimizer ("" = plain aggregation result)
+        if server_opt and server_opt not in ("adam", "yogi", "adagrad"):
+            raise ValueError(f"unknown server_opt {server_opt!r}")
+        self.server_opt = server_opt
+        self.server_lr = server_lr
         self.aggregator = aggregator
         self.trim = trim
         self.keep_opt_state = keep_opt_state
@@ -319,6 +497,32 @@ class SpmdFederation:
             return stacked, jax.vmap(self.tx.init)(stacked)
 
         self.params, self.opt_state = stage(self.model.params)
+        self._server_t = 0  # FedOpt server step count (stays 0 without server_opt)
+        if self.scaffold:
+            # control variates start at zero (Karimireddy et al. 2020 §3);
+            # the global variate replicates on the MESH (a device-0-committed
+            # array would clash with the sharded args under jit)
+            self.c_global = jax.device_put(
+                jax.tree.map(
+                    lambda x: jnp.zeros(x.shape, jnp.float32), self.model.params
+                ),
+                self._repl,
+            )
+            self.c_local = jax.device_put(
+                jax.tree.map(
+                    lambda x: jnp.zeros((n, *x.shape), jnp.float32), self.model.params
+                ),
+                self._shard,
+            )
+        if self.server_opt:
+            zeros = jax.device_put(
+                jax.tree.map(
+                    lambda x: jnp.zeros(x.shape, jnp.float32), self.model.params
+                ),
+                self._repl,
+            )
+            self.opt_m = zeros
+            self.opt_v = jax.tree.map(jnp.copy, zeros)
 
     def _default_mesh(self) -> Mesh:
         from p2pfl_tpu.parallel.mesh import federation_mesh
@@ -386,9 +590,9 @@ class SpmdFederation:
 
     # ---- round driver ----
 
-    def _make_perm(self, epochs: int):
+    def _make_perm_np(self, epochs: int) -> np.ndarray:
         take = self._nb * self.batch_size  # always <= min shard size
-        perm = np.stack(
+        return np.stack(
             [
                 np.stack(
                     [
@@ -401,7 +605,9 @@ class SpmdFederation:
                 for i in range(self.n)
             ]
         ).astype(np.int32)
-        return jax.device_put(perm, self._shard)
+
+    def _make_perm(self, epochs: int):
+        return jax.device_put(self._make_perm_np(epochs), self._shard)
 
     def _effective_mask(self) -> np.ndarray:
         """Train-set ∩ active nodes, optionally client-sampled per round."""
@@ -425,6 +631,26 @@ class SpmdFederation:
 
     def restore_node(self, i: int) -> None:
         self.active_mask[i] = 1.0
+
+    def _algo_kwargs(self, opt_t: float) -> dict:
+        """The ``_round_core`` algorithm knobs — single source of truth for
+        run_round / run_fused / round_flops. A missed copy would silently
+        change the compiled program (e.g. MFU counting the wrong FLOPs).
+        ``opt_t`` is the FedOpt server step the program should use: the
+        1-based step for a single round, the 0-based starting counter for a
+        fused span (the scan body pre-increments)."""
+        return dict(
+            prox_mu=self.prox_mu,
+            scaffold=self.scaffold,
+            local_lr=self.learning_rate,
+            server_opt=self.server_opt,
+            server_lr=self.server_lr,
+            c_global=self.c_global if self.scaffold else None,
+            c_local=self.c_local if self.scaffold else None,
+            opt_m=self.opt_m if self.server_opt else None,
+            opt_v=self.opt_v if self.server_opt else None,
+            opt_t=jnp.float32(opt_t) if self.server_opt else None,
+        )
 
     def run_round(self, epochs: int = 1, eval: bool = False) -> dict:  # noqa: A002
         if self._vote and (self.round == 0 or Settings.VOTE_EVERY_ROUND):
@@ -453,14 +679,22 @@ class SpmdFederation:
             remat=self.remat,
             x_test=self.x_test if eval else None,
             y_test=self.y_test if eval else None,
+            **self._algo_kwargs(self._server_t + 1 if self.server_opt else 0),
         )
         self.params, self.opt_state, loss = result[:3]
+        i = 3
+        if self.scaffold:
+            self.c_global, self.c_local = result[i:i + 2]
+            i += 2
+        if self.server_opt:
+            self.opt_m, self.opt_v = result[i:i + 2]
+            self._server_t += 1
         self.round += 1
         # keep the loss as a device scalar: rounds pipeline back-to-back with
         # no host sync; it coerces to float lazily (e.g. when printed)
         entry = {"round": self.round, "train_loss": loss}
         if eval:
-            entry["test_acc"] = result[3]
+            entry["test_acc"] = result[-1]  # acc is last (scaffold adds outputs)
         self.history.append(entry)
         return entry
 
@@ -470,6 +704,60 @@ class SpmdFederation:
             if eval_every and (r + 1) % eval_every == 0:
                 entry.update(self.evaluate())
         return self.history
+
+    def run_fused(self, rounds: int, epochs: int = 1, eval: bool = False) -> list[dict]:  # noqa: A002
+        """Run ``rounds`` rounds as ONE device dispatch (``lax.scan``).
+
+        At small model scale a round is dispatch-dominated — fusing
+        amortizes the host↔device round-trip. The train set is fixed for
+        the span (the reference's own semantics: voting happens only in
+        round 0); per-round voting or client sampling needs
+        :meth:`run_round`. With ``eval=True`` the per-round accuracy curve
+        is computed on-device and returned in the history entries.
+        """
+        if self._vote and self.round == 0:
+            self.train_mask = self.elect_train_set()
+        if (self._vote and Settings.VOTE_EVERY_ROUND) or self.participation < 1.0:
+            raise ValueError(
+                "run_fused needs a fixed mask: per-round voting/client "
+                "sampling re-elects between rounds — use run_round"
+            )
+        perms = jax.device_put(
+            np.stack([self._make_perm_np(epochs) for _ in range(rounds)]),
+            NamedSharding(self.mesh, P(None, Settings.MESH_NODES_AXIS)),
+        )
+        eff = self._effective_mask()
+        mask = jax.device_put(jnp.asarray(eff), self._shard)
+        sel_idx = jax.device_put(np.flatnonzero(eff).astype(np.int32), self._repl)
+        result = spmd_rounds_fused(
+            self.params, self.opt_state, self.x_all, self.y_all, perms, mask,
+            self._samples, sel_idx,
+            module=self.module, tx=self.tx, agg=self.aggregator, trim=self.trim,
+            out_sharding=self._shard, keep_opt_state=self.keep_opt_state,
+            remat=self.remat,
+            x_test=self.x_test if eval else None,
+            y_test=self.y_test if eval else None,
+            **self._algo_kwargs(self._server_t),
+        )
+        self.params, self.opt_state, losses = result[:3]
+        i = 3
+        if self.scaffold:
+            self.c_global, self.c_local = result[i:i + 2]
+            i += 2
+        if self.server_opt:
+            self.opt_m, self.opt_v = result[i:i + 2]
+            self._server_t += rounds
+            i += 2
+        accs = result[i] if eval else None
+        entries = []
+        for r in range(rounds):
+            self.round += 1
+            entry = {"round": self.round, "train_loss": losses[r]}
+            if eval:
+                entry["test_acc"] = accs[r]
+            self.history.append(entry)
+            entries.append(entry)
+        return entries
 
     def round_flops(self, epochs: int = 1) -> Optional[float]:
         """Compiled FLOPs of one no-eval round (XLA cost analysis).
@@ -483,6 +771,8 @@ class SpmdFederation:
         eff = self._effective_mask()
         mask = jax.device_put(jnp.asarray(eff), self._shard)
         sel_idx = jax.device_put(np.flatnonzero(eff).astype(np.int32), self._repl)
+        # algorithm knobs change the compiled program — MFU must count the
+        # program that actually runs
         return compiled_flops(
             spmd_round,
             self.params, self.opt_state, self.x_all, self.y_all, perm, mask,
@@ -490,6 +780,7 @@ class SpmdFederation:
             module=self.module, tx=self.tx, agg=self.aggregator, trim=self.trim,
             out_sharding=self._shard, keep_opt_state=self.keep_opt_state,
             remat=self.remat,
+            **self._algo_kwargs(self._server_t + 1 if self.server_opt else 0),
         )
 
     def evaluate(self) -> dict:

@@ -53,8 +53,6 @@ def spmd_lora_round(
     keep_opt_state: bool = False,
     remat: bool = False,
 ):
-    import optax
-
     n = mask.shape[0]
 
     def node_fn(lora, opt_state, x, y, idx):

@@ -174,3 +174,44 @@ def test_spmd_matches_node_mode_fedavg():
         np.testing.assert_allclose(
             np.asarray(a, np.float32), np.asarray(b, np.float32), atol=2e-2
         )
+
+
+def test_run_fused_matches_sequential_rounds():
+    """R fused rounds (one dispatch) == R sequential run_round calls with
+    the same RNG seed — identical math, amortized dispatch."""
+    fa = SpmdFederation.from_dataset(mlp(), _dataset(), n_nodes=4, batch_size=64, vote=False, seed=3)
+    fb = SpmdFederation.from_dataset(mlp(), _dataset(), n_nodes=4, batch_size=64, vote=False, seed=3)
+    for _ in range(3):
+        fa.run_round(epochs=1)
+    entries = fb.run_fused(3, epochs=1, eval=True)
+    assert fb.round == 3 and len(entries) == 3
+    assert float(entries[-1]["test_acc"]) > 0.5
+    for a, b in zip(jax.tree.leaves(fa.params), jax.tree.leaves(fb.params)):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32), atol=2e-5, rtol=1e-4
+        )
+
+
+def test_run_fused_composes_with_scaffold_and_fedopt():
+    fed = SpmdFederation.from_dataset(
+        mlp(), _dataset(), n_nodes=4, batch_size=64, vote=False,
+        scaffold=True, optimizer="sgd", learning_rate=0.05,
+        server_opt="adam", server_lr=0.01,
+    )
+    entries = fed.run_fused(3, epochs=1, eval=True)
+    assert fed._server_t == 3
+    assert float(entries[-1]["test_acc"]) > float(entries[0]["test_acc"]) or (
+        float(entries[0]["test_acc"]) > 0.9
+    )
+
+
+def test_run_fused_rejects_per_round_election():
+    from p2pfl_tpu.settings import Settings
+
+    fed = SpmdFederation.from_dataset(mlp(), _dataset(), n_nodes=4, batch_size=64, vote=True)
+    Settings.VOTE_EVERY_ROUND = True
+    try:
+        with pytest.raises(ValueError, match="fixed mask"):
+            fed.run_fused(2)
+    finally:
+        Settings.VOTE_EVERY_ROUND = False
