@@ -107,8 +107,17 @@ class FedOpt(Aggregator):
         # the round resolved to a peer's (already server-stepped) aggregate
         # without this node aggregating: adopt it as the server's x_t so the
         # next round's pseudo-gradient is computed against the consensus
-        # global, not a stale one
+        # global, not a stale one. Moments must exist too — a node whose
+        # FIRST round resolves this way would otherwise crash in
+        # fedopt_update when it later aggregates itself.
         self._prev = update.params
+        if self._m is None:
+            self._m = jax.tree.map(
+                lambda x: jnp.zeros_like(x, jnp.float32), update.params
+            )
+            self._v = jax.tree.map(
+                lambda x: jnp.zeros_like(x, jnp.float32), update.params
+            )
         return update
 
 

@@ -175,6 +175,8 @@ class JaxLearner(NodeLearner):
         seed: int = 0,
         keep_opt_state: bool = False,
         prox_mu: float = 0.0,
+        dp_clip: float = 0.0,
+        dp_noise: float = 0.0,
     ) -> None:
         self.model = model
         self.data = data
@@ -186,6 +188,18 @@ class JaxLearner(NodeLearner):
         # FedProx (Li et al. 2020): μ > 0 adds a proximal pull toward the
         # round's incoming global model during local steps
         self.prox_mu = float(prox_mu)
+        # DP-SGD (Abadi et al. 2016): per-example clipped grads + Gaussian
+        # noise; dp_clip > 0 enables, dp_noise is the noise multiplier σ.
+        # An accountant tracks (ε, δ) across fit() calls.
+        self.dp_clip = float(dp_clip)
+        self.dp_noise = float(dp_noise)
+        self.accountant = None
+        if self.dp_clip > 0.0:
+            from p2pfl_tpu.learning.privacy import PrivacyAccountant
+
+            if self.dp_noise > 0.0:
+                q = min(1.0, batch_size / max(1, data.num_samples))
+                self.accountant = PrivacyAccountant(self.dp_noise, q)
         self.params: Pytree = model.params
         self.opt_state = self.tx.init(self.params)
         self._rng = np.random.default_rng(seed)
@@ -220,16 +234,29 @@ class JaxLearner(NodeLearner):
         self._interrupt.clear()
         if self.epochs == 0:
             return  # test mode, like the reference's epochs=0 CI runs
-        anchor = self.params if self.prox_mu > 0.0 else None  # round's global
+        # round's global model (FedProx anchor — used by both DP and plain paths)
+        anchor = self.params if self.prox_mu > 0.0 else None
         for _ in range(self.epochs):
             if self._interrupt.is_set():
                 logger.info(self.addr, "Training interrupted")
                 return
             xs, ys = self.data.epoch_batches(self.batch_size, self._rng)
-            self.params, self.opt_state, loss = train_epoch(
-                self.params, self.opt_state, jnp.asarray(xs), jnp.asarray(ys),
-                self.model.module, self.tx, prox_mu=self.prox_mu, anchor=anchor,
-            )
+            if self.dp_clip > 0.0:
+                from p2pfl_tpu.learning.privacy import dp_train_epoch
+
+                key = jax.random.PRNGKey(int(self._rng.integers(2**31)))
+                self.params, self.opt_state, loss = dp_train_epoch(
+                    self.params, self.opt_state, jnp.asarray(xs), jnp.asarray(ys),
+                    key, self.model.module, self.tx, self.dp_clip, self.dp_noise,
+                    prox_mu=self.prox_mu, anchor=anchor,
+                )
+                if self.accountant is not None:
+                    self.accountant.step(xs.shape[0])
+            else:
+                self.params, self.opt_state, loss = train_epoch(
+                    self.params, self.opt_state, jnp.asarray(xs), jnp.asarray(ys),
+                    self.model.module, self.tx, prox_mu=self.prox_mu, anchor=anchor,
+                )
             self._steps_done += xs.shape[0]
             logger.log_metric(self.addr, "train_loss", float(loss), step=self._steps_done)
 

@@ -55,6 +55,7 @@ Pytree = Any
 def _local_epoch(
     params, opt_state, xs, ys, module, tx, remat: bool = False,
     prox_mu: float = 0.0, anchor=None, corr=None,
+    dp_clip: float = 0.0, dp_noise: float = 0.0, key=None,
 ):
     """One node's epoch: scan of SGD steps (identical math to JaxLearner).
 
@@ -65,9 +66,35 @@ def _local_epoch(
 
     ``prox_mu``/``anchor``: FedProx proximal pull toward the round's global
     model. ``corr``: SCAFFOLD control-variate correction ``c − c_i`` added
-    to every step's gradient.
+    to every step's gradient. ``dp_clip > 0``: DP-SGD — per-example clipped
+    grads + Gaussian noise (multiplier ``dp_noise``, rng ``key``).
     """
     import optax
+
+    if dp_clip > 0.0:
+        from p2pfl_tpu.learning.privacy import dp_grads
+
+        def loss_one(p_, xi, yi):
+            loss = _loss(p_, module, xi[None], yi[None])[0]
+            if prox_mu > 0.0:
+                loss = loss + _prox_term(p_, anchor, prox_mu)
+            return loss
+
+        def dp_step(carry, batch):
+            p, o, k = carry
+            x, y = batch
+            k, sub = jax.random.split(k)
+            grads = dp_grads(loss_one, p, x, y, dp_clip, dp_noise, sub, remat=remat)
+            if corr is not None:
+                grads = jax.tree.map(lambda g, c: g + c.astype(g.dtype), grads, corr)
+            updates, o = tx.update(grads, o, p)
+            p = optax.apply_updates(p, updates)
+            return (p, o, k), _loss(p, module, x, y)[0]
+
+        (params, opt_state, _), losses = jax.lax.scan(
+            dp_step, (params, opt_state, key), (xs, ys)
+        )
+        return params, opt_state, jnp.mean(losses)
 
     def step(carry, batch):
         p, o = carry
@@ -165,6 +192,9 @@ def _round_core(
     opt_m=None,  # FedOpt server first/second moments (replicated pytrees)
     opt_v=None,
     opt_t=None,  # FedOpt server step count (scalar, 1-based)
+    dp_clip: float = 0.0,  # DP-SGD clip norm (0 = off)
+    dp_noise: float = 0.0,  # DP-SGD noise multiplier
+    dp_keys=None,  # [N, 2] uint32 per-node rng keys (required when dp_clip > 0)
 ):
     """One federated round's device program (train → aggregate → diffuse).
 
@@ -179,23 +209,30 @@ def _round_core(
     n = mask.shape[0]
 
     # gather per-epoch batches: idx [epochs, nb, bs] → x[idx] [epochs, nb, bs, ...]
-    def node_fn(params, opt_state, x, y, idx, ci):
+    def node_fn(params, opt_state, x, y, idx, ci, dp_key):
         anchor = params if (prox_mu > 0.0 or scaffold) else None
         corr = (
             jax.tree.map(lambda c, cl: c - cl, c_global, ci) if scaffold else None
         )
 
         def epoch_body(carry, ep_idx):
-            p, o = carry
+            p, o, k = carry
             xs = jnp.take(x, ep_idx, axis=0)  # [nb, bs, ...]
             ys = jnp.take(y, ep_idx, axis=0)
+            sub = None
+            if dp_clip > 0.0:
+                k, sub = jax.random.split(k)
             p, o, loss = _local_epoch(
                 p, o, xs, ys, module, tx, remat,
                 prox_mu=prox_mu, anchor=anchor, corr=corr,
+                dp_clip=dp_clip, dp_noise=dp_noise, key=sub,
             )
-            return (p, o), loss
+            return (p, o, k), loss
 
-        (params, opt_state), losses = jax.lax.scan(epoch_body, (params, opt_state), idx)
+        k0 = dp_key if dp_clip > 0.0 else jnp.zeros((2,), jnp.uint32)
+        (params, opt_state, _), losses = jax.lax.scan(
+            epoch_body, (params, opt_state, k0), idx
+        )
         if scaffold:
             # c_i⁺ = c_i − c + (x_global − y_i)/(K·η)  (SCAFFOLD option II)
             k_steps = idx.shape[0] * idx.shape[1]
@@ -209,14 +246,16 @@ def _round_core(
             ci_new = ci
         return params, opt_state, jnp.mean(losses), ci_new
 
+    key_ax = 0 if dp_clip > 0.0 else None
+    keys = dp_keys if dp_clip > 0.0 else None
     if scaffold:
         trained_p, trained_o, losses, ci_new = jax.vmap(
-            node_fn, in_axes=(0, 0, 0, 0, 0, 0)
-        )(stacked_params, opt_states, x_all, y_all, perm, c_local)
+            node_fn, in_axes=(0, 0, 0, 0, 0, 0, key_ax)
+        )(stacked_params, opt_states, x_all, y_all, perm, c_local, keys)
     else:
         trained_p, trained_o, losses, _ = jax.vmap(
-            node_fn, in_axes=(0, 0, 0, 0, 0, None)
-        )(stacked_params, opt_states, x_all, y_all, perm, None)
+            node_fn, in_axes=(0, 0, 0, 0, 0, None, key_ax)
+        )(stacked_params, opt_states, x_all, y_all, perm, None, keys)
 
     # non-train-set nodes contribute their previous params (they don't train)
     def sel(new, old):
@@ -303,6 +342,7 @@ def _agg_acc(module, agg_params, x_test, y_test):
 _ROUND_STATICS = (
     "module", "tx", "agg", "trim", "out_sharding", "keep_opt_state", "remat",
     "prox_mu", "scaffold", "local_lr", "server_opt", "server_lr",
+    "dp_clip", "dp_noise",
 )
 
 
@@ -333,7 +373,7 @@ def spmd_rounds_fused(
     stacked_params, opt_states, x_all, y_all, perms, mask, weights, sel_idx,
     *,
     c_global=None, c_local=None, opt_m=None, opt_v=None, opt_t=None,
-    x_test=None, y_test=None, **kw,
+    dp_keys=None, x_test=None, y_test=None, **kw,
 ):
     """R federated rounds as ONE device dispatch: ``lax.scan`` over rounds.
 
@@ -353,12 +393,14 @@ def spmd_rounds_fused(
     if opt_t is None:
         opt_t = jnp.float32(0.0)
 
-    def body(carry, perm):
+    def body(carry, xsi):
+        perm, kk = xsi
         p, o, cg, cl, m_, v_, t_ = carry
         t_next = t_ + 1.0
         out_p, out_o, loss, sstate, fstate, agg_params = _round_core(
             p, o, x_all, y_all, perm, mask, weights, sel_idx,
-            c_global=cg, c_local=cl, opt_m=m_, opt_v=v_, opt_t=t_next, **kw,
+            c_global=cg, c_local=cl, opt_m=m_, opt_v=v_, opt_t=t_next,
+            dp_keys=kk, **kw,
         )
         cg, cl = sstate if scaffold else (cg, cl)
         m_, v_ = fstate if server_opt else (m_, v_)
@@ -366,7 +408,7 @@ def spmd_rounds_fused(
         return (out_p, out_o, cg, cl, m_, v_, t_next), ys
 
     carry0 = (stacked_params, opt_states, c_global, c_local, opt_m, opt_v, opt_t)
-    (p, o, cg, cl, m_, v_, _), ys = jax.lax.scan(body, carry0, perms)
+    (p, o, cg, cl, m_, v_, _), ys = jax.lax.scan(body, carry0, (perms, dp_keys))
     scaffold_state = (cg, cl) if scaffold else ()
     fedopt_state = (m_, v_) if server_opt else ()
     if x_test is None:
@@ -417,6 +459,8 @@ class SpmdFederation:
         optimizer: str = "adam",
         server_opt: str = "",
         server_lr: float = 0.1,
+        dp_clip: float = 0.0,
+        dp_noise: float = 0.0,
     ) -> None:
         self.model = model
         self.module = model.module
@@ -439,6 +483,9 @@ class SpmdFederation:
             raise ValueError(f"unknown server_opt {server_opt!r}")
         self.server_opt = server_opt
         self.server_lr = server_lr
+        # DP-SGD per-node local steps (clip norm + noise multiplier)
+        self.dp_clip = float(dp_clip)
+        self.dp_noise = float(dp_noise)
         self.aggregator = aggregator
         self.trim = trim
         self.keep_opt_state = keep_opt_state
@@ -456,6 +503,14 @@ class SpmdFederation:
 
         # device-resident data, truncated to common per-node sizes
         self._stage_data()
+        # per-node (ε, δ) tracking: every node runs the same mechanism on
+        # its own shard, so one accountant describes each node's guarantee
+        self.accountant = None
+        if self.dp_clip > 0.0 and self.dp_noise > 0.0:
+            from p2pfl_tpu.learning.privacy import PrivacyAccountant
+
+            q = min(1.0, self.batch_size / min(self._sizes))
+            self.accountant = PrivacyAccountant(self.dp_noise, q)
         # node-stacked state: every node starts from the same params
         # (reference: initiator's weights seed the network, §3.3)
         self._stage_state()
@@ -650,7 +705,21 @@ class SpmdFederation:
             opt_m=self.opt_m if self.server_opt else None,
             opt_v=self.opt_v if self.server_opt else None,
             opt_t=jnp.float32(opt_t) if self.server_opt else None,
+            dp_clip=self.dp_clip,
+            dp_noise=self.dp_noise,
         )
+
+    def _dp_round_keys(self, rounds: int = 0) -> Optional[jax.Array]:
+        """Per-node DP rng keys: [N, 2] for one round, [R, N, 2] fused."""
+        if self.dp_clip <= 0.0:
+            return None
+        root = jax.random.PRNGKey(int(self._rng.integers(2**31)))
+        if rounds:
+            keys = jax.random.split(root, rounds * self.n).reshape(rounds, self.n, 2)
+            return jax.device_put(
+                keys, NamedSharding(self.mesh, P(None, Settings.MESH_NODES_AXIS))
+            )
+        return jax.device_put(jax.random.split(root, self.n), self._shard)
 
     def run_round(self, epochs: int = 1, eval: bool = False) -> dict:  # noqa: A002
         if self._vote and (self.round == 0 or Settings.VOTE_EVERY_ROUND):
@@ -679,6 +748,7 @@ class SpmdFederation:
             remat=self.remat,
             x_test=self.x_test if eval else None,
             y_test=self.y_test if eval else None,
+            dp_keys=self._dp_round_keys(),
             **self._algo_kwargs(self._server_t + 1 if self.server_opt else 0),
         )
         self.params, self.opt_state, loss = result[:3]
@@ -689,6 +759,8 @@ class SpmdFederation:
         if self.server_opt:
             self.opt_m, self.opt_v = result[i:i + 2]
             self._server_t += 1
+        if self.accountant is not None:
+            self.accountant.step(epochs * self._nb)
         self.round += 1
         # keep the loss as a device scalar: rounds pipeline back-to-back with
         # no host sync; it coerces to float lazily (e.g. when printed)
@@ -737,6 +809,7 @@ class SpmdFederation:
             remat=self.remat,
             x_test=self.x_test if eval else None,
             y_test=self.y_test if eval else None,
+            dp_keys=self._dp_round_keys(rounds),
             **self._algo_kwargs(self._server_t),
         )
         self.params, self.opt_state, losses = result[:3]
@@ -748,6 +821,8 @@ class SpmdFederation:
             self.opt_m, self.opt_v = result[i:i + 2]
             self._server_t += rounds
             i += 2
+        if self.accountant is not None:
+            self.accountant.step(rounds * epochs * self._nb)
         accs = result[i] if eval else None
         entries = []
         for r in range(rounds):
@@ -780,6 +855,7 @@ class SpmdFederation:
             module=self.module, tx=self.tx, agg=self.aggregator, trim=self.trim,
             out_sharding=self._shard, keep_opt_state=self.keep_opt_state,
             remat=self.remat,
+            dp_keys=self._dp_round_keys(),
             **self._algo_kwargs(self._server_t + 1 if self.server_opt else 0),
         )
 
