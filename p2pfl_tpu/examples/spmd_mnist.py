@@ -22,6 +22,8 @@ def main(argv=None) -> None:
     parser.add_argument("--partition", default="iid", choices=["iid", "sorted", "dirichlet"])
     parser.add_argument("--vote", action="store_true", help="elect a train set (round 0)")
     parser.add_argument("--measure_time", action="store_true")
+    parser.add_argument("--dp-clip", type=float, default=0.0, help="DP-SGD clip norm (0 = off)")
+    parser.add_argument("--dp-noise", type=float, default=0.0, help="DP-SGD noise multiplier")
     args = parser.parse_args(argv)
 
     from p2pfl_tpu.learning.dataset import FederatedDataset
@@ -37,6 +39,8 @@ def main(argv=None) -> None:
         batch_size=args.batch_size,
         aggregator=args.aggregator,
         vote=args.vote,
+        dp_clip=args.dp_clip,
+        dp_noise=args.dp_noise,
     )
     t0 = time.monotonic()
     for r in range(args.rounds):
@@ -45,6 +49,8 @@ def main(argv=None) -> None:
         print(f"round {entry['round']}: loss={entry['train_loss']:.4f} acc={metrics['test_acc']:.4f}")
     if args.measure_time:
         print(f"elapsed: {time.monotonic() - t0:.2f}s ({args.nodes} nodes)")
+    if fed.accountant is not None:
+        print(f"privacy spent: eps={fed.accountant.epsilon(1e-5):.2f} (delta=1e-5)")
 
 
 if __name__ == "__main__":

@@ -193,6 +193,10 @@ class JaxLearner(NodeLearner):
         # An accountant tracks (ε, δ) across fit() calls.
         self.dp_clip = float(dp_clip)
         self.dp_noise = float(dp_noise)
+        if self.dp_noise > 0.0 and self.dp_clip <= 0.0:
+            # noise without a clip bound has no privacy semantics — and the
+            # dp path is gated on dp_clip, so it would silently be ignored
+            raise ValueError("dp_noise > 0 requires dp_clip > 0")
         self.accountant = None
         if self.dp_clip > 0.0:
             from p2pfl_tpu.learning.privacy import PrivacyAccountant

@@ -42,24 +42,25 @@ def clip_by_global_norm(grads: Pytree, clip: float) -> Pytree:
     return jax.tree.map(lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads)
 
 
-def dp_grads(loss_one, params, x, y, clip: float, noise: float, key, remat: bool = False) -> Pytree:
+def dp_grads(loss_one, params, x, y, clip: float, noise: float, key, remat: bool = False):
     """Per-example clipped + noised mean gradient (the DP-SGD estimator).
 
     ``loss_one(params, x_i, y_i) -> scalar`` is the single-example loss;
     ``x``/``y`` carry the batch dim. ``remat`` rematerializes each
     example's backward (per-example grads store activations for the whole
-    batch otherwise — the HBM↔FLOPs trade big models need). Returns a
-    gradient pytree with the same dtypes as ``params``.
+    batch otherwise — the HBM↔FLOPs trade big models need). Returns
+    ``(grads, mean_loss)`` — the pre-update loss comes free from the grad
+    pass, matching what the non-DP paths report.
     """
     batch = x.shape[0]
     if remat:
         loss_one = jax.checkpoint(loss_one)
 
     def one(xi, yi):
-        g = jax.grad(loss_one)(params, xi, yi)
-        return clip_by_global_norm(g, clip)
+        loss, g = jax.value_and_grad(loss_one)(params, xi, yi)
+        return clip_by_global_norm(g, clip), loss
 
-    per_ex = jax.vmap(one)(x, y)  # [B, ...] pytrees
+    per_ex, losses = jax.vmap(one)(x, y)  # [B, ...] pytrees, [B] losses
     mean_g = jax.tree.map(lambda g: jnp.mean(g.astype(jnp.float32), axis=0), per_ex)
     leaves, tdef = jax.tree.flatten(mean_g)
     keys = jax.random.split(key, len(leaves))
@@ -68,7 +69,7 @@ def dp_grads(loss_one, params, x, y, clip: float, noise: float, key, remat: bool
         (g + sigma * jax.random.normal(k, g.shape, jnp.float32)).astype(p.dtype)
         for g, k, p in zip(leaves, keys, jax.tree.leaves(params))
     ]
-    return tdef.unflatten(noised)
+    return tdef.unflatten(noised), jnp.mean(losses)
 
 
 @partial(jax.jit, static_argnames=("module", "tx", "clip", "noise", "prox_mu"))
@@ -94,10 +95,9 @@ def dp_train_epoch(
         p, o, k = carry
         x, y = batch
         k, sub = jax.random.split(k)
-        grads = dp_grads(loss_one, p, x, y, clip, noise, sub)
+        grads, loss = dp_grads(loss_one, p, x, y, clip, noise, sub)
         updates, o = tx.update(grads, o, p)
         p = optax.apply_updates(p, updates)
-        loss = _loss(p, module, x, y)[0]
         return (p, o, k), loss
 
     (params, opt_state, _), losses = jax.lax.scan(step, (params, opt_state, key), (xs, ys))

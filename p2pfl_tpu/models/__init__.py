@@ -6,6 +6,9 @@ ResNet-18/50 (CIFAR) and a LoRA transformer (federated fine-tune).
 """
 
 from p2pfl_tpu.models.base import FlaxModel
-from p2pfl_tpu.models.vision import CNN, MLP, ResNet, cnn, mlp, resnet18, resnet50
+from p2pfl_tpu.models.vision import CNN, MLP, ResNet, ViT, cnn, mlp, resnet18, resnet50, vit
 
-__all__ = ["FlaxModel", "MLP", "CNN", "ResNet", "mlp", "cnn", "resnet18", "resnet50"]
+__all__ = [
+    "FlaxModel", "MLP", "CNN", "ResNet", "ViT",
+    "mlp", "cnn", "resnet18", "resnet50", "vit",
+]

@@ -152,3 +152,87 @@ def resnet50(seed: int = 0, num_classes: int = 100, input_shape=(32, 32, 3)) -> 
         seed,
         num_classes,
     )
+
+
+class ViTBlock(nn.Module):
+    """Pre-norm encoder block: bidirectional MHA + GELU MLP (ViT recipe).
+    Width is derived from the input's last dim."""
+
+    heads: int
+    mlp_ratio: int = 4
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):  # [B, T, D]
+        import jax
+
+        b, t, d = x.shape
+        h = self.heads
+        hd = d // h
+        y = nn.LayerNorm(dtype=jnp.float32)(x).astype(self.dtype)
+        qkv = nn.Dense(3 * d, dtype=self.dtype, name="qkv")(y)
+        q, k, v = jnp.split(qkv.reshape(b, t, 3, h, hd), 3, axis=2)
+        q, k, v = (a.squeeze(2) for a in (q, k, v))  # [B, T, H, hd]
+        # bidirectional attention, fp32 softmax statistics
+        s = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32), k.astype(jnp.float32))
+        a = jax.nn.softmax(s * hd**-0.5, axis=-1).astype(self.dtype)
+        o = jnp.einsum("bhts,bshd->bthd", a, v).reshape(b, t, d)
+        x = x + nn.Dense(d, dtype=self.dtype, name="proj")(o)
+        y = nn.LayerNorm(dtype=jnp.float32)(x).astype(self.dtype)
+        y = nn.Dense(self.mlp_ratio * d, dtype=self.dtype, name="fc1")(y)
+        y = nn.Dense(d, dtype=self.dtype, name="fc2")(nn.gelu(y))
+        return x + y
+
+
+class ViT(nn.Module):
+    """Small vision transformer (Dosovitskiy et al. 2020): conv patch embed,
+    learned position embeddings, mean-pooled head. Fills the attention-based
+    vision slot of the model zoo (the reference has only MLP/CNN,
+    ``mnist_examples/models/``)."""
+
+    num_classes: int = 10
+    patch: int = 4
+    dim: int = 64
+    depth: int = 4
+    heads: int = 4
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):  # [B, H, W, C]
+        x = nn.Conv(
+            self.dim, (self.patch, self.patch), strides=(self.patch, self.patch),
+            dtype=self.dtype, name="patch_embed",
+        )(x.astype(self.dtype))
+        b, hh, ww, d = x.shape
+        x = x.reshape(b, hh * ww, d)
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02), (1, hh * ww, d)
+        )
+        x = x + pos.astype(self.dtype)
+        for i in range(self.depth):
+            x = ViTBlock(self.heads, dtype=self.dtype, name=f"block_{i}")(x)
+        x = nn.LayerNorm(dtype=jnp.float32)(x.mean(axis=1))
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+
+
+def vit(
+    seed: int = 0,
+    num_classes: int = 10,
+    input_shape=(32, 32, 3),
+    patch: int = 4,
+    dim: int = 64,
+    depth: int = 4,
+    heads: int = 4,
+    dtype: jnp.dtype = jnp.bfloat16,
+) -> FlaxModel:
+    """``dtype=jnp.float32`` for CPU runs — bf16 is software-emulated there
+    (the default bf16 is the TPU/MXU recipe)."""
+    return FlaxModel.create(
+        ViT(
+            num_classes=num_classes, patch=patch, dim=dim, depth=depth,
+            heads=heads, dtype=dtype,
+        ),
+        input_shape,
+        seed=seed,
+        num_classes=num_classes,
+    )

@@ -84,12 +84,12 @@ def _local_epoch(
             p, o, k = carry
             x, y = batch
             k, sub = jax.random.split(k)
-            grads = dp_grads(loss_one, p, x, y, dp_clip, dp_noise, sub, remat=remat)
+            grads, loss = dp_grads(loss_one, p, x, y, dp_clip, dp_noise, sub, remat=remat)
             if corr is not None:
                 grads = jax.tree.map(lambda g, c: g + c.astype(g.dtype), grads, corr)
             updates, o = tx.update(grads, o, p)
             p = optax.apply_updates(p, updates)
-            return (p, o, k), _loss(p, module, x, y)[0]
+            return (p, o, k), loss
 
         (params, opt_state, _), losses = jax.lax.scan(
             dp_step, (params, opt_state, key), (xs, ys)
@@ -486,6 +486,8 @@ class SpmdFederation:
         # DP-SGD per-node local steps (clip norm + noise multiplier)
         self.dp_clip = float(dp_clip)
         self.dp_noise = float(dp_noise)
+        if self.dp_noise > 0.0 and self.dp_clip <= 0.0:
+            raise ValueError("dp_noise > 0 requires dp_clip > 0")
         self.aggregator = aggregator
         self.trim = trim
         self.keep_opt_state = keep_opt_state

@@ -267,3 +267,36 @@ def test_double_start_raises():
         agg.set_nodes_to_aggregate(["a"])
     agg.clear()
     agg.set_nodes_to_aggregate(["a"])  # ok after clear
+
+
+def test_vit_forward_and_federated_training():
+    """ViT (attention-based vision model — beyond the reference's MLP/CNN):
+    forward shape, then an SPMD federation learns on CIFAR-shaped data."""
+    import jax.numpy as jnp
+
+    from p2pfl_tpu.models import vit
+    from p2pfl_tpu.parallel import SpmdFederation
+
+    # CIFAR-shaped forward at the default size
+    m = vit(dim=32, depth=2, heads=2)
+    x = jnp.zeros((4, 32, 32, 3))
+    assert m.apply(m.params, x).shape == (4, 10)
+
+    from p2pfl_tpu.learning.dataset import FederatedDataset
+
+    # training run kept CPU-mesh-sized: 16x16 images (16 tokens), f32
+    # (bf16 is software-emulated on CPU), ~100 local steps with carried
+    # Adam moments — a transformer at chance after 2 rounds is expected,
+    # not a bug
+    data = FederatedDataset.synthetic_mnist(
+        n_train=2048, n_test=512, dim=(16, 16, 3), noise=0.5
+    )
+    m = vit(dim=32, depth=2, heads=2, input_shape=(16, 16, 3), dtype=jnp.float32)
+    fed = SpmdFederation.from_dataset(
+        m, data, n_nodes=4, batch_size=128, vote=False,
+        learning_rate=3e-3, keep_opt_state=True,
+    )
+    before = fed.evaluate()["test_acc"]
+    fed.run(rounds=12, epochs=2)
+    after = fed.evaluate()["test_acc"]
+    assert after > max(before, 0.5)

@@ -43,7 +43,8 @@ def test_dp_grads_clip_bounds_sensitivity():
 
     x = jax.random.normal(jax.random.PRNGKey(0), (16, 8))
     y = jnp.ones((16,))
-    g = dp_grads(loss_one, params, x, y, clip=1.0, noise=0.0, key=jax.random.PRNGKey(1))
+    g, loss = dp_grads(loss_one, params, x, y, clip=1.0, noise=0.0, key=jax.random.PRNGKey(1))
+    assert bool(jnp.isfinite(loss))
     norm = float(jnp.sqrt(sum(jnp.sum(v * v) for v in jax.tree.leaves(g))))
     assert norm <= 1.0 + 1e-5
 
@@ -56,8 +57,8 @@ def test_dp_grads_noise_changes_with_key():
 
     x = jnp.ones((8, 4))
     y = jnp.zeros((8,))
-    g1 = dp_grads(loss_one, params, x, y, 1.0, 1.0, jax.random.PRNGKey(1))
-    g2 = dp_grads(loss_one, params, x, y, 1.0, 1.0, jax.random.PRNGKey(2))
+    g1, _ = dp_grads(loss_one, params, x, y, 1.0, 1.0, jax.random.PRNGKey(1))
+    g2, _ = dp_grads(loss_one, params, x, y, 1.0, 1.0, jax.random.PRNGKey(2))
     assert float(jnp.abs(g1["w"] - g2["w"]).max()) > 0.0
 
 
@@ -100,6 +101,16 @@ def test_spmd_dp_federation_learns():
     entries = fed.run_fused(3, epochs=1, eval=True)  # fused path
     assert float(entries[-1]["test_acc"]) > 0.3
     assert fed.round == 4
+
+
+def test_dp_noise_without_clip_rejected():
+    """noise without a clip bound has no privacy semantics and would be
+    silently ignored by the dp_clip-gated paths — must raise."""
+    data = FederatedDataset.synthetic_mnist(n_train=128, n_test=32)
+    with pytest.raises(ValueError, match="dp_clip"):
+        JaxLearner(mlp(), data, dp_noise=1.0)
+    with pytest.raises(ValueError, match="dp_clip"):
+        SpmdFederation.from_dataset(mlp(), data, n_nodes=2, batch_size=32, dp_noise=1.0)
 
 
 def test_spmd_dp_accountant_tracks_rounds():
