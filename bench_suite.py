@@ -300,13 +300,13 @@ def config6_heterogeneous_algorithms() -> None:
     n_nodes, rounds = 8, 10
     results = {}
     times = {}
+    data = FederatedDataset.mnist(None, modes=8, noise=0.7, proto_scale=0.5)
     for algo, kwargs in {
         "fedavg": {},
         "fedprox": {"prox_mu": 0.1},
         "scaffold": {"scaffold": True, "optimizer": "sgd", "learning_rate": 0.05},
         "fedadam": {"server_opt": "adam", "server_lr": 0.01},
     }.items():
-        data = FederatedDataset.mnist(None, modes=8, noise=0.7, proto_scale=0.5)
         fed = SpmdFederation.from_dataset(
             mlp(), data, n_nodes=n_nodes, strategy="dirichlet", alpha=0.3,
             batch_size=64, vote=False, seed=7, **kwargs,
@@ -341,6 +341,54 @@ def config6_heterogeneous_algorithms() -> None:
     })
 
 
+def config7_long_context_flash() -> None:
+    """Long-context single-chip path: Pallas flash attention vs fused dense
+    XLA attention, training-step time across sequence lengths."""
+    import optax
+
+    from p2pfl_tpu.models.transformer import TransformerConfig, tiny_transformer
+
+    cfg_kw = dict(
+        vocab_size=1024, dim=256, n_layers=4, n_heads=8, n_kv_heads=8,
+        ffn_hidden=688, lora_rank=0,
+    )
+    results = {}
+    for seq_len in (1024, 2048, 4096):
+        row = {}
+        for attn in ("dense", "flash"):
+            m = tiny_transformer(seq_len=seq_len, cfg=TransformerConfig(**cfg_kw), attn=attn)
+            tokens = jax.random.randint(jax.random.PRNGKey(0), (8, seq_len), 0, 1024)
+            targets = jnp.roll(tokens, -1, axis=1)
+
+            def loss(p, m=m, tokens=tokens, targets=targets):
+                logits = m.apply(p, tokens)
+                return optax.softmax_cross_entropy_with_integer_labels(logits, targets).mean()
+
+            step = jax.jit(jax.value_and_grad(loss))
+            l, g = step(m.params)
+            jax.block_until_ready(g)  # compile
+            t0 = time.monotonic()
+            for _ in range(10):
+                l, g = step(m.params)
+            jax.block_until_ready(g)
+            row[attn] = round((time.monotonic() - t0) / 10 * 1000, 2)  # ms
+            del m, step, g
+            jax.clear_caches()
+        row["speedup"] = round(row["dense"] / row["flash"], 2)
+        results[f"T{seq_len}"] = row
+        log(f"config7 T={seq_len}: {row}")
+
+    emit({
+        "metric": "config7_long_context_flash_vs_dense",
+        "value": results["T4096"]["speedup"],
+        "unit": "x_speedup_at_4096",
+        "ms_per_train_step": results,
+        "batch": 8,
+        "model": "4L/256d/8h transformer, bf16",
+        "devices": len(jax.devices()),
+    })
+
+
 CONFIGS = {
     "1": config1_mnist_2node,
     "2": config2_resnet18_8node,
@@ -348,6 +396,7 @@ CONFIGS = {
     "4": config4_byzantine_robust,
     "5": config5_lora_32node,
     "6": config6_heterogeneous_algorithms,
+    "7": config7_long_context_flash,
 }
 
 

@@ -10,6 +10,7 @@ from __future__ import annotations
 from typing import Sequence
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from p2pfl_tpu.models.base import FlaxModel
@@ -164,8 +165,6 @@ class ViTBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x):  # [B, T, D]
-        import jax
-
         b, t, d = x.shape
         h = self.heads
         hd = d // h
