@@ -185,3 +185,32 @@ def test_grpc_wire_weights_are_encoded():
     assert seen["params"] is None and seen["encoded"]
     n1.stop()
     n2.stop()
+
+
+def test_grpc_corrupted_weights_stop_node_cleanly():
+    """A garbage weights payload over real sockets must trip the decode
+    error path (reference parity: decode errors stop the node,
+    ``add_model_command.py:96-104``) — and never hang or crash the peer."""
+    full = FederatedDataset.synthetic_mnist(n_train=256, n_test=64)
+    victim = _grpc_node(learner=JaxLearner(mlp(), full.partition(0, 2), batch_size=64))
+    attacker = _grpc_node(learner=JaxLearner(mlp(seed=1), full.partition(1, 2), batch_size=64))
+    attacker.connect(victim.addr)
+    wait_convergence([victim, attacker], 1, only_direct=True)
+
+    # victim initiates, so it is model-initialized and collecting at once;
+    # fire the garbage immediately so it lands mid-round
+    victim.set_start_learning(rounds=1, epochs=1)
+    garbage = ModelUpdate(None, [attacker.addr], 10, encoded=b"NOT A WEIGHTS PAYLOAD")
+    env = WeightsEnvelope(attacker.addr, 0, "add_model", garbage, "corrupt-1")
+    assert encode_weights(env)  # the envelope itself encodes fine
+    attacker.protocol._send_to_neighbor(victim.addr, env)
+
+    # the victim detects the decode error and stops itself (reference
+    # behavior); the attacker stays healthy
+    deadline = time.time() + 10
+    while victim._running and time.time() < deadline:
+        time.sleep(0.1)
+    assert not victim._running
+    assert attacker._running
+    attacker.stop()
+    victim.stop()  # idempotent
