@@ -19,7 +19,7 @@ def main(argv=None) -> None:
     parser.add_argument("--batch-size", type=int, default=64)
     parser.add_argument("--large", action="store_true", help="ResNet-50 / 100 classes")
     parser.add_argument("--aggregator", default="fedavg",
-                        choices=["fedavg", "median", "trimmed_mean", "krum"])
+                        choices=["fedavg", "median", "trimmed_mean", "krum", "bulyan"])
     parser.add_argument("--alpha", type=float, default=0.5, help="Dirichlet concentration")
     parser.add_argument("--samples", type=int, default=16384)
     parser.add_argument("--measure_time", action="store_true")

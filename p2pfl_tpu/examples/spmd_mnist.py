@@ -18,7 +18,7 @@ def main(argv=None) -> None:
     parser.add_argument("--epochs", type=int, default=1)
     parser.add_argument("--batch-size", type=int, default=64)
     parser.add_argument("--aggregator", default="fedavg",
-                        choices=["fedavg", "median", "trimmed_mean", "krum"])
+                        choices=["fedavg", "median", "trimmed_mean", "krum", "bulyan"])
     parser.add_argument("--partition", default="iid", choices=["iid", "sorted", "dirichlet"])
     parser.add_argument("--vote", action="store_true", help="elect a train set (round 0)")
     parser.add_argument("--measure_time", action="store_true")

@@ -1,6 +1,7 @@
 """Aggregation strategies over jax.Array pytrees."""
 
 from p2pfl_tpu.learning.aggregators.aggregator import Aggregator
+from p2pfl_tpu.learning.aggregators.bulyan import Bulyan
 from p2pfl_tpu.learning.aggregators.fedavg import FedAvg
 from p2pfl_tpu.learning.aggregators.fedmedian import FedMedian
 from p2pfl_tpu.learning.aggregators.fedopt import FedAdagrad, FedAdam, FedOpt, FedYogi
@@ -9,6 +10,7 @@ from p2pfl_tpu.learning.aggregators.trimmed_mean import TrimmedMean
 
 __all__ = [
     "Aggregator",
+    "Bulyan",
     "FedAdagrad",
     "FedAdam",
     "FedAvg",

@@ -144,3 +144,33 @@ def fedopt_update(
     out = [one(p, a, mi, vi) for p, a, mi, vi in zip(flat_p, flat_a, flat_m, flat_v)]
     news, ms, vs = zip(*out)
     return tdef.unflatten(news), tdef.unflatten(ms), tdef.unflatten(vs)
+
+
+def bulyan(stacked: Pytree, n_byzantine: int) -> Pytree:
+    """Bulyan (El Mhamdi et al. 2018): iterated Krum selection then
+    coordinate-wise trimmed mean — tolerates f Byzantine among N ≥ 4f + 3.
+
+    θ = N − 2f models are selected one at a time (each round re-runs Krum on
+    the remaining stack, the true iterative variant), then aggregated with a
+    β = f trimmed mean per coordinate. Each iteration is a jitted
+    shape-keyed call, so repeated rounds at the same N reuse executables.
+    """
+    import numpy as np
+
+    n = jax.tree.leaves(stacked)[0].shape[0]
+    f = n_byzantine
+    if n < 4 * f + 3:
+        raise ValueError(f"Bulyan needs N >= 4f + 3 (N={n}, f={f})")
+    theta = n - 2 * f
+
+    remaining = list(range(n))
+    chosen: list[int] = []
+    cur = stacked
+    for _ in range(theta):
+        idx = int(np.asarray(krum_select(cur, n_byzantine=f, multi=1))[0])
+        chosen.append(remaining.pop(idx))
+        keep = jnp.asarray([i for i in range(len(remaining) + 1) if i != idx], dtype=jnp.int32)
+        cur = jax.tree.map(lambda x: jnp.take(x, keep, axis=0), cur)
+
+    sel = jax.tree.map(lambda x: jnp.take(x, jnp.asarray(chosen, dtype=jnp.int32), axis=0), stacked)
+    return trimmed_mean(sel, trim=f)

@@ -162,6 +162,28 @@ def _aggregate(p_used, mask, weights, sel_idx, agg: str, trim: int):
             return jnp.take(x, idx, axis=0).astype(jnp.float32).mean(axis=0).astype(x.dtype)
 
         return jax.tree.map(pick, p_sel)
+    if agg == "bulyan":
+        # iterated Krum selection (θ = K − 2f picks, re-scored each pick)
+        # then β = f trimmed mean — all static shapes: the removal keeps
+        # K−i−1 rows via an index shift around the traced Krum pick
+        f = trim
+        if k < 4 * f + 3:
+            raise ValueError(f"Bulyan needs K >= 4f + 3 (K={k}, f={f})")
+        theta = k - 2 * f
+        cur = p_sel
+        orig = jnp.arange(k, dtype=jnp.int32)
+        chosen = []
+        for i in range(theta):
+            m = k - i
+            idx = ops.krum_select(cur, n_byzantine=f, multi=1)[0]
+            chosen.append(orig[idx])
+            pos = jnp.arange(m - 1, dtype=jnp.int32)
+            keep = jnp.where(pos < idx, pos, pos + 1)  # skip the pick
+            cur = jax.tree.map(lambda x: jnp.take(x, keep, axis=0), cur)
+            orig = jnp.take(orig, keep)
+        sel = jnp.stack(chosen)
+        sel_tree = jax.tree.map(lambda x: jnp.take(x, sel, axis=0), p_sel)
+        return ops.trimmed_mean(sel_tree, trim=f)
     raise ValueError(f"unknown aggregator {agg}")
 
 

@@ -300,3 +300,29 @@ def test_vit_forward_and_federated_training():
     fed.run(rounds=12, epochs=2)
     after = fed.evaluate()["test_acc"]
     assert after > max(before, 0.5)
+
+
+def test_bulyan_resists_coordinate_attack():
+    """Bulyan (Krum select + trimmed mean) survives both large-distance
+    outliers AND the 'a little is enough' per-coordinate attack; needs
+    N >= 4f + 3."""
+    from p2pfl_tpu.learning.aggregators import Bulyan
+    from p2pfl_tpu.ops.aggregation import bulyan
+    from p2pfl_tpu.ops.tree import tree_stack
+
+    rng = np.random.default_rng(0)
+    honest = [
+        {"w": jnp.asarray(1.0 + 0.01 * rng.normal(size=8), jnp.float32)} for _ in range(6)
+    ]
+    # f=1 attacker: close enough to pass Krum, one coordinate poisoned
+    atk = {"w": honest[0]["w"].at[3].add(0.5)}
+    models = [ModelUpdate(p, [f"n{i}"], 10) for i, p in enumerate(honest + [atk])]
+
+    agg = Bulyan("me", n_byzantine=1)
+    result = agg.aggregate(models)
+    # the poisoned coordinate is trimmed away: stays near the honest 1.0
+    assert abs(float(result.params["w"][3]) - 1.0) < 0.05
+    assert result.contributors == [f"n{i}" for i in range(7)]
+
+    with pytest.raises(ValueError, match="4f"):
+        bulyan(tree_stack([m.params for m in models[:5]]), n_byzantine=1)

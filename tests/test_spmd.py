@@ -215,3 +215,29 @@ def test_run_fused_rejects_per_round_election():
             fed.run_fused(2)
     finally:
         Settings.VOTE_EVERY_ROUND = False
+
+
+def test_spmd_bulyan_survives_byzantine_noise():
+    """Bulyan in the jitted round (iterated Krum + trimmed mean): 8 nodes,
+    1 Byzantine slot overwritten with large noise each round — training
+    still converges. K=8 satisfies N >= 4f+3 for f=1."""
+    fed = SpmdFederation.from_dataset(
+        mlp(), _dataset(), n_nodes=8, batch_size=64, vote=False,
+        aggregator="bulyan", trim=1,
+    )
+    key = jax.random.PRNGKey(0)
+    for _ in range(3):
+        key, sub = jax.random.split(key)  # fresh garbage every round
+        fed.params = jax.tree.map(
+            lambda x, sub=sub: x.at[:1].set(jax.random.normal(sub, x.shape[1:], x.dtype) * 10.0),
+            fed.params,
+        )
+        fed.run_round(epochs=1)
+    assert fed.evaluate()["test_acc"] > 0.8
+
+    with pytest.raises(ValueError, match="4f"):
+        bad = SpmdFederation.from_dataset(
+            mlp(), _dataset(), n_nodes=4, batch_size=64, vote=False,
+            aggregator="bulyan", trim=1,
+        )
+        bad.run_round()
