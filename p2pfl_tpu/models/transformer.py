@@ -286,13 +286,14 @@ def resolve_attention(
         return partial(
             flash_attention, causal=True, block_q=block, block_k=block, interpret=interpret
         )
-    if attn == "ring":
+    if attn in ("ring", "ring_flash"):
         if mesh is None:
-            raise ValueError("attn='ring' needs a mesh (sequence is sharded over it)")
+            raise ValueError(f"attn={attn!r} needs a mesh (sequence is sharded over it)")
         from p2pfl_tpu.ops.attention import ring_attention
 
-        return partial(ring_attention, mesh=mesh, axis_name=axis_name)
-    raise ValueError(f"unknown attention backend {attn!r} (dense|flash|ring)")
+        impl = "flash" if attn == "ring_flash" else "dense"
+        return partial(ring_attention, mesh=mesh, axis_name=axis_name, impl=impl, block=block)
+    raise ValueError(f"unknown attention backend {attn!r} (dense|flash|ring|ring_flash)")
 
 
 def tiny_transformer(
@@ -310,17 +311,27 @@ def tiny_transformer(
     """
     cfg = cfg or TransformerConfig()
     if attn_fn is None:
-        if seq_len <= 128:
-            block = seq_len  # block == T always satisfies the TPU tiling rule
+        # flash blocks must divide the attended length: the GLOBAL sequence
+        # for attn="flash", but the PER-DEVICE shard for "ring_flash" (each
+        # hop's kernel sees T_local)
+        basis = seq_len
+        if attn == "ring_flash":
+            if mesh is None:
+                raise ValueError("attn='ring_flash' needs a mesh")
+            from p2pfl_tpu.settings import Settings
+
+            basis = seq_len // mesh.shape[Settings.MESH_MODEL_AXIS]
+        if basis <= 128:
+            block = basis  # block == T always satisfies the TPU tiling rule
         else:
-            # blocks must divide T and (on TPU Mosaic) be a multiple of 8
+            # blocks must divide the basis and (on TPU Mosaic) be a multiple of 8
             block = next(
-                (b for b in range(128, 7, -1) if seq_len % b == 0 and b % 8 == 0), None
+                (b for b in range(128, 7, -1) if basis % b == 0 and b % 8 == 0), None
             )
-            if block is None and attn == "flash":
+            if block is None and attn in ("flash", "ring_flash"):
                 raise ValueError(
-                    f"attn='flash' needs seq_len with a divisor <=128 that is a "
-                    f"multiple of 8; seq_len={seq_len} has none (use attn='dense')"
+                    f"attn={attn!r} needs a length with a divisor <=128 that is "
+                    f"a multiple of 8; {basis} (seq_len per shard) has none"
                 )
         attn_fn = resolve_attention(attn, mesh=mesh, block=block)
     module = CausalLM(cfg, attn_fn)
