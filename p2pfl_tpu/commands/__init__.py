@@ -12,6 +12,7 @@ from p2pfl_tpu.commands.control import (
     ModelInitializedCommand,
     ModelsAggregatedCommand,
     ModelsReadyCommand,
+    SecAggPubCommand,
     VoteTrainSetCommand,
 )
 from p2pfl_tpu.commands.heartbeat import HeartbeatCommand
@@ -32,6 +33,7 @@ __all__ = [
     "ModelsAggregatedCommand",
     "ModelsReadyCommand",
     "MetricsCommand",
+    "SecAggPubCommand",
     "InitModelCommand",
     "AddModelCommand",
 ]

@@ -30,6 +30,40 @@ class ModelInitializedCommand(Command):
         self._state.nei_status[source] = -1
 
 
+class SecAggPubCommand(Command):
+    """Peer announced its DH public key for secure aggregation.
+
+    One hex arg; flooded over the message gossip at experiment start
+    (``learning/secagg.py``). No round check — keys are per-experiment.
+    """
+
+    def __init__(self, state: "NodeState") -> None:
+        self._state = state
+
+    @staticmethod
+    def get_name() -> str:
+        return "secagg_pub"
+
+    def execute(self, source: str, round: int, *args, **kwargs) -> None:  # noqa: A002
+        if not args:
+            logger.error(self._state.addr, f"Malformed secagg_pub from {source}: no key")
+            return
+        try:
+            pub = int(args[0], 16)
+        except ValueError:
+            logger.error(self._state.addr, f"Malformed secagg_pub from {source}: bad hex")
+            return
+        from p2pfl_tpu.learning.secagg import valid_public_key
+
+        if not valid_public_key(pub):
+            # 0/1/p-1 make the pair's shared secret trivially computable —
+            # an active attacker spoofing this message could strip the
+            # victim's masks; never store a degenerate key
+            logger.error(self._state.addr, f"Degenerate DH key from {source} — rejected")
+            return
+        self._state.secagg_pubs[source] = pub
+
+
 class VoteTrainSetCommand(Command):
     """Train-set vote: flat ``[name, weight, name, weight, ...]`` pairs.
 

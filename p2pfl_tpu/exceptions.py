@@ -30,5 +30,16 @@ class NeighborNotConnectedError(Exception):
     """Raised when sending to a neighbor that is not connected."""
 
 
+class SecAggError(Exception):
+    """Raised when a secure-aggregation contribution cannot be masked safely.
+
+    The caller must NOT fall back to sending the model unmasked: peers that
+    already derived this node's pair seeds would still add their half of the
+    pairwise masks, which then never cancel — silently turning the round's
+    aggregate into noise. Skipping the contribution instead leaves coverage
+    incomplete, which the aggregator detects and reports loudly.
+    """
+
+
 class CommunicationError(Exception):
     """Raised on transport-level send failures."""

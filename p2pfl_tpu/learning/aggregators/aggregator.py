@@ -180,6 +180,15 @@ class Aggregator:
                 self.node_name,
                 f"Aggregation timeout — proceeding with partial coverage {sorted(covered)} of {sorted(train)}",
             )
+            if Settings.SECURE_AGGREGATION and covered != train:
+                # pairwise masks only cancel over the FULL train set; the
+                # missing members' masks are still riding on this aggregate
+                logger.error(
+                    self.node_name,
+                    "SecAgg: partial coverage — unresolved pairwise masks, "
+                    "this round's aggregate is noise (dropout recovery is not "
+                    "implemented; see learning/secagg.py)",
+                )
         # a single model is returned as-is when (a) this node is waiting,
         # (b) the strategy is stateless, or (c) it is a full multi-node
         # aggregate a faster train-set peer diffused (already

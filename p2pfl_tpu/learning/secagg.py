@@ -1,0 +1,241 @@
+"""Secure aggregation: pairwise additive masking over the gossip overlay.
+
+The reference has no privacy layer — every gossiped payload is a node's raw
+model over an insecure channel (``p2pfl/communication/grpc/grpc_server.py``,
+insecure channels throughout). This module adds the classic
+pairwise-masking scheme (Bonawitz et al., CCS'17) adapted to p2p federated
+averaging:
+
+- every node derives one shared seed per train-set peer via Diffie-Hellman
+  over the existing message gossip (a single ``secagg_pub`` broadcast at
+  experiment start — RFC 3526 group-14 modular DH, no extra dependencies);
+- before contributing its model, each node adds a mask built from those
+  seeds: ``u_i = (c / w_i) * Σ_{j≠i} sign(i,j) · PRG(seed_ij, round)`` with
+  ``sign(i,j) = +1`` iff ``addr_i < addr_j`` — antisymmetric, so in the
+  sample-weighted FedAvg sum ``Σ w_i (p_i + u_i) = Σ w_i p_i`` the masks
+  cancel **exactly pairwise** (up to float32 rounding);
+- FedAvg's partial-aggregation algebra is linear in the weighted sums, so
+  masked partials combine correctly through every gossip hop; the true
+  model only materializes once the full train set is covered.
+
+What a wire snoop sees is a single masked model — Gaussian noise of scale
+``Settings.SECAGG_MASK_STD`` riding on the parameters, useless without the
+other train-set members' masks.
+
+Limits (documented, matching the protocol's nature):
+
+- FedAvg only: robust aggregators (Krum/median/...) need individual
+  models, which is exactly what masking forbids.
+- If aggregation times out with partial train-set coverage, the leftover
+  masks do NOT cancel and the round's aggregate is noise. The full
+  Bonawitz protocol adds a seed-recovery round for dropouts; here the
+  failure is detected (coverage < train set) and logged as an error —
+  availability degrades instead of privacy.
+- Control messages (votes, heartbeats, coverage) stay plaintext, like the
+  reference's insecure channels; the protected asset is the model payload.
+
+The SPMD mesh runtime (``parallel/spmd.py``) deliberately does not mask:
+it is a single-process simulation where "nodes" are device slots — there
+is no wire to protect, and the all-reduce is already the trusted
+aggregator. :func:`masked_stack` exposes the same masking as a pure jitted
+op for device-side verification (see ``tests/test_secagg.py``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import secrets
+from typing import Any, Optional
+
+import numpy as np
+
+from p2pfl_tpu.learning.weights import ModelUpdate, _flatten_named
+from p2pfl_tpu.management.logger import logger
+from p2pfl_tpu.settings import Settings
+
+Pytree = Any
+
+# RFC 3526 group 14: 2048-bit MODP prime, generator 2.
+DH_PRIME = int(
+    "FFFFFFFFFFFFFFFFC90FDAA22168C234C4C6628B80DC1CD129024E088A67CC74"
+    "020BBEA63B139B22514A08798E3404DDEF9519B3CD3A431B302B0A6DF25F1437"
+    "4FE1356D6D51C245E485B576625E7EC6F44C42E9A637ED6B0BFF5CB6F406B7ED"
+    "EE386BFB5A899FA5AE9F24117C4B1FE649286651ECE45B3DC2007CB8A163BF05"
+    "98DA48361C55D39A69163FA8FD24CF5F83655D23DCA3AD961C62F356208552BB"
+    "9ED529077096966D670C354E4ABC9804F1746C08CA18217C32905E462E36CE3B"
+    "E39E772C180E86039B2783A2EC07A28FB5C55DF06F4C52C9DE2BCBF695581718"
+    "3995497CEA956AE515D2261898FA051015728E5A8AACAA68FFFFFFFFFFFFFFFF",
+    16,
+)
+DH_GENERATOR = 2
+
+
+def dh_keypair() -> tuple[int, int]:
+    """A fresh (private, public) modular Diffie-Hellman pair."""
+    priv = secrets.randbits(256)
+    return priv, pow(DH_GENERATOR, priv, DH_PRIME)
+
+
+def valid_public_key(pub: int) -> bool:
+    """Range check for a peer's DH public key.
+
+    Rejects the degenerate elements 0, 1, p-1 (and anything out of range):
+    with pub=1 every shared secret is 1, so an active sender spoofing
+    ``secagg_pub`` messages could make a victim's mask seeds computable
+    from public information and strip its masks off the wire.
+    """
+    return 2 <= pub <= DH_PRIME - 2
+
+
+def dh_pair_seed(priv: int, peer_pub: int, context: str) -> int:
+    """The shared 63-bit PRG seed for one (self, peer) pair.
+
+    Symmetric: both ends compute ``g^(xy) mod p`` and hash it with the
+    experiment context, so seed(x, g^y) == seed(y, g^x).
+    """
+    if not valid_public_key(peer_pub):
+        from p2pfl_tpu.exceptions import SecAggError
+
+        raise SecAggError("degenerate DH public key (value outside [2, p-2])")
+    shared = pow(peer_pub, priv, DH_PRIME)
+    h = hashlib.sha256(shared.to_bytes(256, "big") + context.encode("utf-8"))
+    return int.from_bytes(h.digest()[:8], "big") >> 1  # non-negative int64
+
+
+def _leaf_mask(seed: int, round_no: int, shape: tuple, li: int) -> np.ndarray:
+    """Deterministic N(0,1) mask block — same stream on both ends of a pair.
+
+    Seeded by (pair seed, round, leaf index) so masks are fresh every round
+    (a reused mask would leak the round-to-round parameter delta).
+    """
+    rng = np.random.default_rng([seed, round_no, li])
+    return rng.standard_normal(size=shape, dtype=np.float32)
+
+
+def pairwise_mask(
+    template: Pytree,
+    my_addr: str,
+    pair_seeds: dict[str, int],
+    round_no: int,
+) -> dict[str, np.ndarray]:
+    """This node's total mask as a flat {path: array} dict.
+
+    ``Σ_i (w_i) · (c/w_i) · m_i`` over the full train set telescopes to zero
+    because each pair (i, j) contributes ``+PRG(seed_ij)`` on one side and
+    ``-PRG(seed_ij)`` on the other.
+    """
+    flat = _flatten_named(template)
+    keys = sorted(flat)
+    out: dict[str, np.ndarray] = {k: np.zeros(flat[k].shape, np.float32) for k in keys}
+    for peer, seed in pair_seeds.items():
+        sign = 1.0 if my_addr < peer else -1.0
+        for li, k in enumerate(keys):
+            out[k] += sign * _leaf_mask(seed, round_no, flat[k].shape, li)
+    return out
+
+
+def mask_update(
+    update: ModelUpdate,
+    my_addr: str,
+    train_set: list[str],
+    priv: int,
+    pubs: dict[str, int],
+    experiment: str,
+    round_no: int,
+) -> ModelUpdate:
+    """Mask a node's own contribution before it enters the aggregator.
+
+    Raises :class:`SecAggError` when masking cannot be done safely (missing
+    peer keys, zero sample weight, non-float32 parameters). The caller must
+    then SKIP contributing rather than send unmasked: peers already derived
+    this node's pair seeds and will add their half of the pairwise masks
+    regardless, so an unmasked (or zero-weighted, or rounding-lossy)
+    contribution leaves uncancelled mask terms in a full-coverage aggregate
+    — noise that nothing would detect. An aborted contribution instead
+    leaves coverage incomplete, which ``wait_and_get_aggregation`` reports
+    as a loud SecAgg error on every node.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from p2pfl_tpu.exceptions import SecAggError
+
+    peers = [n for n in train_set if n != my_addr]
+    if not peers:
+        return update
+    missing = [n for n in peers if n not in pubs]
+    if missing:
+        raise SecAggError(f"missing DH public keys for train-set peers {missing}")
+    if update.num_samples <= 0:
+        # FedAvg would weight this row by 0, annihilating our masks while
+        # peers' matching pair terms survive — cancellation breaks
+        raise SecAggError("cannot mask a contribution with zero sample weight")
+    bad_dtypes = {
+        str(jnp.asarray(leaf).dtype)
+        for leaf in jax.tree_util.tree_leaves(update.params)
+        if jnp.asarray(leaf).dtype != jnp.float32
+    }
+    if bad_dtypes:
+        # mask cancellation is exact only in float32: casting params+mask to
+        # a narrower dtype (bf16 has an 8-bit mantissa) quantizes each
+        # node's mask independently, and the rounding residue — ~0.4% of
+        # the mask's magnitude, i.e. comparable to the weights themselves —
+        # survives the FedAvg sum
+        raise SecAggError(
+            f"params contain {sorted(bad_dtypes)} leaves; secure aggregation "
+            "requires float32 parameters (use param_dtype=float32 — bf16 "
+            "compute is unaffected)"
+        )
+    seeds = {n: dh_pair_seed(priv, pubs[n], experiment) for n in peers}
+    masks = pairwise_mask(update.params, my_addr, seeds, round_no)
+    scale = Settings.SECAGG_MASK_STD / float(update.num_samples)
+
+    leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(update.params)
+    from p2pfl_tpu.learning.weights import _SEP, _path_part
+
+    new_leaves = []
+    for path, leaf in leaves_with_path:
+        key = _SEP.join(_path_part(p) for p in path)
+        new_leaves.append(
+            (jnp.asarray(leaf, jnp.float32) + scale * masks[key]).astype(jnp.asarray(leaf).dtype)
+        )
+    masked = jax.tree_util.tree_unflatten(treedef, new_leaves)
+    return ModelUpdate(masked, list(update.contributors), update.num_samples)
+
+
+def masked_stack(params_stack: Pytree, weights, key, scale: float = None) -> Pytree:
+    """Device-side pairwise masking of a node-stacked ``[N, ...]`` pytree.
+
+    Pure jitted op mirroring the host protocol's math: per-pair N(0,1)
+    blocks from ``jax.random.fold_in``, antisymmetric signs, each node's
+    mask scaled by ``c / w_i`` — so the sample-weighted FedAvg of the
+    result equals that of the input (to float32 rounding). Used to verify
+    cancellation on an 8-device mesh without any wire.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if scale is None:
+        scale = Settings.SECAGG_MASK_STD
+    n = weights.shape[0]
+
+    def node_mask(i, leaf_key, shape):
+        def pair(j):
+            lo, hi = jnp.minimum(i, j), jnp.maximum(i, j)
+            pk = jax.random.fold_in(jax.random.fold_in(leaf_key, lo), hi)
+            sign = jnp.where(i < j, 1.0, -1.0) * jnp.where(i == j, 0.0, 1.0)
+            return sign * jax.random.normal(pk, shape, jnp.float32)
+
+        return sum(pair(jnp.uint32(j)) for j in range(n))
+
+    def mask_leaf(li_key, leaf):
+        per_node = jax.vmap(
+            lambda i: node_mask(i, li_key, leaf.shape[1:]) * (scale / weights[i])
+        )(jnp.arange(n, dtype=jnp.uint32))
+        return (leaf.astype(jnp.float32) + per_node).astype(leaf.dtype)
+
+    leaves, treedef = jax.tree_util.tree_flatten(params_stack)
+    keys = jax.random.split(key, len(leaves))
+    return jax.tree_util.tree_unflatten(
+        treedef, [mask_leaf(k, leaf) for k, leaf in zip(keys, leaves)]
+    )

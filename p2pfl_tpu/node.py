@@ -18,6 +18,7 @@ from p2pfl_tpu.commands import (
     ModelInitializedCommand,
     ModelsAggregatedCommand,
     ModelsReadyCommand,
+    SecAggPubCommand,
     StartLearningCommand,
     StopLearningCommand,
     VoteTrainSetCommand,
@@ -81,6 +82,7 @@ class Node:
             ModelsAggregatedCommand(self.state),
             ModelsReadyCommand(self.state),
             MetricsCommand(self.state),
+            SecAggPubCommand(self.state),
             InitModelCommand(self),
             AddModelCommand(self),
         ):

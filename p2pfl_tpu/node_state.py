@@ -32,6 +32,11 @@ class NodeState:
         self.train_set: List[str] = []
         self.train_set_votes: Dict[str, Dict[str, int]] = {}
 
+        # secure aggregation (learning/secagg.py): this node's DH private key
+        # for the current experiment + peers' announced public keys
+        self.secagg_priv: Optional[int] = None
+        self.secagg_pubs: Dict[str, int] = {}
+
         # monotonically counts experiments entered; lets harnesses distinguish
         # "never started" from "finished" (both have round None)
         self.experiment_epoch = 0
@@ -67,5 +72,7 @@ class NodeState:
         self.nei_status = {}
         self.train_set = []
         self.train_set_votes = {}
+        self.secagg_priv = None
+        self.secagg_pubs = {}
         self.votes_ready_event.clear()
         self.model_initialized_event.clear()

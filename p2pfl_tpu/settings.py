@@ -60,6 +60,14 @@ class Settings:
     # (int8 = symmetric per-tensor quantization, 4x smaller gossip payloads,
     # native C++ hot loop when p2pfl_tpu/native is built).
     WIRE_COMPRESSION: str = "none"
+    # Secure aggregation (pairwise masking, learning/secagg.py): when True,
+    # train-set nodes Diffie-Hellman a seed per peer at experiment start and
+    # mask their model contribution; masks cancel in the FedAvg sum, so no
+    # individual model ever crosses the wire in the clear. FedAvg only.
+    SECURE_AGGREGATION: bool = False
+    # Std-dev of the pairwise Gaussian masks (before the 1/num_samples
+    # weighting) — large enough to drown the parameters themselves.
+    SECAGG_MASK_STD: float = 100.0
 
 
 def set_test_settings() -> None:

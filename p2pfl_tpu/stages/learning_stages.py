@@ -36,6 +36,16 @@ class StartLearningStage(Stage):
         node.learner.set_epochs(node.epochs)
         node.learner.set_addr(node.addr)
 
+        if Settings.SECURE_AGGREGATION:
+            # announce this experiment's DH public key so any later train
+            # set can derive pairwise mask seeds (learning/secagg.py)
+            from p2pfl_tpu.learning import secagg
+
+            state.secagg_priv, pub = secagg.dh_keypair()
+            node.protocol.broadcast(
+                node.protocol.build_msg("secagg_pub", [f"{pub:x}"], round=0)
+            )
+
         # wait for initial weights: the initiator's event was set by
         # set_start_learning(); everyone else blocks until init_model arrives
         # (reference blocks on model_initialized_lock, start_learning_stage.py:78)
@@ -159,17 +169,57 @@ class TrainStage(Stage):
         if node.learning_interrupted():
             return None
 
-        # contribute own model
+        # contribute own model (masked when secure aggregation is on)
         own = node.learner.get_model_update()
-        covered = node.aggregator.add_model(own)
-        node.protocol.broadcast(
-            node.protocol.build_msg("models_aggregated", covered, round=state.round or 0)
-        )
+        if Settings.SECURE_AGGREGATION and len(state.train_set) > 1:
+            own = TrainStage._secagg_mask(node, own)
+        if own is not None:
+            covered = node.aggregator.add_model(own)
+            node.protocol.broadcast(
+                node.protocol.build_msg("models_aggregated", covered, round=state.round or 0)
+            )
 
         TrainStage._gossip_partial_aggregations(node)
         if node.learning_interrupted():
             return None
         return GossipModelStage
+
+    @staticmethod
+    def _secagg_mask(node: "Node", own):
+        """Pairwise-mask the node's contribution (``learning/secagg.py``).
+
+        Peers' DH keys were flooded at experiment start; a short poll covers
+        gossip propagation lag. If masking still cannot be done safely,
+        returns None — the contribution is SKIPPED, never sent unmasked
+        (peers' halves of the pairwise masks would go uncancelled and turn a
+        full-coverage aggregate into undetected noise; incomplete coverage
+        is detected and reported by ``wait_and_get_aggregation`` instead).
+        """
+        from p2pfl_tpu.exceptions import SecAggError
+        from p2pfl_tpu.learning import secagg
+
+        state = node.state
+        peers = [n for n in state.train_set if n != node.addr]
+        deadline = time.monotonic() + Settings.VOTE_TIMEOUT
+        while (
+            any(n not in state.secagg_pubs for n in peers)
+            and time.monotonic() < deadline
+            and not node.learning_interrupted()
+        ):
+            time.sleep(0.1)
+        try:
+            return secagg.mask_update(
+                own,
+                node.addr,
+                state.train_set,
+                state.secagg_priv,
+                dict(state.secagg_pubs),
+                state.experiment_name or "",
+                state.round or 0,
+            )
+        except SecAggError as exc:
+            logger.error(node.addr, f"SecAgg: {exc} — skipping this round's contribution")
+            return None
 
     @staticmethod
     def _evaluate(node: "Node") -> None:
