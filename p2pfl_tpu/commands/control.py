@@ -31,10 +31,11 @@ class ModelInitializedCommand(Command):
 
 
 class SecAggPubCommand(Command):
-    """Peer announced its DH public key for secure aggregation.
+    """Peer announced its DH public key + sample count for secure aggregation.
 
-    One hex arg; flooded over the message gossip at experiment start
-    (``learning/secagg.py``). No round check — keys are per-experiment.
+    Args: ``[pub_hex, num_samples]``; flooded over the message gossip at
+    experiment start (``learning/secagg.py`` — the sample counts set the
+    pairwise mask scales). No round check — keys are per-experiment.
     """
 
     def __init__(self, state: "NodeState") -> None:
@@ -45,13 +46,14 @@ class SecAggPubCommand(Command):
         return "secagg_pub"
 
     def execute(self, source: str, round: int, *args, **kwargs) -> None:  # noqa: A002
-        if not args:
-            logger.error(self._state.addr, f"Malformed secagg_pub from {source}: no key")
+        if len(args) < 2:
+            logger.error(self._state.addr, f"Malformed secagg_pub from {source}: need key + samples")
             return
         try:
             pub = int(args[0], 16)
+            samples = int(args[1])
         except ValueError:
-            logger.error(self._state.addr, f"Malformed secagg_pub from {source}: bad hex")
+            logger.error(self._state.addr, f"Malformed secagg_pub from {source}: bad values")
             return
         from p2pfl_tpu.learning.secagg import valid_public_key
 
@@ -61,7 +63,10 @@ class SecAggPubCommand(Command):
             # victim's masks; never store a degenerate key
             logger.error(self._state.addr, f"Degenerate DH key from {source} — rejected")
             return
-        self._state.secagg_pubs[source] = pub
+        if samples <= 0:
+            logger.error(self._state.addr, f"Non-positive sample count from {source} — rejected")
+            return
+        self._state.secagg_pubs[source] = (pub, samples)
 
 
 class VoteTrainSetCommand(Command):

@@ -11,7 +11,7 @@ from __future__ import annotations
 from typing import TYPE_CHECKING
 
 from p2pfl_tpu.commands.command import Command
-from p2pfl_tpu.exceptions import DecodingParamsError, ModelNotMatchingError
+from p2pfl_tpu.exceptions import AnchorMismatchError, DecodingParamsError, ModelNotMatchingError
 from p2pfl_tpu.learning.weights import ModelUpdate
 from p2pfl_tpu.management.logger import logger
 
@@ -104,6 +104,12 @@ class AddModelCommand(Command):
             if update.params is None:
                 update = node.learner.materialize(update)
             covered = node.aggregator.add_model(update)
+        except AnchorMismatchError as exc:
+            # a delta-coded payload against an anchor we don't hold (we are
+            # a round behind/ahead of the sender): skip it and wait for one
+            # we can reconstruct — NOT fatal, unlike a corrupt payload
+            logger.info(state.addr, f"add_model from {source} skipped: {exc}")
+            return
         except (DecodingParamsError, ModelNotMatchingError) as exc:
             logger.error(state.addr, f"add_model decode failed: {exc} — stopping node")
             node.stop_async()

@@ -30,6 +30,17 @@ class NeighborNotConnectedError(Exception):
     """Raised when sending to a neighbor that is not connected."""
 
 
+class AnchorMismatchError(Exception):
+    """Raised when a delta-coded (topk8) payload references a different
+    round-start anchor than the receiver holds.
+
+    NOT a fatal decode error: the receiver ignores the update and waits for
+    one it can reconstruct (a stale node catches up via a later dense or
+    matching-anchor payload), unlike :class:`DecodingParamsError` which
+    stops the node (reference ``add_model_command.py:96-104``).
+    """
+
+
 class SecAggError(Exception):
     """Raised when a secure-aggregation contribution cannot be masked safely.
 

@@ -41,6 +41,11 @@ class Aggregator:
     #: exactly once per round even when a single update covers the train set
     #: (the single-model shortcut would skip the server step).
     ALWAYS_AGGREGATE: bool = False
+    #: True only for strategies that are linear in the contributions, so
+    #: secure-aggregation pairwise masks cancel through them
+    #: (``learning/secagg.py``). Robust strategies inspect individual
+    #: models and would operate on masked noise.
+    MASK_COMPATIBLE: bool = False
 
     def __init__(self, node_name: str = "unknown") -> None:
         self.node_name = node_name
@@ -198,7 +203,20 @@ class Aggregator:
             waiting or not self.ALWAYS_AGGREGATE or len(models[0].contributors) > 1
         ):
             return self.on_result(models[0])
-        return self.aggregate(models)
+        return self._inherit_anchor(self.aggregate(models), models)
+
+    @staticmethod
+    def _inherit_anchor(result: ModelUpdate, models: list[ModelUpdate]) -> ModelUpdate:
+        """Carry the delta-coding anchor through aggregation.
+
+        All of a round's updates share one anchor (the round-start global,
+        ``learning/weights.py`` topk8), so a fresh aggregate re-encodes
+        against the same anchor when it goes back on the wire.
+        """
+        if result.anchor is None and models and models[0].anchor is not None:
+            result.anchor = models[0].anchor
+            result.anchor_tag = models[0].anchor_tag
+        return result
 
     def on_result(self, update: ModelUpdate) -> ModelUpdate:
         """Hook: the round resolved to ``update`` WITHOUT this node running
@@ -219,7 +237,7 @@ class Aggregator:
             return todo[0]
         if not self.SUPPORTS_PARTIALS:
             return None
-        return self.aggregate(todo)
+        return self._inherit_anchor(self.aggregate(todo), todo)
 
     def get_models_to_send(self, except_nodes: list[str]) -> list[ModelUpdate]:
         """Payloads to gossip to a peer that already covers ``except_nodes``.
@@ -232,7 +250,7 @@ class Aggregator:
         if not todo:
             return []
         if self.SUPPORTS_PARTIALS and len(todo) > 1:
-            return [self.aggregate(todo)]
+            return [self._inherit_anchor(self.aggregate(todo), todo)]
         return todo
 
     def _models_not_covered(self, except_nodes: list[str]) -> list[ModelUpdate]:

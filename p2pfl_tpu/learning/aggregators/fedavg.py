@@ -17,6 +17,7 @@ from p2pfl_tpu.settings import Settings
 
 class FedAvg(Aggregator):
     SUPPORTS_PARTIALS = True
+    MASK_COMPATIBLE = True  # linear: secagg pairwise masks cancel through it
 
     def aggregate(self, models: list[ModelUpdate]) -> ModelUpdate:
         stacked = tree_stack([m.params for m in models])

@@ -70,15 +70,53 @@ class NodeLearner(ABC):
         self.addr = addr
 
     def get_model_update(self) -> ModelUpdate:
-        return ModelUpdate(self.get_parameters(), [self.addr], self.get_num_samples())
+        update = ModelUpdate(self.get_parameters(), [self.addr], self.get_num_samples())
+        anchor = getattr(self, "_wire_anchor", None)
+        if anchor is not None:
+            update.anchor = anchor
+            update.anchor_tag = getattr(self, "_wire_anchor_tag", None)
+        return update
+
+    def set_wire_anchor(self, params, tag: str) -> None:
+        """Pin the round-start global model as the delta-coding anchor.
+
+        Called by the stages at the two points where every node holds the
+        round's shared model (after init-weights sync, and at each round
+        boundary) — see ``learning/weights.py`` topk8. ``tag`` is the round
+        identity (``"experiment_epoch:round"``) that both ends of a
+        delta-coded transfer must agree on.
+        """
+        from p2pfl_tpu.settings import Settings
+
+        if Settings.WIRE_COMPRESSION != "topk8":
+            self._wire_anchor = None
+            return
+        self._wire_anchor = params
+        self._wire_anchor_tag = tag
+
+    def ef_residual_store(self) -> dict:
+        """The node's error-feedback residual ({path: dropped delta mass}).
+
+        Attached by TrainStage to the node's OWN contribution only — it
+        must accumulate exactly one encode per round.
+        """
+        if not hasattr(self, "_ef_residual"):
+            self._ef_residual = {}
+        return self._ef_residual
 
     def materialize(self, update: ModelUpdate) -> ModelUpdate:
         """Decode a wire payload against this learner's parameter structure."""
         if update.params is not None:
             return update
-        flat = decode_params(update.encoded)
+        anchor = getattr(self, "_wire_anchor", None)
+        tag = getattr(self, "_wire_anchor_tag", None)
+        flat = decode_params(update.encoded, anchor=anchor, anchor_tag=tag)
         params = restore_like(self.get_parameters(), flat)
-        return ModelUpdate(params, update.contributors, update.num_samples)
+        out = ModelUpdate(params, update.contributors, update.num_samples)
+        # relays re-encode fresh aggregates against the same shared anchor
+        out.anchor = anchor
+        out.anchor_tag = tag
+        return out
 
 
 # ---- pure jitted steps (module-level => shared jit cache) ----

@@ -10,10 +10,15 @@ averaging:
   over the existing message gossip (a single ``secagg_pub`` broadcast at
   experiment start — RFC 3526 group-14 modular DH, no extra dependencies);
 - before contributing its model, each node adds a mask built from those
-  seeds: ``u_i = (c / w_i) * Σ_{j≠i} sign(i,j) · PRG(seed_ij, round)`` with
-  ``sign(i,j) = +1`` iff ``addr_i < addr_j`` — antisymmetric, so in the
-  sample-weighted FedAvg sum ``Σ w_i (p_i + u_i) = Σ w_i p_i`` the masks
-  cancel **exactly pairwise** (up to float32 rounding);
+  seeds: ``u_i = Σ_{j≠i} sign(i,j) · (s_ij / w_i) · PRG(seed_ij, round)``
+  with pair scale ``s_ij = SECAGG_MASK_STD · sqrt(w_i · w_j)`` (sample
+  counts are announced alongside the DH keys) and ``sign(i,j) = +1`` iff
+  ``addr_i < addr_j`` — antisymmetric, so in the sample-weighted FedAvg
+  sum ``Σ w_i (p_i + u_i) = Σ w_i p_i`` the masks cancel **exactly
+  pairwise** (up to float32 rounding). The sqrt law keeps the mask's
+  magnitude ``STD · sqrt(w_j / w_i)`` per pair — independent of the
+  absolute dataset size, unlike a naive ``c / w_i`` scale that would leave
+  large-dataset nodes effectively unmasked;
 - FedAvg's partial-aggregation algebra is linear in the weighted sums, so
   masked partials combine correctly through every gossip hop; the true
   model only materializes once the full train set is covered.
@@ -33,6 +38,13 @@ Limits (documented, matching the protocol's nature):
   availability degrades instead of privacy.
 - Control messages (votes, heartbeats, coverage) stay plaintext, like the
   reference's insecure channels; the protected asset is the model payload.
+- Wire compression must be off (``WIRE_COMPRESSION="none"``): per-node
+  quantization of the masks breaks exact cancellation. Checked at
+  experiment start.
+- A node holding the overwhelming majority of the federation's samples
+  gets a small mask (``STD·sqrt((W−w_i)/w_i)``) — but such a node's update
+  IS essentially the aggregate, so aggregation itself offers it no privacy
+  regardless of masking.
 
 The SPMD mesh runtime (``parallel/spmd.py``) deliberately does not mask:
 it is a single-process simulation where "nodes" are device slots — there
@@ -117,20 +129,23 @@ def pairwise_mask(
     my_addr: str,
     pair_seeds: dict[str, int],
     round_no: int,
+    pair_scales: Optional[dict[str, float]] = None,
 ) -> dict[str, np.ndarray]:
     """This node's total mask as a flat {path: array} dict.
 
-    ``Σ_i (w_i) · (c/w_i) · m_i`` over the full train set telescopes to zero
-    because each pair (i, j) contributes ``+PRG(seed_ij)`` on one side and
-    ``-PRG(seed_ij)`` on the other.
+    The weighted sum over the full train set telescopes to zero because
+    each pair (i, j) contributes ``+s_ij·PRG(seed_ij)`` on one side and
+    ``-s_ij·PRG(seed_ij)`` on the other (``pair_scales[j] = s_ij``, the
+    SAME value on both ends).
     """
     flat = _flatten_named(template)
     keys = sorted(flat)
     out: dict[str, np.ndarray] = {k: np.zeros(flat[k].shape, np.float32) for k in keys}
     for peer, seed in pair_seeds.items():
         sign = 1.0 if my_addr < peer else -1.0
+        s = 1.0 if pair_scales is None else pair_scales[peer]
         for li, k in enumerate(keys):
-            out[k] += sign * _leaf_mask(seed, round_no, flat[k].shape, li)
+            out[k] += (sign * s) * _leaf_mask(seed, round_no, flat[k].shape, li)
     return out
 
 
@@ -139,21 +154,25 @@ def mask_update(
     my_addr: str,
     train_set: list[str],
     priv: int,
-    pubs: dict[str, int],
+    pubs: dict[str, tuple[int, int]],
     experiment: str,
     round_no: int,
 ) -> ModelUpdate:
     """Mask a node's own contribution before it enters the aggregator.
 
+    ``pubs`` maps peer address → (DH public key, announced sample count);
+    the pair scale ``s_ij = STD·sqrt(w_i·w_j)`` needs both ends' counts.
+
     Raises :class:`SecAggError` when masking cannot be done safely (missing
-    peer keys, zero sample weight, non-float32 parameters). The caller must
-    then SKIP contributing rather than send unmasked: peers already derived
-    this node's pair seeds and will add their half of the pairwise masks
-    regardless, so an unmasked (or zero-weighted, or rounding-lossy)
-    contribution leaves uncancelled mask terms in a full-coverage aggregate
-    — noise that nothing would detect. An aborted contribution instead
-    leaves coverage incomplete, which ``wait_and_get_aggregation`` reports
-    as a loud SecAgg error on every node.
+    peer keys, zero sample weight, non-float32 parameters, lossy wire
+    compression). The caller must then SKIP contributing rather than send
+    unmasked: peers already derived this node's pair seeds and will add
+    their half of the pairwise masks regardless, so an unmasked (or
+    zero-weighted, or rounding-lossy) contribution leaves uncancelled mask
+    terms in a full-coverage aggregate — noise that nothing would detect.
+    An aborted contribution instead leaves coverage incomplete, which
+    ``wait_and_get_aggregation`` reports as a loud SecAgg error on every
+    node.
     """
     import jax
     import jax.numpy as jnp
@@ -163,6 +182,14 @@ def mask_update(
     peers = [n for n in train_set if n != my_addr]
     if not peers:
         return update
+    if Settings.WIRE_COMPRESSION != "none":
+        # int8/topk8 would quantize each node's masks independently; the
+        # per-node quantization residue survives the FedAvg sum exactly
+        # like the bf16 rounding residue rejected below
+        raise SecAggError(
+            f"WIRE_COMPRESSION={Settings.WIRE_COMPRESSION!r} breaks mask "
+            "cancellation; secure aggregation needs a lossless wire"
+        )
     missing = [n for n in peers if n not in pubs]
     if missing:
         raise SecAggError(f"missing DH public keys for train-set peers {missing}")
@@ -170,6 +197,8 @@ def mask_update(
         # FedAvg would weight this row by 0, annihilating our masks while
         # peers' matching pair terms survive — cancellation breaks
         raise SecAggError("cannot mask a contribution with zero sample weight")
+    if any(w <= 0 for _p, w in pubs.values()):
+        raise SecAggError("a peer announced a non-positive sample count")
     bad_dtypes = {
         str(jnp.asarray(leaf).dtype)
         for leaf in jax.tree_util.tree_leaves(update.params)
@@ -186,9 +215,15 @@ def mask_update(
             "requires float32 parameters (use param_dtype=float32 — bf16 "
             "compute is unaffected)"
         )
-    seeds = {n: dh_pair_seed(priv, pubs[n], experiment) for n in peers}
-    masks = pairwise_mask(update.params, my_addr, seeds, round_no)
-    scale = Settings.SECAGG_MASK_STD / float(update.num_samples)
+    w_i = float(update.num_samples)
+    seeds = {n: dh_pair_seed(priv, pubs[n][0], experiment) for n in peers}
+    # s_ij/w_i with s_ij = STD·sqrt(w_i·w_j): per-pair magnitude
+    # STD·sqrt(w_j/w_i), never vanishing with absolute dataset size
+    scales = {
+        n: Settings.SECAGG_MASK_STD * float(np.sqrt(w_i * float(pubs[n][1]))) / w_i
+        for n in peers
+    }
+    masks = pairwise_mask(update.params, my_addr, seeds, round_no, scales)
 
     leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(update.params)
     from p2pfl_tpu.learning.weights import _SEP, _path_part
@@ -196,9 +231,7 @@ def mask_update(
     new_leaves = []
     for path, leaf in leaves_with_path:
         key = _SEP.join(_path_part(p) for p in path)
-        new_leaves.append(
-            (jnp.asarray(leaf, jnp.float32) + scale * masks[key]).astype(jnp.asarray(leaf).dtype)
-        )
+        new_leaves.append(jnp.asarray(leaf, jnp.float32) + masks[key])
     masked = jax.tree_util.tree_unflatten(treedef, new_leaves)
     return ModelUpdate(masked, list(update.contributors), update.num_samples)
 
@@ -207,10 +240,11 @@ def masked_stack(params_stack: Pytree, weights, key, scale: float = None) -> Pyt
     """Device-side pairwise masking of a node-stacked ``[N, ...]`` pytree.
 
     Pure jitted op mirroring the host protocol's math: per-pair N(0,1)
-    blocks from ``jax.random.fold_in``, antisymmetric signs, each node's
-    mask scaled by ``c / w_i`` — so the sample-weighted FedAvg of the
-    result equals that of the input (to float32 rounding). Used to verify
-    cancellation on an 8-device mesh without any wire.
+    blocks from ``jax.random.fold_in``, antisymmetric signs, pair scale
+    ``scale·sqrt(w_i·w_j)`` applied as ``s_ij/w_i`` on node i — so the
+    sample-weighted FedAvg of the result equals that of the input (to
+    float32 rounding) while every node's mask magnitude stays O(scale).
+    Used to verify cancellation on an 8-device mesh without any wire.
     """
     import jax
     import jax.numpy as jnp
@@ -224,13 +258,14 @@ def masked_stack(params_stack: Pytree, weights, key, scale: float = None) -> Pyt
             lo, hi = jnp.minimum(i, j), jnp.maximum(i, j)
             pk = jax.random.fold_in(jax.random.fold_in(leaf_key, lo), hi)
             sign = jnp.where(i < j, 1.0, -1.0) * jnp.where(i == j, 0.0, 1.0)
-            return sign * jax.random.normal(pk, shape, jnp.float32)
+            s = scale * jnp.sqrt(weights[i] * weights[j]) / weights[i]
+            return (sign * s) * jax.random.normal(pk, shape, jnp.float32)
 
         return sum(pair(jnp.uint32(j)) for j in range(n))
 
     def mask_leaf(li_key, leaf):
         per_node = jax.vmap(
-            lambda i: node_mask(i, li_key, leaf.shape[1:]) * (scale / weights[i])
+            lambda i: node_mask(i, li_key, leaf.shape[1:])
         )(jnp.arange(n, dtype=jnp.uint32))
         return (leaf.astype(jnp.float32) + per_node).astype(leaf.dtype)
 

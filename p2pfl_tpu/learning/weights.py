@@ -26,7 +26,7 @@ from typing import Any, Optional
 import jax
 import numpy as np
 
-from p2pfl_tpu.exceptions import DecodingParamsError, ModelNotMatchingError
+from p2pfl_tpu.exceptions import AnchorMismatchError, DecodingParamsError, ModelNotMatchingError
 
 Pytree = Any
 
@@ -34,6 +34,17 @@ _MAGIC = b"P2TW"  # p2pfl-tpu weights
 _VERSION = 1
 
 _SEP = "/"
+
+
+def anchor_digest(tree: Pytree) -> int:
+    """CRC32C over a pytree's canonical buffer order (sorted paths)."""
+    from p2pfl_tpu import native
+
+    flat = _flatten_named(tree)
+    crc = 0
+    for key in sorted(flat):
+        crc = native.crc32c(np.ascontiguousarray(flat[key]).tobytes(), crc)
+    return crc
 
 
 def _flatten_named(tree: Pytree) -> dict[str, np.ndarray]:
@@ -55,13 +66,37 @@ def _path_part(p) -> str:
     return str(p)
 
 
-def encode_params(tree: Pytree, compression: Optional[str] = None) -> bytes:
+def encode_params(
+    tree: Pytree,
+    compression: Optional[str] = None,
+    anchor: Optional[Pytree] = None,
+    anchor_tag: Optional[str] = None,
+    residual: Optional[dict] = None,
+) -> bytes:
     """Serialize a params pytree to the self-describing wire format.
 
     ``compression="int8"`` quantizes float tensors symmetrically per-tensor
     (4x smaller payloads; native C++ hot loop in ``p2pfl_tpu/native`` when
     built). Every payload carries a CRC32C over the tensor bytes; decoding
     verifies it.
+
+    ``compression="topk8"`` delta-codes against ``anchor`` (the round-start
+    global model): per float tensor, keep the top
+    ``Settings.TOPK_FRACTION`` coordinates of ``params − anchor`` by
+    magnitude, int8-quantized, shipped as (uint32 index, int8 value) pairs
+    — ~``0.05 × 5/4`` of the dense float32 bytes at the default fraction.
+    ``anchor_tag`` (the round identity ``"epoch:round"``, pinned by the
+    stages) rides in the header: the receiver accepts the delta only when
+    its own anchor carries the same tag. Anchors of the same round are NOT
+    bit-identical across nodes — each node folds its OWN params losslessly
+    but its peers' through the lossy wire — so reconstruction tolerates a
+    small anchor divergence (same order as the int8 quantization error);
+    the tag catches the catastrophic case, delta-coding against a
+    different round's model. With no anchor (e.g. the round-0 init model)
+    the tensor falls back to dense int8. ``residual`` (a mutable
+    {path: np.ndarray} dict) enables error feedback: the coordinates a
+    round drops are added back into the next round's delta instead of
+    being lost (Seide et al. 2014; Karimireddy et al. 2019).
     """
     from p2pfl_tpu import native
 
@@ -69,6 +104,11 @@ def encode_params(tree: Pytree, compression: Optional[str] = None) -> bytes:
         from p2pfl_tpu.settings import Settings
 
         compression = Settings.WIRE_COMPRESSION
+    if compression == "topk8":
+        from p2pfl_tpu.settings import Settings as _S
+
+        topk_frac = _S.TOPK_FRACTION
+    anchor_flat = _flatten_named(anchor) if anchor is not None else None
     flat = _flatten_named(tree)
     entries = []
     buffers = []
@@ -76,7 +116,35 @@ def encode_params(tree: Pytree, compression: Optional[str] = None) -> bytes:
     for key in sorted(flat):
         arr = flat[key]
         entry = {"k": key, "shape": list(arr.shape), "dtype": arr.dtype.name}
-        if compression == "int8" and arr.dtype.kind == "f":
+        use_topk = (
+            compression == "topk8"
+            and arr.dtype.kind == "f"
+            and anchor_flat is not None
+            and key in anchor_flat
+            and arr.size > 16  # tiny tensors: index overhead beats the savings
+        )
+        if use_topk:
+            delta = np.asarray(arr, np.float32).ravel() - np.asarray(
+                anchor_flat[key], np.float32
+            ).ravel()
+            if residual is not None and key in residual:
+                delta = delta + residual[key]
+            k = max(1, int(np.ceil(arr.size * topk_frac)))
+            idx = np.argpartition(np.abs(delta), -k)[-k:].astype(np.uint32)
+            idx.sort()
+            vals = delta[idx]
+            q, scale = native.quantize(vals)
+            if residual is not None:
+                # error feedback: what this payload fails to carry (dropped
+                # coordinates + quantization error) feeds the next round
+                sent = np.zeros_like(delta)
+                sent[idx] = native.dequantize(q, scale)
+                residual[key] = delta - sent
+            buf = idx.tobytes() + q.tobytes()
+            entry["enc"] = "tk8"
+            entry["scale"] = scale
+            entry["nnz"] = int(k)
+        elif compression in ("int8", "topk8") and arr.dtype.kind == "f":
             q, scale = native.quantize(np.asarray(arr, dtype=np.float32))
             buf = q.tobytes()
             entry["enc"] = "i8"
@@ -87,7 +155,10 @@ def encode_params(tree: Pytree, compression: Optional[str] = None) -> bytes:
         crc = native.crc32c(buf, crc)
         entries.append(entry)
         buffers.append(buf)
-    header = json.dumps({"v": _VERSION, "t": entries, "crc": crc}).encode("utf-8")
+    head = {"v": _VERSION, "t": entries, "crc": crc}
+    if any(e.get("enc") == "tk8" for e in entries):
+        head["anchor_tag"] = anchor_tag if anchor_tag is not None else ""
+    header = json.dumps(head).encode("utf-8")
     out = bytearray()
     out += _MAGIC
     out += struct.pack("<I", len(header))
@@ -97,8 +168,20 @@ def encode_params(tree: Pytree, compression: Optional[str] = None) -> bytes:
     return bytes(out)
 
 
-def decode_params(payload: bytes) -> dict[str, np.ndarray]:
-    """Decode the wire format to a flat ``{path: array}`` dict."""
+def decode_params(
+    payload: bytes,
+    anchor: Optional[Pytree] = None,
+    anchor_tag: Optional[str] = None,
+) -> dict[str, np.ndarray]:
+    """Decode the wire format to a flat ``{path: array}`` dict.
+
+    Delta-coded (``tk8``) payloads require an ``anchor`` whose round
+    identity matches the header's ``anchor_tag``; a mismatch raises
+    :class:`AnchorMismatchError` — reconstructing against a different
+    round's model would yield silently wrong parameters. Same-round
+    anchors may differ slightly across nodes (see :func:`encode_params`);
+    that divergence is part of the codec's loss budget.
+    """
     try:
         if payload[:4] != _MAGIC:
             raise DecodingParamsError("bad magic — not a p2pfl_tpu weights payload")
@@ -108,19 +191,50 @@ def decode_params(payload: bytes) -> dict[str, np.ndarray]:
             raise DecodingParamsError(f"unsupported weights version {header['v']}")
         from p2pfl_tpu import native
 
+        anchor_flat = None
+        if "anchor_tag" in header:
+            if anchor is None:
+                raise AnchorMismatchError(
+                    "payload is delta-coded (topk8) but no anchor is available"
+                )
+            if (anchor_tag or "") != header["anchor_tag"]:
+                raise AnchorMismatchError(
+                    f"anchor round mismatch (local {anchor_tag!r} != payload "
+                    f"{header['anchor_tag']!r}) — sender delta-coded against a "
+                    "different round's model"
+                )
+            anchor_flat = _flatten_named(anchor)
+
         flat = {}
         off = 8 + hlen
         crc = 0
         for e in header["t"]:
             dtype = _resolve_dtype(e["dtype"])
             count = int(np.prod(e["shape"], dtype=np.int64)) if e["shape"] else 1
-            stored_itemsize = 1 if e.get("enc") == "i8" else dtype.itemsize
-            if e["n"] != count * stored_itemsize:
+            if e.get("enc") == "tk8":
+                nnz = int(e["nnz"])
+                expect = nnz * 5  # uint32 index + int8 value per coordinate
+            elif e.get("enc") == "i8":
+                expect = count
+            else:
+                expect = count * dtype.itemsize
+            if e["n"] != expect:
                 raise DecodingParamsError(f"inconsistent header for {e['k']}: n={e['n']} vs shape {e['shape']}")
             if off + e["n"] > len(payload):
                 raise DecodingParamsError(f"truncated payload at {e['k']}")
             crc = native.crc32c(payload[off : off + e["n"]], crc)
-            if e.get("enc") == "i8":
+            if e.get("enc") == "tk8":
+                nnz = int(e["nnz"])
+                if anchor_flat is None or e["k"] not in anchor_flat:
+                    raise AnchorMismatchError(f"no anchor tensor for delta-coded {e['k']}")
+                idx = np.frombuffer(payload, dtype=np.uint32, count=nnz, offset=off)
+                q = np.frombuffer(payload, dtype=np.int8, count=nnz, offset=off + nnz * 4)
+                if nnz and int(idx.max()) >= count:
+                    raise DecodingParamsError(f"index out of range in {e['k']}")
+                dense = np.asarray(anchor_flat[e["k"]], np.float32).ravel().copy()
+                dense[idx] = dense[idx] + native.dequantize(q, float(e["scale"]))
+                arr = dense.astype(dtype)
+            elif e.get("enc") == "i8":
                 q = np.frombuffer(payload, dtype=np.int8, count=count, offset=off)
                 arr = native.dequantize(q, float(e["scale"])).astype(dtype)
             else:
@@ -130,7 +244,7 @@ def decode_params(payload: bytes) -> dict[str, np.ndarray]:
         if "crc" in header and header["crc"] != crc:
             raise DecodingParamsError(f"CRC mismatch: payload corrupted ({crc} != {header['crc']})")
         return flat
-    except DecodingParamsError:
+    except (DecodingParamsError, AnchorMismatchError):
         raise
     except Exception as exc:  # noqa: BLE001 — any malformed payload is a decode error
         raise DecodingParamsError(str(exc)) from exc
@@ -180,10 +294,25 @@ class ModelUpdate:
     contributors: list[str] = field(default_factory=list)
     num_samples: int = 1
     encoded: Optional[bytes] = None  # populated lazily for byte transports
+    #: round-start global model for delta (topk8) wire coding — never
+    #: serialized; attached by the learner, inherited through aggregation
+    anchor: Optional[Pytree] = None
+    anchor_tag: Optional[str] = None  # round identity, e.g. "1:3"
+    #: mutable error-feedback store ({path: residual}) — set only on a
+    #: node's OWN train-stage contribution (TrainStage attaches it; letting
+    #: every diffusion encode write it would clobber the store with
+    #: aggregate-encode error) so dropped delta coordinates re-enter the
+    #: next round
+    ef_residual: Optional[dict] = None
 
     def encode(self) -> bytes:
         if self.encoded is None:
-            self.encoded = encode_params(self.params)
+            self.encoded = encode_params(
+                self.params,
+                anchor=self.anchor,
+                anchor_tag=self.anchor_tag,
+                residual=self.ef_residual,
+            )
         return self.encoded
 
     @staticmethod

@@ -33,9 +33,10 @@ class NodeState:
         self.train_set_votes: Dict[str, Dict[str, int]] = {}
 
         # secure aggregation (learning/secagg.py): this node's DH private key
-        # for the current experiment + peers' announced public keys
+        # for the current experiment + peers' announced (public key, sample
+        # count) pairs
         self.secagg_priv: Optional[int] = None
-        self.secagg_pubs: Dict[str, int] = {}
+        self.secagg_pubs: Dict[str, tuple] = {}
 
         # monotonically counts experiments entered; lets harnesses distinguish
         # "never started" from "finished" (both have round None)

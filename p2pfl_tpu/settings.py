@@ -56,17 +56,27 @@ class Settings:
     # Mesh axis names used by the parallel runtime.
     MESH_NODES_AXIS: str = "nodes"
     MESH_MODEL_AXIS: str = "model"
-    # Wire compression for network transports: "none" | "int8"
+    # Wire compression for network transports: "none" | "int8" | "topk8"
     # (int8 = symmetric per-tensor quantization, 4x smaller gossip payloads,
-    # native C++ hot loop when p2pfl_tpu/native is built).
+    # native C++ hot loop when p2pfl_tpu/native is built; topk8 = top-k
+    # sparsified int8 DELTAS against the round-start global model — 0.25
+    # bytes/param at the default fraction, 16x under dense float32 — with
+    # error feedback).
     WIRE_COMPRESSION: str = "none"
+    # Fraction of delta coordinates kept per tensor by topk8.
+    TOPK_FRACTION: float = 0.05
+    # Error feedback for topk8: dropped coordinates accumulate locally and
+    # re-enter the next round's delta (Seide et al. 2014).
+    TOPK_ERROR_FEEDBACK: bool = True
     # Secure aggregation (pairwise masking, learning/secagg.py): when True,
     # train-set nodes Diffie-Hellman a seed per peer at experiment start and
     # mask their model contribution; masks cancel in the FedAvg sum, so no
     # individual model ever crosses the wire in the clear. FedAvg only.
     SECURE_AGGREGATION: bool = False
-    # Std-dev of the pairwise Gaussian masks (before the 1/num_samples
-    # weighting) — large enough to drown the parameters themselves.
+    # Per-pair Gaussian mask scale: pair (i,j) is masked at
+    # STD*sqrt(w_j/w_i) on node i (sample counts announced with the DH
+    # keys), so the mask drowns the parameters regardless of how large the
+    # local datasets are. Requires WIRE_COMPRESSION="none".
     SECAGG_MASK_STD: float = 100.0
 
 

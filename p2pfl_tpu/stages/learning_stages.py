@@ -37,13 +37,38 @@ class StartLearningStage(Stage):
         node.learner.set_addr(node.addr)
 
         if Settings.SECURE_AGGREGATION:
-            # announce this experiment's DH public key so any later train
-            # set can derive pairwise mask seeds (learning/secagg.py)
             from p2pfl_tpu.learning import secagg
 
+            # fail the misconfigurations loudly BEFORE any training: masks
+            # only cancel through a lossless, linear aggregation path
+            if Settings.WIRE_COMPRESSION != "none":
+                logger.error(
+                    node.addr,
+                    f"SECURE_AGGREGATION is incompatible with WIRE_COMPRESSION="
+                    f"{Settings.WIRE_COMPRESSION!r}: per-node quantization of the "
+                    "masks breaks exact cancellation — aborting the experiment",
+                )
+                state.clear()
+                return None
+            if not getattr(node.aggregator, "MASK_COMPATIBLE", False):
+                logger.error(
+                    node.addr,
+                    f"SECURE_AGGREGATION requires a linear aggregator (FedAvg "
+                    f"family); {type(node.aggregator).__name__} would operate on "
+                    "masked noise — aborting the experiment",
+                )
+                state.clear()
+                return None
+            # announce this experiment's DH public key (+ sample count, which
+            # peers need for the pair mask scales) so any later train set can
+            # derive pairwise mask seeds (learning/secagg.py)
             state.secagg_priv, pub = secagg.dh_keypair()
             node.protocol.broadcast(
-                node.protocol.build_msg("secagg_pub", [f"{pub:x}"], round=0)
+                node.protocol.build_msg(
+                    "secagg_pub",
+                    [f"{pub:x}", str(node.learner.get_num_samples())],
+                    round=0,
+                )
             )
 
         # wait for initial weights: the initiator's event was set by
@@ -78,6 +103,13 @@ class StartLearningStage(Stage):
         )
         if node.learning_interrupted():
             return None
+
+        # every node now holds the round's shared init weights: pin them as
+        # the delta-coding anchor for this round's wire payloads (topk8)
+        node.learner.set_wire_anchor(
+            node.learner.get_parameters(),
+            tag=f"{state.experiment_epoch}:{state.round or 0}",
+        )
 
         # let heartbeats flood so the full membership is known before voting
         time.sleep(Settings.WAIT_HEARTBEATS_CONVERGENCE)
@@ -171,6 +203,14 @@ class TrainStage(Stage):
 
         # contribute own model (masked when secure aggregation is on)
         own = node.learner.get_model_update()
+        if (
+            Settings.WIRE_COMPRESSION == "topk8"
+            and Settings.TOPK_ERROR_FEEDBACK
+            and not Settings.SECURE_AGGREGATION
+        ):
+            # error feedback rides ONLY on the own train-stage contribution
+            # — exactly one encode per round updates the residual store
+            own.ef_residual = node.learner.ef_residual_store()
         if Settings.SECURE_AGGREGATION and len(state.train_set) > 1:
             own = TrainStage._secagg_mask(node, own)
         if own is not None:
@@ -350,6 +390,14 @@ class RoundFinishedStage(Stage):
             return None
         node.aggregator.clear()
         state.increase_round()
+        # round boundary: the just-diffused aggregate is the next round's
+        # shared model — re-pin the delta-coding anchor here, NOT inside
+        # set_parameters (this round's remaining diffusion sends must still
+        # delta-code against the anchor the behind nodes hold)
+        node.learner.set_wire_anchor(
+            node.learner.get_parameters(),
+            tag=f"{state.experiment_epoch}:{state.round}",
+        )
         logger.round_finished(node.addr)
         if state.round is not None and state.total_rounds is not None and state.round < state.total_rounds:
             if Settings.VOTE_EVERY_ROUND:

@@ -6,6 +6,8 @@ cancel in the sample-weighted FedAvg sum, end-to-end federation with
 SECURE_AGGREGATION on, and the device-side masking op on the mesh.
 """
 
+import time
+
 import numpy as np
 import pytest
 
@@ -42,9 +44,6 @@ def test_dh_pair_seed_symmetric():
 
 
 def _mask_for(addr, addrs, privs, pubs, params, num_samples, round_no=0):
-    seeds = {
-        n: secagg.dh_pair_seed(privs[addr], pubs[n], "exp") for n in addrs if n != addr
-    }
     u = ModelUpdate(params, [addr], num_samples)
     return secagg.mask_update(u, addr, addrs, privs[addr], pubs, "exp", round_no)
 
@@ -54,10 +53,10 @@ def test_masks_cancel_in_weighted_fedavg():
     addrs = ["a", "b", "c", "d"]
     keys = {n: secagg.dh_keypair() for n in addrs}
     privs = {n: k[0] for n, k in keys.items()}
-    pubs = {n: k[1] for n, k in keys.items()}
+    weights = {"a": 10, "b": 20, "c": 30, "d": 40}
+    pubs = {n: (keys[n][1], weights[n]) for n in addrs}
     rng = np.random.default_rng(0)
     params = {n: {"w": rng.normal(size=(16, 8)).astype(np.float32)} for n in addrs}
-    weights = {"a": 10, "b": 20, "c": 30, "d": 40}
 
     masked = {
         n: _mask_for(n, addrs, privs, pubs, params[n], weights[n]) for n in addrs
@@ -79,7 +78,7 @@ def test_mask_fresh_per_round():
     addrs = ["a", "b"]
     keys = {n: secagg.dh_keypair() for n in addrs}
     privs = {n: k[0] for n, k in keys.items()}
-    pubs = {n: k[1] for n, k in keys.items()}
+    pubs = {n: (k[1], 1) for n, k in keys.items()}
     p = {"w": np.zeros((4, 4), np.float32)}
     m0 = _mask_for("a", addrs, privs, pubs, p, 1, round_no=0)
     m1 = _mask_for("a", addrs, privs, pubs, p, 1, round_no=1)
@@ -100,12 +99,19 @@ def test_unsafe_masking_raises_never_unmasked():
     with pytest.raises(SecAggError, match="missing DH"):
         secagg.mask_update(ModelUpdate(p32, ["a"], 5), "a", addrs, priv, {}, "exp", 0)
     with pytest.raises(SecAggError, match="zero sample"):
-        secagg.mask_update(ModelUpdate(p32, ["a"], 0), "a", addrs, priv, {"b": pub_b}, "exp", 0)
+        secagg.mask_update(ModelUpdate(p32, ["a"], 0), "a", addrs, priv, {"b": (pub_b, 5)}, "exp", 0)
     import jax.numpy as jnp
 
     p16 = {"w": jnp.ones((2, 2), jnp.bfloat16)}
     with pytest.raises(SecAggError, match="float32"):
-        secagg.mask_update(ModelUpdate(p16, ["a"], 5), "a", addrs, priv, {"b": pub_b}, "exp", 0)
+        secagg.mask_update(ModelUpdate(p16, ["a"], 5), "a", addrs, priv, {"b": (pub_b, 5)}, "exp", 0)
+    # lossy wire compression breaks cancellation — refused up front
+    Settings.WIRE_COMPRESSION = "int8"
+    try:
+        with pytest.raises(SecAggError, match="lossless"):
+            secagg.mask_update(ModelUpdate(p32, ["a"], 5), "a", addrs, priv, {"b": (pub_b, 5)}, "exp", 0)
+    finally:
+        Settings.WIRE_COMPRESSION = "none"
 
 
 def test_degenerate_dh_keys_rejected():
@@ -124,11 +130,37 @@ def test_degenerate_dh_keys_rejected():
 
     state = NodeState("me")
     cmd = SecAggPubCommand(state)
-    cmd.execute("attacker", 0, "1")  # pub = 1
+    cmd.execute("attacker", 0, "1", "5")  # pub = 1
     assert "attacker" not in state.secagg_pubs
     _, good = secagg.dh_keypair()
-    cmd.execute("peer", 0, f"{good:x}")
-    assert state.secagg_pubs["peer"] == good
+    cmd.execute("peer", 0, f"{good:x}", "0")  # degenerate sample count
+    assert "peer" not in state.secagg_pubs
+    cmd.execute("peer", 0, f"{good:x}", "5")
+    assert state.secagg_pubs["peer"] == (good, 5)
+
+
+def test_secagg_misconfig_aborts_experiment():
+    """SecAgg + a robust aggregator (or lossy wire) must abort at
+    StartLearning — Krum over masked noise would silently elect garbage."""
+    from p2pfl_tpu.learning.aggregators.krum import Krum
+    from p2pfl_tpu.learning.learner import DummyLearner
+    from p2pfl_tpu.utils import wait_convergence
+
+    Settings.SECURE_AGGREGATION = True
+    nodes = [Node(learner=DummyLearner(), aggregator=Krum()) for _ in range(2)]
+    for n in nodes:
+        n.start()
+    nodes[0].connect(nodes[1].addr)
+    wait_convergence(nodes, 1, only_direct=True)
+    nodes[0].set_start_learning(rounds=1, epochs=1)
+    time.sleep(1.5)
+    # the learning thread aborted in StartLearningStage: state cleared, no
+    # training ran (DummyLearner.fit would have bumped the params)
+    for n in nodes:
+        assert n.state.round is None
+        assert float(np.asarray(n.learner.get_parameters()["w"]).mean()) == 0.0
+    for n in nodes:
+        n.stop()
 
 
 def test_secure_federation_end_to_end():
