@@ -49,7 +49,7 @@ def log(msg: str) -> None:
 
 def main() -> None:
     from p2pfl_tpu.learning.dataset import FederatedDataset
-    from p2pfl_tpu.management.profiling import mfu
+    from p2pfl_tpu.management.profiling import force_execution, mfu
     from p2pfl_tpu.models import mlp
     from p2pfl_tpu.parallel import SpmdFederation
 
@@ -78,7 +78,7 @@ def main() -> None:
     log(f"warm-up (compile, {3 * CHUNK} rounds): {time.monotonic() - t0:.1f}s")
     t0 = time.monotonic()
     fed.reset(seed=3)
-    jax.block_until_ready(jax.tree.leaves(fed.params)[0])
+    force_execution(fed.params)
     log(f"reset: {time.monotonic() - t0:.2f}s")
 
     # convergence: fused chunks of CHUNK rounds, the whole chunk (train +
@@ -111,7 +111,7 @@ def main() -> None:
     # put a fresh XLA compile inside the timer)
     t1 = time.monotonic()
     fed.run_fused(CHUNK, epochs=1)
-    jax.block_until_ready(jax.tree.leaves(fed.params)[0])
+    force_execution(fed.params)
     sec_per_round = (time.monotonic() - t1) / CHUNK
 
     # MFU of the steady-state round (train only, no eval)

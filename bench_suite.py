@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from p2pfl_tpu.management.profiling import force_execution
+
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
@@ -39,7 +41,7 @@ def _steady_state(fed, rounds: int = 3) -> float:
     t0 = time.monotonic()
     for _ in range(rounds):
         fed.run_round(epochs=1)
-    jax.block_until_ready(jax.tree.leaves(fed.params)[0])
+    force_execution(fed.params)
     return (time.monotonic() - t0) / rounds
 
 
@@ -163,7 +165,7 @@ def _config3_measure(n_nodes: int) -> None:
         batch_size=32, vote=False, seed=3, remat=True,
     )
     fed.run_round(epochs=1)  # warm-up + OOM probe
-    jax.block_until_ready(jax.tree.leaves(fed.params)[0])
+    force_execution(fed.params)
     fed.evaluate()  # probe the eval path's memory too
     sec_per_round = _steady_state(fed)
     acc = fed.evaluate()["test_acc"]
@@ -208,7 +210,7 @@ def config4_byzantine_robust() -> None:
             )
             t0 = time.monotonic()
             fed.run_round(epochs=1)
-            jax.block_until_ready(jax.tree.leaves(fed.params)[0])
+            force_execution(fed.params)
             t_rounds.append(time.monotonic() - t0)
         results[agg] = {
             "acc": round(float(fed.evaluate()["test_acc"]), 4),
@@ -321,7 +323,7 @@ def config6_heterogeneous_algorithms() -> None:
         t0 = time.monotonic()
         entries = fed.run_fused(rounds, epochs=1, eval=True)
         accs = [round(float(e["test_acc"]), 4) for e in entries]
-        jax.block_until_ready(fed.params)
+        force_execution(fed.params)
         times[algo] = round((time.monotonic() - t0) / rounds, 4)
         results[algo] = accs
         log(f"config6 {algo}: {accs}")
@@ -366,11 +368,11 @@ def config7_long_context_flash() -> None:
 
             step = jax.jit(jax.value_and_grad(loss))
             l, g = step(m.params)
-            jax.block_until_ready(g)  # compile
+            force_execution(g)  # compile barrier (real D2H fetch)
             t0 = time.monotonic()
             for _ in range(10):
                 l, g = step(m.params)
-            jax.block_until_ready(g)
+            force_execution(g)
             row[attn] = round((time.monotonic() - t0) / 10 * 1000, 2)  # ms
             del m, step, g
             jax.clear_caches()

@@ -60,6 +60,24 @@ def peak_flops(device=None) -> Optional[float]:
     return None
 
 
+def force_execution(tree) -> float:
+    """Block until ``tree``'s pending computation REALLY finished.
+
+    ``jax.block_until_ready`` is not a reliable barrier on remote-attached
+    platforms (the axon TPU tunnel acks buffer readiness before the device
+    is done — measured 9× under-reads on round timings); a device-to-host
+    fetch is. Fetches a SINGLE element (a tiny on-device slice that depends
+    on the pending computation), so the barrier itself moves O(bytes) — a
+    whole-leaf fetch would bill megabytes of tunnel transfer to whatever
+    the caller is timing. All benchmark timers use this.
+    """
+    import numpy as np
+
+    leaf = jax.tree_util.tree_leaves(tree)[0]
+    scalar = leaf[(0,) * getattr(leaf, "ndim", 0)]
+    return float(np.asarray(scalar))
+
+
 def compiled_flops(jitted, *args, **kwargs) -> Optional[float]:
     """Total FLOPs of one execution, from the compiled XLA cost analysis."""
     try:

@@ -859,10 +859,15 @@ class SpmdFederation:
         return entries
 
     def round_flops(self, epochs: int = 1) -> Optional[float]:
-        """Compiled FLOPs of one no-eval round (XLA cost analysis).
+        """FLOPs of one no-eval round, scan-trip-count aware.
 
-        Used by the benchmarks for MFU; returns None when the backend
-        exposes no cost analysis.
+        XLA's ``cost_analysis`` counts a ``lax.scan`` body ONCE regardless
+        of trip count, so the whole-round program's figure misses
+        ``epochs × nb − 1`` of every node's SGD steps (a ~16× undercount at
+        nb=16 — this made round-1's MFU look 1.7% when the chip was really
+        running ~10×+ that). Corrected here: the whole-round analysis (which
+        counts aggregation/diffusion plus exactly one step per node) plus a
+        scan-free single-step probe times the steps the analysis missed.
         """
         from p2pfl_tpu.management.profiling import compiled_flops
 
@@ -872,7 +877,7 @@ class SpmdFederation:
         sel_idx = jax.device_put(np.flatnonzero(eff).astype(np.int32), self._repl)
         # algorithm knobs change the compiled program — MFU must count the
         # program that actually runs
-        return compiled_flops(
+        base = compiled_flops(
             spmd_round,
             self.params, self.opt_state, self.x_all, self.y_all, perm, mask,
             self._samples, sel_idx,
@@ -882,6 +887,44 @@ class SpmdFederation:
             dp_keys=self._dp_round_keys(),
             **self._algo_kwargs(self._server_t + 1 if self.server_opt else 0),
         )
+        if base is None:
+            return None
+        step = self._single_step_flops()
+        if step is None:
+            return base
+        return base + self.n * (epochs * self._nb - 1) * step
+
+    def _single_step_flops(self) -> Optional[float]:
+        """Compiled FLOPs of ONE node's ONE SGD step (trip-count-1 scan, so
+        the cost analysis counts it exactly once). Mirrors the round's
+        per-step math including remat/FedProx/DP variants."""
+        from p2pfl_tpu.management.profiling import compiled_flops
+
+        def one(a):
+            return jax.ShapeDtypeStruct(a.shape[1:], a.dtype)
+
+        p1 = jax.tree.map(one, self.params)
+        o1 = jax.tree.map(one, self.opt_state)
+        xs = jax.ShapeDtypeStruct(
+            (1, self.batch_size) + tuple(self.x_all.shape[2:]), self.x_all.dtype
+        )
+        ys = jax.ShapeDtypeStruct(
+            (1, self.batch_size) + tuple(self.y_all.shape[2:]), self.y_all.dtype
+        )
+        dp = self.dp_clip > 0.0
+
+        def one_epoch(p, o, xs_, ys_, key=None):
+            anchor = p if (self.prox_mu > 0.0 or self.scaffold) else None
+            return _local_epoch(
+                p, o, xs_, ys_, self.module, self.tx, self.remat,
+                prox_mu=self.prox_mu, anchor=anchor,
+                dp_clip=self.dp_clip, dp_noise=self.dp_noise, key=key,
+            )
+
+        args = [p1, o1, xs, ys]
+        if dp:
+            args.append(jax.ShapeDtypeStruct((2,), jnp.uint32))
+        return compiled_flops(jax.jit(one_epoch), *args)
 
     def evaluate(self) -> dict:
         loss, acc = spmd_eval(self.params, self.x_test, self.y_test, module=self.module)
