@@ -138,6 +138,14 @@ class GrpcProtocol(CommunicationProtocol):
         super().__init__(parse_address(address).target)
         self._server: Optional[grpc.Server] = None
         self._lock = threading.Lock()
+        # egress accounting (control vs weight plane) — the evidence base
+        # for wire-compression claims (bench_suite config 8). Written from
+        # the gossiper/heartbeater threads AND server-executor handlers, so
+        # increments hold _lock; only successfully acknowledged sends count
+        self.wire_stats: dict[str, int] = {
+            "weights_bytes": 0, "weights_msgs": 0,
+            "control_bytes": 0, "control_msgs": 0,
+        }
 
     # ---- server ----
 
@@ -170,14 +178,20 @@ class GrpcProtocol(CommunicationProtocol):
             adhoc = grpc.insecure_channel(nei)  # reference grpc_client.py:142-144
             channel = adhoc
         try:
-            if isinstance(env, WeightsEnvelope):
+            kind = "weights" if isinstance(env, WeightsEnvelope) else "control"
+            if kind == "weights":
+                payload = encode_weights(env)
                 resp = channel.unary_unary(_SERVICE + "send_weights")(
-                    encode_weights(env), timeout=Settings.GRPC_TIMEOUT
+                    payload, timeout=Settings.GRPC_TIMEOUT
                 )
             else:
+                payload = encode_message(env)
                 resp = channel.unary_unary(_SERVICE + "send_message")(
-                    encode_message(env), timeout=Settings.GRPC_TIMEOUT
+                    payload, timeout=Settings.GRPC_TIMEOUT
                 )
+            with self._lock:
+                self.wire_stats[f"{kind}_bytes"] += len(payload)
+                self.wire_stats[f"{kind}_msgs"] += 1
             return _reply_ok(resp)
         except grpc.RpcError:
             return False

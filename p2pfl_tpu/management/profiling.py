@@ -73,9 +73,14 @@ def force_execution(tree) -> float:
     """
     import numpy as np
 
-    leaf = jax.tree_util.tree_leaves(tree)[0]
-    scalar = leaf[(0,) * getattr(leaf, "ndim", 0)]
-    return float(np.asarray(scalar))
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return 0.0
+    leaf = leaves[0]
+    ndim = getattr(leaf, "ndim", None)
+    if not ndim:  # Python scalar or 0-d array: nothing to slice
+        return float(np.asarray(leaf))
+    return float(np.asarray(leaf[(0,) * ndim]))
 
 
 def compiled_flops(jitted, *args, **kwargs) -> Optional[float]:
