@@ -119,7 +119,7 @@ def _local_epoch(
     return params, opt_state, jnp.mean(losses)
 
 
-def _aggregate(p_used, mask, weights, sel_idx, agg: str, trim: int):
+def _aggregate(p_used, mask, weights, sel_idx, agg: str, trim: int, center=None, clip_tau: float = 1.0):
     """Combine node-stacked params [N, ...] into one model (fp32 accumulate).
 
     ``sel_idx`` is the [K] array of train-set ∩ active node indices
@@ -184,6 +184,10 @@ def _aggregate(p_used, mask, weights, sel_idx, agg: str, trim: int):
         sel = jnp.stack(chosen)
         sel_tree = jax.tree.map(lambda x: jnp.take(x, sel, axis=0), p_sel)
         return ops.trimmed_mean(sel_tree, trim=f)
+    if agg == "clip":
+        # centered clipping (Karimireddy et al. 2021): center = previous
+        # round's global (every slot held it identically pre-training)
+        return ops.centered_clip(p_sel, center, clip_tau)
     raise ValueError(f"unknown aggregator {agg}")
 
 
@@ -201,6 +205,7 @@ def _round_core(
     tx,
     agg: str = "fedavg",
     trim: int = 0,
+    clip_tau: float = 1.0,
     out_sharding=None,
     keep_opt_state: bool = False,
     remat: bool = False,
@@ -285,7 +290,24 @@ def _round_core(
         return new * m + old * (1 - m)
 
     p_used = jax.tree.map(sel, trained_p, stacked_params)
-    agg_params = _aggregate(p_used, mask, weights, sel_idx, agg, trim)
+    # clip center = the round's shared starting model. Under normal
+    # diffusion every slot holds it identically; the coordinate-wise median
+    # over the elected rows recovers it exactly in that case AND stays
+    # robust if a slot's incoming copy was tampered with (taking row 0
+    # verbatim would let a poisoned slot choose the center).
+    center = (
+        jax.tree.map(
+            lambda x: jnp.median(
+                jnp.take(x, sel_idx, axis=0).astype(jnp.float32), axis=0
+            ),
+            stacked_params,
+        )
+        if agg == "clip"
+        else None
+    )
+    agg_params = _aggregate(
+        p_used, mask, weights, sel_idx, agg, trim, center=center, clip_tau=clip_tau
+    )
 
     fedopt_state = ()
     if server_opt:
@@ -362,6 +384,8 @@ def _agg_acc(module, agg_params, x_test, y_test):
 
 
 _ROUND_STATICS = (
+    # clip_tau is deliberately NOT static: it traces as a scalar operand
+    # (ops.centered_clip takes tau traced), so tuning it never recompiles
     "module", "tx", "agg", "trim", "out_sharding", "keep_opt_state", "remat",
     "prox_mu", "scaffold", "local_lr", "server_opt", "server_lr",
     "dp_clip", "dp_noise",
@@ -471,6 +495,7 @@ class SpmdFederation:
         learning_rate: float = 1e-3,
         aggregator: str = "fedavg",
         trim: int = 0,
+        clip_tau: float = 1.0,
         vote: bool = True,
         keep_opt_state: bool = False,
         remat: bool = False,
@@ -510,8 +535,15 @@ class SpmdFederation:
         self.dp_noise = float(dp_noise)
         if self.dp_noise > 0.0 and self.dp_clip <= 0.0:
             raise ValueError("dp_noise > 0 requires dp_clip > 0")
+        if aggregator not in ("fedavg", "median", "trimmed_mean", "krum", "bulyan", "clip"):
+            raise ValueError(f"unknown aggregator {aggregator!r}")
         self.aggregator = aggregator
         self.trim = trim
+        if aggregator == "clip" and clip_tau <= 0:
+            # tau <= 0 zeroes every clip factor: the aggregate would never
+            # leave the center and training silently freezes
+            raise ValueError(f"clip_tau must be > 0 (got {clip_tau})")
+        self.clip_tau = float(clip_tau)
         self.keep_opt_state = keep_opt_state
         self.remat = remat
         if not 0.0 < participation <= 1.0:
@@ -767,6 +799,7 @@ class SpmdFederation:
             tx=self.tx,
             agg=self.aggregator,
             trim=self.trim,
+            clip_tau=self.clip_tau,
             out_sharding=self._shard,
             keep_opt_state=self.keep_opt_state,
             remat=self.remat,
@@ -828,7 +861,7 @@ class SpmdFederation:
         result = spmd_rounds_fused(
             self.params, self.opt_state, self.x_all, self.y_all, perms, mask,
             self._samples, sel_idx,
-            module=self.module, tx=self.tx, agg=self.aggregator, trim=self.trim,
+            module=self.module, tx=self.tx, agg=self.aggregator, trim=self.trim, clip_tau=self.clip_tau,
             out_sharding=self._shard, keep_opt_state=self.keep_opt_state,
             remat=self.remat,
             x_test=self.x_test if eval else None,
@@ -881,7 +914,7 @@ class SpmdFederation:
             spmd_round,
             self.params, self.opt_state, self.x_all, self.y_all, perm, mask,
             self._samples, sel_idx,
-            module=self.module, tx=self.tx, agg=self.aggregator, trim=self.trim,
+            module=self.module, tx=self.tx, agg=self.aggregator, trim=self.trim, clip_tau=self.clip_tau,
             out_sharding=self._shard, keep_opt_state=self.keep_opt_state,
             remat=self.remat,
             dp_keys=self._dp_round_keys(),
