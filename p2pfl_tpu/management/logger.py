@@ -212,6 +212,12 @@ class P2pflLogger:
             monitor.start()
             self._monitors[node] = monitor
 
+    def learning_states(self) -> list:
+        """(addr, NodeState) snapshot of every registered node that has a
+        state object — the stall watchdog's scan source."""
+        with self._nodes_lock:
+            return [(n, s) for n, (s, _sim) in self._nodes.items() if s is not None]
+
     def unregister_node(self, node: str) -> None:
         with self._nodes_lock:
             self._nodes.pop(node, None)

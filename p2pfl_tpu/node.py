@@ -94,6 +94,9 @@ class Node:
         if self._running:
             raise NodeRunningException(f"Node {self.addr} already running")
         logger.register_node(self.addr, self.state, self.state.simulation)
+        from p2pfl_tpu.management.watchdog import StallWatchdog
+
+        StallWatchdog.ensure_started()  # no-op unless Settings.STALL_WATCHDOG_S > 0
         self.protocol.start()
         self._running = True
         if wait:

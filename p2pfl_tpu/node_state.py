@@ -42,6 +42,11 @@ class NodeState:
         # "never started" from "finished" (both have round None)
         self.experiment_epoch = 0
 
+        # stall-watchdog instrumentation (management/watchdog.py): stamped
+        # by the workflow loop on every stage transition
+        self.last_transition: Optional[float] = None
+        self.current_stage: str = ""
+
         # synchronization (reference: four lock-latches, node_state.py:77-81)
         self.train_set_votes_lock = threading.Lock()
         self.start_thread_lock = threading.Lock()

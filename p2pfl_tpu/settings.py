@@ -45,6 +45,10 @@ class Settings:
 
     # --- monitoring ---
     RESOURCE_MONITOR_PERIOD: float = 1.0
+    # Stall watchdog (management/watchdog.py): when > 0, a daemon thread
+    # dumps every thread's stack if a learning node makes no stage
+    # transition for this many seconds. Detection only; 0 disables.
+    STALL_WATCHDOG_S: float = 0.0
 
     # --- TPU-native additions ---
     # Default dtype for on-wire / aggregation math. bfloat16 keeps matmuls on

@@ -14,11 +14,16 @@ class LearningWorkflow:
     """Runs stages until one returns ``None``. Exceptions end the experiment."""
 
     def run(self, node: "Node") -> None:
+        import time
+
         from p2pfl_tpu.stages.learning_stages import StartLearningStage
 
         stage = StartLearningStage
         while stage is not None:
             logger.debug(node.addr, f"── stage: {stage.name}")
+            # stall-watchdog instrumentation (management/watchdog.py)
+            node.state.current_stage = stage.name
+            node.state.last_transition = time.monotonic()
             try:
                 stage = stage.execute(node)
             except Exception as exc:  # noqa: BLE001 — stage failure ends learning, not the node

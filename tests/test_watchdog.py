@@ -1,0 +1,84 @@
+"""Stall watchdog: stack dumps when a learning round stops moving."""
+
+import time
+
+import pytest
+
+from p2pfl_tpu.management.logger import logger
+from p2pfl_tpu.management.watchdog import StallWatchdog, all_thread_stacks
+from p2pfl_tpu.node_state import NodeState
+from p2pfl_tpu.settings import Settings
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    StallWatchdog.shutdown()
+    Settings.STALL_WATCHDOG_S = 0.0
+    logger.unregister_node("stuck-node")
+    logger.unregister_node("moving-node")
+
+
+def test_all_thread_stacks_names_threads():
+    dump = all_thread_stacks()
+    assert "MainThread" in dump and "test_all_thread_stacks" in dump
+
+
+def test_disabled_by_default():
+    assert Settings.STALL_WATCHDOG_S == 0.0
+    assert StallWatchdog.ensure_started() is None
+
+
+def test_stall_detected_and_reported_once():
+    import logging
+
+    records = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    handler = _Capture()
+    logging.getLogger("p2pfl_tpu").addHandler(handler)  # propagate=False: attach directly
+    Settings.STALL_WATCHDOG_S = 0.4
+
+    stuck = NodeState("stuck-node")
+    stuck.status = "Learning"
+    stuck.round = 1
+    stuck.current_stage = "VoteTrainSetStage"
+    stuck.last_transition = time.monotonic() - 10.0
+    logger.register_node("stuck-node", stuck)
+
+    moving = NodeState("moving-node")
+    moving.status = "Learning"
+    moving.last_transition = time.monotonic()
+    logger.register_node("moving-node", moving)
+
+    assert StallWatchdog.ensure_started() is not None
+
+    def wait_for_hits(expected, timeout=5.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            moving.last_transition = time.monotonic()  # it really does move
+            got = [r for r in records if "STALL" in r.getMessage()]
+            if len(got) >= expected:
+                return got
+            time.sleep(0.1)
+        return [r for r in records if "STALL" in r.getMessage()]
+
+    hits = wait_for_hits(1)
+    assert hits, "watchdog never reported the stall"
+    msg = hits[0].getMessage()
+    assert "stuck-node" in msg and "VoteTrainSetStage" in msg
+    assert "stall-watchdog" in msg or "MainThread" in msg  # stacks included
+    assert all("moving-node" not in r.getMessage() for r in hits)
+
+    # one report per stall, not one per tick
+    hits2 = wait_for_hits(2, timeout=1.0)
+    assert len(hits2) == len(hits)
+
+    # a transition clears the report latch; a NEW stall reports again
+    stuck.last_transition = time.monotonic() - 10.0
+    hits3 = wait_for_hits(len(hits) + 1, timeout=2.0)
+    assert len(hits3) == len(hits) + 1
+    logging.getLogger("p2pfl_tpu").removeHandler(handler)
