@@ -193,10 +193,10 @@ def config4_byzantine_robust() -> None:
     results = {}
     key = jax.random.PRNGKey(0)
     # fedavg is the non-robust control: same attack, no defense
-    for agg in ("krum", "trimmed_mean", "fedavg"):
+    for agg in ("krum", "trimmed_mean", "clip", "fedavg"):
         fed = SpmdFederation.from_dataset(
             resnet18(), data, n_nodes=n, batch_size=64, vote=False,
-            aggregator=agg, trim=byz, seed=3, remat=True,
+            aggregator=agg, trim=byz, clip_tau=3.0, seed=3, remat=True,
         )
         t_rounds = []
         for _ in range(rounds):
@@ -224,6 +224,7 @@ def config4_byzantine_robust() -> None:
         "rounds": rounds,
         "krum": results["krum"],
         "trimmed_mean": results["trimmed_mean"],
+        "centered_clip": results["clip"],
         "fedavg_under_attack": results["fedavg"],
         "data": "synthetic (CIFAR-10 shaped)",
         "devices": len(jax.devices()),

@@ -88,6 +88,11 @@ def test_fedopt_gossips_individual_models():
 
     old = Settings.TRAIN_SET_SIZE
     Settings.TRAIN_SET_SIZE = 3
+    # timing-sensitive e2e: under a saturated host (suite running next to
+    # benches) the shrunken test timeouts can cut a round short — widen them
+    old_agg, old_gossip = Settings.AGGREGATION_TIMEOUT, Settings.GOSSIP_EXIT_ON_X_EQUAL_ROUNDS
+    Settings.AGGREGATION_TIMEOUT = max(Settings.AGGREGATION_TIMEOUT, 60.0)
+    Settings.GOSSIP_EXIT_ON_X_EQUAL_ROUNDS = max(old_gossip, 12)
     data = FederatedDataset.synthetic_mnist(n_train=384, n_test=64)
     nodes = []
     try:
@@ -108,6 +113,8 @@ def test_fedopt_gossips_individual_models():
         assert max(ts) >= 1 and all(t <= 1 for t in ts)
     finally:
         Settings.TRAIN_SET_SIZE = old
+        Settings.AGGREGATION_TIMEOUT = old_agg
+        Settings.GOSSIP_EXIT_ON_X_EQUAL_ROUNDS = old_gossip
         for n in nodes:
             n.stop()
 

@@ -1,0 +1,76 @@
+"""Membership across experiments: back-to-back runs and late joiners.
+
+The reference forbids joining DURING learning (``node.py:74-75,141-142``)
+but the overlay outlives an experiment — a node that connects between
+experiments must participate in the next one, and the same federation
+must be able to run experiment after experiment without state bleed
+(votes, aggregator windows, init latches all reset via ``state.clear``).
+"""
+
+import pytest
+
+from p2pfl_tpu.communication.memory import MemoryRegistry
+from p2pfl_tpu.learning.dataset import FederatedDataset
+from p2pfl_tpu.learning.learner import JaxLearner
+from p2pfl_tpu.models import mlp
+from p2pfl_tpu.node import Node
+from p2pfl_tpu.utils import check_equal_models, full_connection, wait_convergence, wait_to_finish
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    MemoryRegistry.reset()
+    yield
+    MemoryRegistry.reset()
+
+
+def _node(i, n, full):
+    learner = JaxLearner(mlp(seed=i), full.partition(i, n), batch_size=64)
+    node = Node(learner=learner)
+    node.start()
+    return node
+
+
+def test_back_to_back_experiments():
+    """The same federation runs two experiments; no state bleeds between."""
+    full = FederatedDataset.synthetic_mnist(n_train=1024, n_test=256)
+    nodes = [_node(i, 2, full) for i in range(2)]
+    nodes[0].connect(nodes[1].addr)
+    wait_convergence(nodes, 1, only_direct=True)
+
+    nodes[0].set_start_learning(rounds=1, epochs=1)
+    wait_to_finish(nodes, timeout=60)
+    check_equal_models(nodes)
+    assert all(n.state.experiment_epoch == 1 for n in nodes)
+
+    # experiment 2 on the same overlay — from the OTHER node this time
+    nodes[1].set_start_learning(rounds=2, epochs=1)
+    wait_to_finish(nodes, timeout=90)
+    check_equal_models(nodes)
+    assert all(n.state.experiment_epoch == 2 for n in nodes)
+    assert nodes[0].learner.evaluate()["test_acc"] > 0.8
+
+
+def test_late_joiner_participates_in_next_experiment():
+    """A node that connects AFTER experiment 1 trains in experiment 2 and
+    converges to the same model as the incumbents."""
+    full = FederatedDataset.synthetic_mnist(n_train=1536, n_test=256)
+    nodes = [_node(i, 3, full) for i in range(2)]
+    nodes[0].connect(nodes[1].addr)
+    wait_convergence(nodes, 1, only_direct=True)
+    nodes[0].set_start_learning(rounds=1, epochs=1)
+    wait_to_finish(nodes, timeout=60)
+
+    late = _node(2, 3, full)
+    nodes.append(late)
+    for n in nodes[:2]:
+        full_connection(late, [n])
+    wait_convergence(nodes, 2, only_direct=True)
+
+    nodes[0].set_start_learning(rounds=2, epochs=1)
+    wait_to_finish(nodes, timeout=120)
+    check_equal_models(nodes)
+    assert late.state.experiment_epoch == 1  # its first experiment
+    assert late.learner.evaluate()["test_acc"] > 0.8
+    for n in nodes:
+        n.stop()

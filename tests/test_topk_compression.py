@@ -152,3 +152,32 @@ def test_topk_federation_grpc_end_to_end():
     assert sparse < dense8 / 3, (sparse, dense8)
     for n in nodes:
         n.stop()
+
+
+def test_corrupted_tk8_payloads_never_escape_decode_errors():
+    """Byte-level corruption of a delta payload must surface as
+    DecodingParamsError/AnchorMismatchError — never an arbitrary crash or
+    silently wrong tensors (CRC + per-entry length + index-range checks)."""
+    from p2pfl_tpu.exceptions import DecodingParamsError
+
+    anchor = _tree(0)
+    params = {"w": anchor["w"] + 0.1}
+    payload = bytearray(
+        encode_params(params, compression="topk8", anchor=anchor, anchor_tag="1:1")
+    )
+    rng = np.random.default_rng(0)
+    for _ in range(60):
+        corrupted = bytearray(payload)
+        pos = int(rng.integers(len(corrupted)))
+        corrupted[pos] ^= int(rng.integers(1, 256))
+        try:
+            flat = decode_params(bytes(corrupted), anchor=anchor, anchor_tag="1:1")
+        except (DecodingParamsError, AnchorMismatchError):
+            continue  # detected — good
+        # undetected only if the flip was a no-op... it never is (xor>0),
+        # so any successful decode means the CRC failed to catch a flip
+        raise AssertionError(f"corruption at byte {pos} decoded silently")
+    # truncation at every framing boundary
+    for cut in (2, 6, len(payload) // 2, len(payload) - 1):
+        with pytest.raises((DecodingParamsError, AnchorMismatchError)):
+            decode_params(bytes(payload[:cut]), anchor=anchor, anchor_tag="1:1")
