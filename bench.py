@@ -59,8 +59,13 @@ def main() -> None:
     log(f"data: {provenance}")
     model = mlp()
 
+    # keep_opt_state: the framework's documented improvement over the
+    # reference's per-round optimizer reset (Adam moments carry across
+    # rounds) — measured 12 -> 9 rounds to 98% on this task; recorded in
+    # the JSON so the knob is visible
     fed = SpmdFederation.from_dataset(
-        model, data, n_nodes=N_NODES, batch_size=BATCH, vote=False, seed=3
+        model, data, n_nodes=N_NODES, batch_size=BATCH, vote=False, seed=3,
+        keep_opt_state=True,
     )
 
     # compile warm-up, then reset state in place (same mesh → same
@@ -133,6 +138,7 @@ def main() -> None:
                 "mfu": round(round_mfu, 4) if round_mfu is not None else None,
                 "data": provenance,
                 "n_nodes": N_NODES,
+                "keep_opt_state": True,
                 "devices": len(jax.devices()),
             }
         )
