@@ -8,6 +8,7 @@ for pure-communication use, matching the reference's communication tests.
 from __future__ import annotations
 
 import threading
+import weakref
 from typing import Any, Optional, Type, Union
 
 from p2pfl_tpu.commands import (
@@ -30,6 +31,25 @@ from p2pfl_tpu.learning.aggregators.fedavg import FedAvg
 from p2pfl_tpu.learning.weights import ModelUpdate
 from p2pfl_tpu.management.logger import logger
 from p2pfl_tpu.node_state import NodeState
+
+
+#: weak registry of every constructed Node — lets harnesses find and stop
+#: leaked nodes (a failed test that skips ``stop()`` would otherwise leave
+#: live heartbeater/gossiper threads interfering with everything after it)
+ALL_NODES: "weakref.WeakSet[Node]" = weakref.WeakSet()
+
+
+def stop_leaked_nodes() -> list[str]:
+    """Stop every still-running Node in the process; returns their addrs."""
+    leaked = []
+    for node in list(ALL_NODES):
+        if getattr(node, "_running", False):
+            leaked.append(node.addr)
+            try:
+                node.stop()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+    return leaked
 
 
 class Node:
@@ -71,6 +91,7 @@ class Node:
         self._interrupt = threading.Event()
         self._learning_thread: Optional[threading.Thread] = None
         self._running = False
+        ALL_NODES.add(self)
 
         # command registry (reference node.py:110-131)
         for cmd in (

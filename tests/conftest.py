@@ -32,3 +32,19 @@ def _fast_settings():
 
     logger.set_level("DEBUG")
     yield
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_nodes():
+    """Cross-test isolation: a test that fails before stopping its nodes
+    must not leave live heartbeater/gossiper threads interfering with every
+    test after it (observed: leaked gRPC heartbeaters evicting neighbors
+    suite-wide). Stops leftovers and makes the leak visible."""
+    yield
+    from p2pfl_tpu.node import stop_leaked_nodes
+
+    leaked = stop_leaked_nodes()
+    if leaked:
+        import warnings
+
+        warnings.warn(f"test leaked running nodes (now stopped): {leaked}", stacklevel=1)
