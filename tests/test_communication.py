@@ -108,7 +108,9 @@ def test_node_abrupt_down_evicted_by_heartbeat():
             break
         time.sleep(0.05)
     assert all(victim.addr not in n.get_neighbors() for n in nodes[1:])
-    _stop_all(nodes[1:])
+    _stop_all(nodes)  # incl. the half-dead victim: its gossiper thread and
+    # node registration would otherwise leak into every later test that
+    # reuses the default "node-1" address
 
 
 def test_send_failure_evicts_neighbor():

@@ -49,6 +49,8 @@ def test_back_to_back_experiments():
     check_equal_models(nodes)
     assert all(n.state.experiment_epoch == 2 for n in nodes)
     assert nodes[0].learner.evaluate()["test_acc"] > 0.8
+    for n in nodes:
+        n.stop()
 
 
 def test_late_joiner_participates_in_next_experiment():
