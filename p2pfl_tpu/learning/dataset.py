@@ -155,6 +155,7 @@ class FederatedDataset:
         strategy: str = "iid",
         alpha: float = 0.5,
         seed: int = 0,
+        test_strategy: Optional[str] = None,
     ) -> "FederatedDataset":
         """Extract shard ``sub_id`` of ``n_parts``.
 
@@ -162,9 +163,16 @@ class FederatedDataset:
         - ``sorted``: sort-by-label then slice → each node sees few classes
           (reference ``iid=False``, :86-100),
         - ``dirichlet``: label-skew with concentration ``alpha``.
+
+        ``test_strategy`` defaults to ``"iid"`` (reference parity: every
+        node judges against the global distribution); pass the train
+        strategy instead when each node's deployment distribution matches
+        its local data — the personalization (FedPer) setting.
         """
         tr = _partition_indices(self.y_train, sub_id, n_parts, strategy, alpha, seed)
-        te = _partition_indices(self.y_test, sub_id, n_parts, "iid", alpha, seed)
+        te = _partition_indices(
+            self.y_test, sub_id, n_parts, test_strategy or "iid", alpha, seed
+        )
         return FederatedDataset(
             self.x_train[tr], self.y_train[tr], self.x_test[te], self.y_test[te],
             self.num_classes, source=self.source,
