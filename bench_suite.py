@@ -113,6 +113,19 @@ def config2_resnet18_8node() -> None:
     elapsed = time.monotonic() - t0
     sec_per_round = _steady_state(fed)
     flops, round_mfu = _spmd_mfu(fed, sec_per_round)
+
+    # scaling point: the same federation at batch 256/node — 4x the work
+    # per round in barely more wall-clock (the chip is underfed at 64)
+    del fed
+    jax.clear_caches()
+    fed_big = SpmdFederation.from_dataset(
+        resnet18(), data, n_nodes=8, batch_size=256, vote=False, seed=3
+    )
+    fed_big.run_round(epochs=1)
+    force_execution(fed_big.params)
+    sec_big = _steady_state(fed_big)
+    flops_big, mfu_big = _spmd_mfu(fed_big, sec_big)
+
     emit({
         "metric": "config2_resnet18_cifar10_8node_fedavg",
         "value": round(sec_per_round, 4),
@@ -121,6 +134,11 @@ def config2_resnet18_8node() -> None:
         "time_6_rounds_s": round(elapsed, 3),
         "flops_per_round": flops,
         "mfu": round(round_mfu, 4) if round_mfu is not None else None,
+        "batch256": {
+            "sec_per_round": round(sec_big, 4),
+            "flops_per_round": flops_big,
+            "mfu": round(mfu_big, 4) if mfu_big is not None else None,
+        },
         "data": "synthetic-hard (CIFAR-10 shaped)",
         "devices": len(jax.devices()),
     })
