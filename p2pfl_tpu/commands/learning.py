@@ -100,6 +100,21 @@ class AddModelCommand(Command):
         if not state.model_initialized_event.is_set():
             logger.debug(state.addr, f"add_model from {source} before init — ignored")
             return
+        if state.round is not None and round < state.round:
+            # stale payload from a peer still finishing an older round —
+            # most often the previous round's aggregate diffused to a node
+            # whose models_ready hadn't reached the sender yet. Because the
+            # train set is reused across rounds (round-0 vote quirk), its
+            # contributor set matches OUR window exactly and the aggregator
+            # would accept it as this round's full aggregate, silently
+            # discarding the round's training. The reference shares this
+            # race (its add_model has no round check either); gating here
+            # is a documented divergence that closes it.
+            logger.debug(
+                state.addr,
+                f"add_model from {source} for stale round {round} (at {state.round}) — ignored",
+            )
+            return
         try:
             if update.params is None:
                 update = node.learner.materialize(update)

@@ -174,3 +174,31 @@ def test_wrong_model_does_not_hang():
     n1.set_start_learning(rounds=1, epochs=0)
     wait_to_finish([n1], timeout=60)
     _stop_all([n1, n2])
+
+
+def test_stale_round_add_model_rejected():
+    """A previous round's diffused aggregate must not satisfy the CURRENT
+    round's collection window (the train set is reused across rounds, so
+    its contributor set matches exactly — without a round gate the window
+    accepts it and the round's training is silently discarded)."""
+    from p2pfl_tpu.learning.weights import ModelUpdate
+
+    learner = JaxLearner(mlp(), _data(0, 2), batch_size=64)
+    node = Node(learner=learner)
+    node.start()
+    try:
+        node.state.model_initialized_event.set()
+        node.state.round = 2
+        node.state.train_set = [node.addr, "peer"]
+        node.aggregator.set_nodes_to_aggregate([node.addr, "peer"])
+        stale = ModelUpdate(learner.get_parameters(), [node.addr, "peer"], 10)
+        # round 1 payload into a round-2 window: rejected by the gate
+        from p2pfl_tpu.commands.learning import AddModelCommand
+
+        AddModelCommand(node).execute("peer", 1, update=stale)
+        assert node.aggregator.get_aggregated_models() == []
+        # same payload at the CURRENT round is accepted
+        AddModelCommand(node).execute("peer", 2, update=stale)
+        assert node.aggregator.get_aggregated_models() == sorted([node.addr, "peer"])
+    finally:
+        node.stop()
