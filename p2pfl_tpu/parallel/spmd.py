@@ -834,15 +834,13 @@ class SpmdFederation:
                 entry.update(self.evaluate())
         return self.history
 
-    def run_fused(self, rounds: int, epochs: int = 1, eval: bool = False) -> list[dict]:  # noqa: A002
-        """Run ``rounds`` rounds as ONE device dispatch (``lax.scan``).
+    def _fused_inputs(self, rounds: int, epochs: int):
+        """Guards + staged device inputs shared by every fused-span runner.
 
-        At small model scale a round is dispatch-dominated — fusing
-        amortizes the host↔device round-trip. The train set is fixed for
-        the span (the reference's own semantics: voting happens only in
-        round 0); per-round voting or client sampling needs
-        :meth:`run_round`. With ``eval=True`` the per-round accuracy curve
-        is computed on-device and returned in the history entries.
+        Elects the round-0 train set if needed, rejects per-round
+        voting/client sampling (a fused span needs one fixed mask), and
+        returns ``(perms [R,N,epochs,nb,bs], mask, sel_idx)`` device-put
+        with the span's shardings.
         """
         if self._vote and self.round == 0:
             self.train_mask = self.elect_train_set()
@@ -858,6 +856,19 @@ class SpmdFederation:
         eff = self._effective_mask()
         mask = jax.device_put(jnp.asarray(eff), self._shard)
         sel_idx = jax.device_put(np.flatnonzero(eff).astype(np.int32), self._repl)
+        return perms, mask, sel_idx
+
+    def run_fused(self, rounds: int, epochs: int = 1, eval: bool = False) -> list[dict]:  # noqa: A002
+        """Run ``rounds`` rounds as ONE device dispatch (``lax.scan``).
+
+        At small model scale a round is dispatch-dominated — fusing
+        amortizes the host↔device round-trip. The train set is fixed for
+        the span (the reference's own semantics: voting happens only in
+        round 0); per-round voting or client sampling needs
+        :meth:`run_round`. With ``eval=True`` the per-round accuracy curve
+        is computed on-device and returned in the history entries.
+        """
+        perms, mask, sel_idx = self._fused_inputs(rounds, epochs)
         result = spmd_rounds_fused(
             self.params, self.opt_state, self.x_all, self.y_all, perms, mask,
             self._samples, sel_idx,

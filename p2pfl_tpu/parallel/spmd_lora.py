@@ -29,12 +29,7 @@ from p2pfl_tpu.parallel.spmd import SpmdFederation, _aggregate
 Pytree = Any
 
 
-@partial(
-    jax.jit,
-    static_argnames=("module", "tx", "agg", "trim", "out_sharding", "keep_opt_state", "remat"),
-    donate_argnums=(0, 1),
-)
-def spmd_lora_round(
+def _lora_round_core(
     stacked_lora,  # [N, ...] adapters
     opt_states,  # [N, ...]
     base,  # shared frozen params (no node axis)
@@ -53,6 +48,7 @@ def spmd_lora_round(
     keep_opt_state: bool = False,
     remat: bool = False,
 ):
+    """Trace-time body shared by the one-round and fused-round programs."""
     n = mask.shape[0]
 
     def node_fn(lora, opt_state, x, y, idx):
@@ -104,6 +100,41 @@ def spmd_lora_round(
             lambda a: jax.lax.with_sharding_constraint(a, out_sharding), out_opt
         )
     return out, out_opt, jnp.mean(losses, where=mask.astype(bool))
+
+
+_LORA_STATICS = ("module", "tx", "agg", "trim", "out_sharding", "keep_opt_state", "remat")
+
+
+@partial(jax.jit, static_argnames=_LORA_STATICS, donate_argnums=(0, 1))
+def spmd_lora_round(
+    stacked_lora, opt_states, base, x_all, y_all, perm, mask, weights, sel_idx, **kw
+):
+    return _lora_round_core(
+        stacked_lora, opt_states, base, x_all, y_all, perm, mask, weights, sel_idx, **kw
+    )
+
+
+@partial(jax.jit, static_argnames=_LORA_STATICS, donate_argnums=(0, 1))
+def spmd_lora_rounds_fused(
+    stacked_lora, opt_states, base, x_all, y_all, perms, mask, weights, sel_idx, **kw
+):
+    """R LoRA federated rounds as ONE device dispatch (``lax.scan``).
+
+    ``perms``: [R, N, epochs, nb, bs]. Adapters are tiny (config 5:
+    57 k params/node), so a round is dispatch-dominated — fusing amortizes
+    the host↔device round-trip R×, same as :func:`spmd_rounds_fused`.
+    Returns (adapters', opt', losses [R]).
+    """
+
+    def body(carry, perm):
+        p, o = carry
+        out_p, out_o, loss = _lora_round_core(
+            p, o, base, x_all, y_all, perm, mask, weights, sel_idx, **kw
+        )
+        return (out_p, out_o), loss
+
+    (p, o), losses = jax.lax.scan(body, (stacked_lora, opt_states), perms)
+    return p, o, losses
 
 
 @partial(jax.jit, static_argnames=("module",))
@@ -183,6 +214,31 @@ class SpmdLoraFederation(SpmdFederation):
         entry = {"round": self.round, "train_loss": loss}
         self.history.append(entry)
         return entry
+
+    def run_fused(self, rounds: int, epochs: int = 1, eval: bool = False) -> list[dict]:  # noqa: A002
+        """R adapter-federation rounds as ONE device dispatch.
+
+        Same contract as :meth:`SpmdFederation.run_fused` (fixed train set
+        for the span; no per-round voting). ``eval`` is not fused here —
+        adapters are tiny, call :meth:`evaluate` where a curve is needed.
+        """
+        if eval:
+            raise ValueError("SpmdLoraFederation.run_fused has no fused eval; call evaluate()")
+        perms, mask, sel_idx = self._fused_inputs(rounds, epochs)
+        self.params, self.opt_state, losses = spmd_lora_rounds_fused(
+            self.params, self.opt_state, self.base, self.x_all, self.y_all,
+            perms, mask, self._samples, sel_idx,
+            module=self.module, tx=self.tx, agg=self.aggregator, trim=self.trim,
+            out_sharding=self._shard, keep_opt_state=self.keep_opt_state,
+            remat=self.remat,
+        )
+        entries = []
+        for r in range(rounds):
+            self.round += 1
+            entry = {"round": self.round, "train_loss": losses[r]}
+            self.history.append(entry)
+            entries.append(entry)
+        return entries
 
     def evaluate(self) -> dict:
         loss, acc = spmd_lora_eval(

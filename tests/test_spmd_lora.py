@@ -78,3 +78,32 @@ def test_tp_sharded_forward_matches_replicated():
     got = jax.jit(lambda p, t: model.module.apply({"params": p}, t))(sharded, toks)
     # bf16 matmuls accumulate in a different order when sharded
     np.testing.assert_allclose(np.asarray(want), np.asarray(got), atol=2e-2)
+
+
+def test_lora_fused_matches_sequential():
+    """run_fused(R) must produce the same adapters as R run_round calls
+    with the same seed (one dispatch vs R dispatches)."""
+    import numpy as np
+
+    from p2pfl_tpu.learning.dataset import FederatedDataset
+    from p2pfl_tpu.models.transformer import TransformerConfig, tiny_transformer
+    from p2pfl_tpu.parallel import SpmdLoraFederation
+
+    cfg = TransformerConfig(vocab_size=64, dim=32, n_layers=1, n_heads=2, n_kv_heads=2, ffn_hidden=64)
+    data = FederatedDataset.synthetic_lm(vocab_size=64, seq_len=16, n_train=4 * 32, n_test=16)
+
+    def build():
+        return SpmdLoraFederation.from_dataset(
+            tiny_transformer(seq_len=16, cfg=cfg), data, n_nodes=4,
+            batch_size=8, vote=False, seed=5,
+        )
+
+    seq = build()
+    for _ in range(3):
+        seq.run_round(epochs=1)
+    fused = build()
+    fused.run_fused(3, epochs=1)
+
+    for a, b in zip(jax.tree.leaves(seq.params), jax.tree.leaves(fused.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+    assert fused.round == 3
