@@ -13,6 +13,7 @@ from p2pfl_tpu.commands.control import (
     ModelsAggregatedCommand,
     ModelsReadyCommand,
     SecAggPubCommand,
+    SecAggRecoverCommand,
     VoteTrainSetCommand,
 )
 from p2pfl_tpu.commands.heartbeat import HeartbeatCommand
@@ -34,6 +35,7 @@ __all__ = [
     "ModelsReadyCommand",
     "MetricsCommand",
     "SecAggPubCommand",
+    "SecAggRecoverCommand",
     "InitModelCommand",
     "AddModelCommand",
 ]

@@ -66,7 +66,63 @@ class SecAggPubCommand(Command):
         if samples <= 0:
             logger.error(self._state.addr, f"Non-positive sample count from {source} — rejected")
             return
+        held = self._state.secagg_pubs.get(source)
+        if held is not None:
+            # latch the FIRST key per (source, experiment): the gossip plane
+            # is unauthenticated, so a later re-broadcast with a spoofed
+            # source must not replace the key a victim's peers already use
+            # (an attacker-controlled key would let them derive all of the
+            # victim's pair seeds and strip its masks). Identical
+            # re-deliveries are normal gossip redundancy.
+            if held != (pub, samples):
+                logger.error(
+                    self._state.addr,
+                    f"secagg_pub from {source} tried to replace an already-"
+                    "latched key — rejected (possible spoofing)",
+                )
+            return
         self._state.secagg_pubs[source] = (pub, samples)
+
+
+class SecAggRecoverCommand(Command):
+    """A survivor re-disclosed its pair seed for a dropped train-set member.
+
+    Args: ``[dropped_addr, seed_hex]``; the message's round field pins the
+    round being recovered. Stored under (round, dropped, source) — the
+    recovery routine in ``stages/learning_stages.py`` waits until every
+    survivor's seed for every missing member is present, then subtracts
+    the uncancelled mask sum (``learning/secagg.py:dropout_correction``).
+    """
+
+    def __init__(self, state: "NodeState") -> None:
+        self._state = state
+
+    @staticmethod
+    def get_name() -> str:
+        return "secagg_recover"
+
+    def execute(self, source: str, round: int, *args, **kwargs) -> None:  # noqa: A002
+        st = self._state
+        if len(args) < 2:
+            logger.error(st.addr, f"Malformed secagg_recover from {source}")
+            return
+        try:
+            seed = int(args[1], 16)
+        except ValueError:
+            logger.error(st.addr, f"Malformed secagg_recover seed from {source}")
+            return
+        if not 0 <= seed < (1 << 256):
+            # an out-of-range stored seed would make _leaf_mask's
+            # to_bytes(32) raise mid-recovery and kill the experiment on
+            # every survivor — one malformed message must not do that
+            logger.error(st.addr, f"Out-of-range secagg_recover seed from {source} — rejected")
+            return
+        if st.round is not None and round != st.round:
+            logger.debug(st.addr, f"secagg_recover from {source} for round {round} (at {st.round}) — ignored")
+            return
+        key = (round, args[0], source)
+        # first disclosure wins, same latch rationale as secagg_pub
+        st.secagg_disclosed.setdefault(key, seed)
 
 
 class VoteTrainSetCommand(Command):

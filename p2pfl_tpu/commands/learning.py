@@ -115,6 +115,21 @@ class AddModelCommand(Command):
                 f"add_model from {source} for stale round {round} (at {state.round}) — ignored",
             )
             return
+        if state.round is not None and round > state.round:
+            # future-round payload from a peer that finished ahead of us:
+            # accept only a FULL-coverage aggregate (the catch-up/liveness
+            # case — the behind node adopts the consensus and moves on). A
+            # future-round individual or partial contribution must not fold
+            # into THIS round's window: the train set is reused across
+            # rounds, so the aggregator would accept it as a disjoint
+            # round-r contributor and mix two rounds' models.
+            if not state.train_set or set(update.contributors) != set(state.train_set):
+                logger.debug(
+                    state.addr,
+                    f"add_model from {source} for future round {round} (at "
+                    f"{state.round}) is not a full aggregate — ignored",
+                )
+                return
         try:
             if update.params is None:
                 update = node.learner.materialize(update)

@@ -85,6 +85,17 @@ class Aggregator:
             self._models = {}
             self._complete.set()
 
+    def reset_experiment(self) -> None:
+        """Experiment boundary: drop cross-ROUND strategy state.
+
+        The per-round :meth:`clear` deliberately keeps state that persists
+        across rounds (FedOpt moments, CenteredClip's center); a new
+        experiment must not inherit it — round 0 would otherwise be
+        server-stepped/clipped against the PREVIOUS experiment's final
+        model. Called from experiment start, experiment end, and
+        stop-learning (``stages/learning_stages.py``, ``node.py``).
+        """
+
     # ---- collection ----
 
     def get_aggregated_models(self) -> list[str]:
@@ -187,12 +198,13 @@ class Aggregator:
             )
             if Settings.SECURE_AGGREGATION and covered != train:
                 # pairwise masks only cancel over the FULL train set; the
-                # missing members' masks are still riding on this aggregate
-                logger.error(
+                # missing members' masks still ride on this aggregate. The
+                # stage must run seed-disclosure recovery before applying it
+                # (GossipModelStage._secagg_finalize, learning/secagg.py).
+                logger.warning(
                     self.node_name,
-                    "SecAgg: partial coverage — unresolved pairwise masks, "
-                    "this round's aggregate is noise (dropout recovery is not "
-                    "implemented; see learning/secagg.py)",
+                    "SecAgg: partial coverage — unresolved pairwise masks; "
+                    "attempting dropout recovery",
                 )
         # a single model is returned as-is when (a) this node is waiting,
         # (b) the strategy is stateless, or (c) it is a full multi-node

@@ -64,3 +64,9 @@ class CenteredClip(Aggregator):
         # round's clip center
         self._center = update.params
         return update
+
+    def reset_experiment(self) -> None:
+        # a second experiment on the same node must re-bootstrap from the
+        # median, not clip round 0 against the previous experiment's final
+        # model (which would pin early progress to tau per round)
+        self._center = None

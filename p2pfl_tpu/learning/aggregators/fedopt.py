@@ -121,6 +121,16 @@ class FedOpt(Aggregator):
         return update
 
 
+    def reset_experiment(self) -> None:
+        # same staleness hazard as CenteredClip's center: a new experiment
+        # must not server-step its round 0 against the previous
+        # experiment's final global, nor inherit its moments
+        self._prev = None
+        self._m = None
+        self._v = None
+        self._t = 0
+
+
 class FedAdam(FedOpt):
     SERVER_OPT = "adam"
 

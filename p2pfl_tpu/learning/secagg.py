@@ -27,17 +27,42 @@ What a wire snoop sees is a single masked model — Gaussian noise of scale
 ``Settings.SECAGG_MASK_STD`` riding on the parameters, useless without the
 other train-set members' masks.
 
+**Threat model: passive wire snooping only.** The protected asset is the
+model payload crossing an insecure channel; the adversary reads traffic
+but does not inject or reorder control messages. Active attackers are out
+of scope — control messages (votes, heartbeats, key announcements,
+coverage) are unauthenticated plaintext, exactly like the reference's
+insecure channels. Two hardenings still apply against cheap active
+tricks: degenerate DH keys are rejected (:func:`valid_public_key`) and
+the FIRST key announced per (peer, experiment) is latched — a later
+``secagg_pub`` claiming the same source cannot replace it
+(``commands/control.py``).
+
+Dropout recovery (Bonawitz-style seed re-disclosure): when aggregation
+times out with partial train-set coverage, the leftover pairwise masks
+between survivors and the dropped nodes do not cancel. Survivors then
+re-disclose their pair seeds *for the dropped nodes only*
+(``secagg_recover`` messages), letting every aggregating node subtract
+the exact uncancelled sum (:func:`dropout_correction`) and recover the
+survivors' clean aggregate — availability degrades to a partial
+aggregate, like the reference's plain path
+(``p2pfl/learning/aggregators/aggregator.py:236-242``), instead of a
+destroyed model. Residual risk, documented: if a "dropped" node's masked
+update was captured on the wire but never reached an aggregator, the
+disclosed seeds could unmask that single update; the same applies to a
+node declared missing by SOME survivors' coverage views but not others
+(disclosures cover the union of announced missing sets, trading that
+node's single-update privacy for round availability). The full Bonawitz
+double-mask (a self-mask whose shares are never disclosed together with
+the pair seeds) closes this; under the passive-snooping threat model the
+race requires adversarial timing that is out of scope. A lone survivor
+never discloses anything — it corrects locally (its "aggregate" is its
+own model, which aggregation cannot protect anyway).
+
 Limits (documented, matching the protocol's nature):
 
 - FedAvg only: robust aggregators (Krum/median/...) need individual
   models, which is exactly what masking forbids.
-- If aggregation times out with partial train-set coverage, the leftover
-  masks do NOT cancel and the round's aggregate is noise. The full
-  Bonawitz protocol adds a seed-recovery round for dropouts; here the
-  failure is detected (coverage < train set) and logged as an error —
-  availability degrades instead of privacy.
-- Control messages (votes, heartbeats, coverage) stay plaintext, like the
-  reference's insecure channels; the protected asset is the model payload.
 - Wire compression must be off (``WIRE_COMPRESSION="none"``): per-node
   quantization of the masks breaks exact cancellation. Checked at
   experiment start.
@@ -100,7 +125,7 @@ def valid_public_key(pub: int) -> bool:
 
 
 def dh_pair_seed(priv: int, peer_pub: int, context: str) -> int:
-    """The shared 63-bit PRG seed for one (self, peer) pair.
+    """The shared 256-bit PRG key for one (self, peer) pair.
 
     Symmetric: both ends compute ``g^(xy) mod p`` and hash it with the
     experiment context, so seed(x, g^y) == seed(y, g^x).
@@ -111,17 +136,39 @@ def dh_pair_seed(priv: int, peer_pub: int, context: str) -> int:
         raise SecAggError("degenerate DH public key (value outside [2, p-2])")
     shared = pow(peer_pub, priv, DH_PRIME)
     h = hashlib.sha256(shared.to_bytes(256, "big") + context.encode("utf-8"))
-    return int.from_bytes(h.digest()[:8], "big") >> 1  # non-negative int64
+    return int.from_bytes(h.digest(), "big")
 
 
 def _leaf_mask(seed: int, round_no: int, shape: tuple, li: int) -> np.ndarray:
     """Deterministic N(0,1) mask block — same stream on both ends of a pair.
 
-    Seeded by (pair seed, round, leaf index) so masks are fresh every round
-    (a reused mask would leak the round-to-round parameter delta).
+    Keyed by (pair seed, round, leaf index) so masks are fresh every round
+    (a reused mask would leak the round-to-round parameter delta). The
+    stream is SHAKE-256 in XOF mode mapped through Box–Muller: a keyed
+    CSPRNG whose byte stream is defined by the hash standard on every
+    platform/library version — unlike NumPy's PCG64, whose stream is only
+    stable within a NumPy version line and is not cryptographic. The
+    Box–Muller ``log``/``cos``/``sin`` are not IEEE-correctly-rounded, so
+    heterogeneous numpy/libm builds may differ by ~1 ulp per value; the
+    resulting uncancelled residual is O(STD·2⁻²³) per pair — the same
+    class as the float32 addition rounding the protocol already tolerates
+    (vs. PCG64 version drift, which would diverge the ENTIRE stream).
     """
-    rng = np.random.default_rng([seed, round_no, li])
-    return rng.standard_normal(size=shape, dtype=np.float32)
+    n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+    m = 2 * ((n + 1) // 2)  # even count for Box–Muller pairing
+    material = hashlib.shake_256(
+        b"p2pfl-secagg-mask\x00"
+        + seed.to_bytes(32, "big")
+        + round_no.to_bytes(8, "big")
+        + li.to_bytes(8, "big")
+    ).digest(8 * m)
+    x = np.frombuffer(material, dtype=">u8").astype(np.float64)
+    u = (x + 1.0) * 2.0**-64  # uniform in (0, 1]; log() is safe
+    half = m // 2
+    r = np.sqrt(-2.0 * np.log(u[:half]))
+    theta = (2.0 * np.pi) * u[half:]
+    z = np.concatenate([r * np.cos(theta), r * np.sin(theta)])[:n]
+    return z.astype(np.float32).reshape(shape)
 
 
 def pairwise_mask(
@@ -149,6 +196,14 @@ def pairwise_mask(
     return out
 
 
+def pair_scale(w_i: float, w_j: float) -> float:
+    """The pair mask scale ``s_ij = STD·sqrt(w_i·w_j)`` — symmetric, from
+    the ANNOUNCED sample counts (both masking and dropout correction must
+    use the same values, which is why :func:`mask_update` latches the
+    announced count against the actual one)."""
+    return Settings.SECAGG_MASK_STD * float(np.sqrt(float(w_i) * float(w_j)))
+
+
 def mask_update(
     update: ModelUpdate,
     my_addr: str,
@@ -157,6 +212,7 @@ def mask_update(
     pubs: dict[str, tuple[int, int]],
     experiment: str,
     round_no: int,
+    announced_samples: Optional[int] = None,
 ) -> ModelUpdate:
     """Mask a node's own contribution before it enters the aggregator.
 
@@ -197,6 +253,16 @@ def mask_update(
         # FedAvg would weight this row by 0, annihilating our masks while
         # peers' matching pair terms survive — cancellation breaks
         raise SecAggError("cannot mask a contribution with zero sample weight")
+    if announced_samples is not None and update.num_samples != announced_samples:
+        # peers scale their half of each pair mask with the count WE
+        # announced alongside our DH key; masking with a different actual
+        # weight would leave a residual that survives a FULL-coverage
+        # aggregate — noise that no coverage check can detect
+        raise SecAggError(
+            f"num_samples changed since the key announcement "
+            f"({announced_samples} announced, {update.num_samples} now); "
+            "mask cancellation would silently break"
+        )
     if any(w <= 0 for _p, w in pubs.values()):
         raise SecAggError("a peer announced a non-positive sample count")
     bad_dtypes = {
@@ -219,21 +285,79 @@ def mask_update(
     seeds = {n: dh_pair_seed(priv, pubs[n][0], experiment) for n in peers}
     # s_ij/w_i with s_ij = STD·sqrt(w_i·w_j): per-pair magnitude
     # STD·sqrt(w_j/w_i), never vanishing with absolute dataset size
-    scales = {
-        n: Settings.SECAGG_MASK_STD * float(np.sqrt(w_i * float(pubs[n][1]))) / w_i
-        for n in peers
-    }
+    scales = {n: pair_scale(w_i, pubs[n][1]) / w_i for n in peers}
     masks = pairwise_mask(update.params, my_addr, seeds, round_no, scales)
 
-    leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(update.params)
-    from p2pfl_tpu.learning.weights import _SEP, _path_part
+    from p2pfl_tpu.learning.weights import named_leaves
 
-    new_leaves = []
-    for path, leaf in leaves_with_path:
-        key = _SEP.join(_path_part(p) for p in path)
-        new_leaves.append(jnp.asarray(leaf, jnp.float32) + masks[key])
-    masked = jax.tree_util.tree_unflatten(treedef, new_leaves)
+    treedef, keyed = named_leaves(update.params)
+    masked = jax.tree_util.tree_unflatten(
+        treedef, [jnp.asarray(leaf, jnp.float32) + masks[key] for key, leaf in keyed]
+    )
     return ModelUpdate(masked, list(update.contributors), update.num_samples)
+
+
+def dropout_correction(
+    template: Pytree,
+    survivors: list[str],
+    missing: list[str],
+    seeds: dict[tuple[str, str], int],
+    weights: dict[str, int],
+    round_no: int,
+) -> dict[str, np.ndarray]:
+    """The uncancelled mask sum left by dropped train-set members.
+
+    In the sample-weighted sum ``Σ_{i∈survivors} w_i·y_i`` each survivor i
+    contributes, for every missing peer j, the term
+    ``sign(i,j)·s_ij·PRG(seed_ij, round)`` — j's matching opposite term
+    never arrived. This returns that double sum as a flat {path: array}
+    dict; subtracting it (divided by the survivors' total weight) from the
+    partial aggregate recovers the survivors' clean weighted mean.
+
+    ``seeds`` maps (survivor, missing) → the pair seed — each survivor
+    knows its own pair seeds and re-discloses them via ``secagg_recover``
+    gossip; ``weights`` maps every involved address to its ANNOUNCED
+    sample count (the same values the masks were scaled with — enforced by
+    :func:`mask_update`'s announced-count latch). Pairs between two
+    missing nodes need no correction (neither side contributed), and pairs
+    between two survivors cancelled normally.
+    """
+    flat = _flatten_named(template)
+    keys = sorted(flat)
+    out: dict[str, np.ndarray] = {k: np.zeros(flat[k].shape, np.float32) for k in keys}
+    for i in survivors:
+        for j in missing:
+            sign = 1.0 if i < j else -1.0
+            s = pair_scale(weights[i], weights[j])
+            seed = seeds[(i, j)]
+            for li, k in enumerate(keys):
+                out[k] += (sign * s) * _leaf_mask(seed, round_no, flat[k].shape, li)
+    return out
+
+
+def apply_dropout_correction(
+    params: Pytree,
+    correction: dict[str, np.ndarray],
+    survivor_weight: float,
+) -> Pytree:
+    """Subtract ``correction / survivor_weight`` from a params pytree.
+
+    The partial aggregate is the weighted MEAN over survivors, so the
+    weighted-sum-domain correction is divided by their total weight.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from p2pfl_tpu.learning.weights import named_leaves
+
+    treedef, keyed = named_leaves(params)
+    return jax.tree_util.tree_unflatten(
+        treedef,
+        [
+            jnp.asarray(leaf, jnp.float32) - correction[key] / np.float32(survivor_weight)
+            for key, leaf in keyed
+        ],
+    )
 
 
 def masked_stack(params_stack: Pytree, weights, key, scale: float = None) -> Pytree:

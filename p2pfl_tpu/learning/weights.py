@@ -47,13 +47,22 @@ def anchor_digest(tree: Pytree) -> int:
     return crc
 
 
+def named_leaves(tree: Pytree):
+    """``(treedef, [(canonical path key, leaf), ...])`` in flatten order.
+
+    The single source of the path-key scheme shared by the wire codec and
+    secagg masking/recovery — keys built anywhere else would silently stop
+    matching if the scheme ever changed.
+    """
+    leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    return treedef, [
+        (_SEP.join(_path_part(p) for p in path), leaf) for path, leaf in leaves_with_path
+    ]
+
+
 def _flatten_named(tree: Pytree) -> dict[str, np.ndarray]:
     """Flatten a pytree (nested dicts / dataclass pytrees) to path->array."""
-    flat = {}
-    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
-        key = _SEP.join(_path_part(p) for p in path)
-        flat[key] = np.asarray(leaf)
-    return flat
+    return {key: np.asarray(leaf) for key, leaf in named_leaves(tree)[1]}
 
 
 def _path_part(p) -> str:

@@ -20,6 +20,7 @@ from p2pfl_tpu.commands import (
     ModelsAggregatedCommand,
     ModelsReadyCommand,
     SecAggPubCommand,
+    SecAggRecoverCommand,
     StartLearningCommand,
     StopLearningCommand,
     VoteTrainSetCommand,
@@ -88,6 +89,9 @@ class Node:
         self.total_rounds = 0
         self.epochs = 1
         self.pending_init_update: Optional[ModelUpdate] = None
+        # round-start global stash for secagg dropout fallback
+        # (stages/learning_stages.py TrainStage / GossipModelStage)
+        self.round_start_params: Optional[Any] = None
         self._interrupt = threading.Event()
         self._learning_thread: Optional[threading.Thread] = None
         self._running = False
@@ -104,6 +108,7 @@ class Node:
             ModelsReadyCommand(self.state),
             MetricsCommand(self.state),
             SecAggPubCommand(self.state),
+            SecAggRecoverCommand(self.state),
             InitModelCommand(self),
             AddModelCommand(self),
         ):
@@ -203,5 +208,6 @@ class Node:
         if self.learner is not None:
             self.learner.interrupt_fit()
         self.aggregator.clear()
+        self.aggregator.reset_experiment()
         self.state.clear()
         self.state.votes_ready_event.set()

@@ -34,9 +34,16 @@ class NodeState:
 
         # secure aggregation (learning/secagg.py): this node's DH private key
         # for the current experiment + peers' announced (public key, sample
-        # count) pairs
+        # count) pairs. Keys are latched: the FIRST announcement per peer
+        # per experiment wins (commands/control.py SecAggPubCommand).
         self.secagg_priv: Optional[int] = None
         self.secagg_pubs: Dict[str, tuple] = {}
+        # the sample count THIS node announced with its key — masking must
+        # use exactly this weight or pair masks stop cancelling
+        self.secagg_samples: Optional[int] = None
+        # dropout recovery: (round, dropped_addr, survivor_addr) -> pair
+        # seed the survivor re-disclosed via secagg_recover
+        self.secagg_disclosed: Dict[tuple, int] = {}
 
         # monotonically counts experiments entered; lets harnesses distinguish
         # "never started" from "finished" (both have round None)
@@ -80,5 +87,7 @@ class NodeState:
         self.train_set_votes = {}
         self.secagg_priv = None
         self.secagg_pubs = {}
+        self.secagg_samples = None
+        self.secagg_disclosed = {}
         self.votes_ready_event.clear()
         self.model_initialized_event.clear()

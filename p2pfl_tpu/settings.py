@@ -82,6 +82,10 @@ class Settings:
     # keys), so the mask drowns the parameters regardless of how large the
     # local datasets are. Requires WIRE_COMPRESSION="none".
     SECAGG_MASK_STD: float = 100.0
+    # How long a train-set node waits for peers' secagg_recover seed
+    # disclosures after an aggregation timeout with dropouts, before giving
+    # the round up (keeping the previous global instead of applying noise).
+    SECAGG_RECOVERY_TIMEOUT: float = 30.0
 
 
 def set_test_settings() -> None:
@@ -102,5 +106,6 @@ def set_test_settings() -> None:
     Settings.TRAIN_SET_SIZE = 4
     Settings.VOTE_TIMEOUT = 10.0
     Settings.AGGREGATION_TIMEOUT = 10.0
+    Settings.SECAGG_RECOVERY_TIMEOUT = 6.0
     Settings.WAIT_HEARTBEATS_CONVERGENCE = 0.4
     Settings.LOG_LEVEL = "DEBUG"

@@ -33,6 +33,9 @@ class StartLearningStage(Stage):
         state = node.state
         state.set_experiment(node.experiment_name, node.total_rounds)
         logger.experiment_started(node.addr)
+        # fresh experiment: cross-round strategy state (FedOpt moments,
+        # CenteredClip center) from any previous experiment must not leak in
+        node.aggregator.reset_experiment()
         node.learner.set_epochs(node.epochs)
         node.learner.set_addr(node.addr)
 
@@ -63,10 +66,15 @@ class StartLearningStage(Stage):
             # peers need for the pair mask scales) so any later train set can
             # derive pairwise mask seeds (learning/secagg.py)
             state.secagg_priv, pub = secagg.dh_keypair()
+            # latch the announced count: masking later checks the actual
+            # num_samples against it — peers scale their half of each pair
+            # mask with THIS value, so a silent divergence would break
+            # cancellation undetectably
+            state.secagg_samples = node.learner.get_num_samples()
             node.protocol.broadcast(
                 node.protocol.build_msg(
                     "secagg_pub",
-                    [f"{pub:x}", str(node.learner.get_num_samples())],
+                    [f"{pub:x}", str(state.secagg_samples)],
                     round=0,
                 )
             )
@@ -190,6 +198,11 @@ class TrainStage(Stage):
     def execute(node: "Node") -> Optional[Type[Stage]]:
         state = node.state
         node.aggregator.set_nodes_to_aggregate(state.train_set)
+        if Settings.SECURE_AGGREGATION:
+            # stash the round-start global: if a dropout makes the round's
+            # masked aggregate unrecoverable, the round is discarded back to
+            # this model instead of applying noise (GossipModelStage)
+            node.round_start_params = node.learner.get_parameters()
 
         # evaluate current model, share metrics (reference train_stage.py:59-60,95-112)
         TrainStage._evaluate(node)
@@ -256,6 +269,7 @@ class TrainStage(Stage):
                 dict(state.secagg_pubs),
                 state.experiment_name or "",
                 state.round or 0,
+                announced_samples=state.secagg_samples,
             )
         except SecAggError as exc:
             logger.error(node.addr, f"SecAgg: {exc} — skipping this round's contribution")
@@ -338,7 +352,15 @@ class GossipModelStage(Stage):
     @staticmethod
     def execute(node: "Node") -> Optional[Type[Stage]]:
         state = node.state
-        agg = node.aggregator.wait_and_get_aggregation()
+        timeout = None
+        if Settings.SECURE_AGGREGATION and node.addr not in state.train_set:
+            # non-train-set nodes only accept a full-coverage diffusion;
+            # leave headroom for the train set's seed-recovery round to
+            # finish before giving up on that diffusion arriving
+            timeout = Settings.AGGREGATION_TIMEOUT + Settings.SECAGG_RECOVERY_TIMEOUT
+        agg = node.aggregator.wait_and_get_aggregation(timeout=timeout)
+        if Settings.SECURE_AGGREGATION:
+            agg = GossipModelStage._secagg_finalize(node, agg)
         node.learner.set_parameters(agg.params)
         if node.learning_interrupted():
             return None
@@ -366,6 +388,124 @@ class GossipModelStage(Stage):
         if node.learning_interrupted():
             return None
         return RoundFinishedStage
+
+    @staticmethod
+    def _secagg_finalize(node: "Node", agg):
+        """Dropout recovery: strip uncancelled masks from a partial aggregate.
+
+        Full coverage → masks cancelled, pass through. Partial coverage
+        (some train-set member died before contributing) → the Bonawitz-style
+        seed-recovery round (``learning/secagg.py`` module docs): every
+        survivor re-discloses its pair seeds *for the missing members only*
+        (``secagg_recover`` broadcast), then everyone subtracts the exact
+        uncancelled mask sum and continues with the survivors' clean partial
+        aggregate — the same graceful degradation the reference's plain path
+        has (``p2pfl/learning/aggregators/aggregator.py:236-242``). If the
+        disclosures do not complete in ``Settings.SECAGG_RECOVERY_TIMEOUT``,
+        the noised aggregate is DISCARDED and the round resolves to the
+        round-start global (a no-op round) rather than destroying the model.
+        """
+        from p2pfl_tpu.learning import secagg
+        from p2pfl_tpu.learning.weights import ModelUpdate
+
+        state = node.state
+        train = set(state.train_set)
+        covered = set(agg.contributors)
+        if covered == train or len(train) <= 1:
+            return agg
+        round_no = state.round or 0
+        missing = sorted(train - covered)
+        survivors = sorted(covered)
+        logger.warning(
+            node.addr,
+            f"SecAgg: round {round_no} aggregate covers {survivors} — "
+            f"recovering from dropout of {missing}",
+        )
+
+        weights: dict[str, int] = {n: pk[1] for n, pk in state.secagg_pubs.items()}
+        if state.secagg_samples is not None:
+            weights[node.addr] = state.secagg_samples
+        recoverable = all(n in weights for n in set(survivors) | set(missing))
+
+        # Disclose own pair seeds for every member missing from ANY
+        # survivor's announced coverage (models_aggregated broadcasts), not
+        # just our own: coverage views can differ at timeout (a partial that
+        # reached us may have been lost to a peer), and a peer missing {C}
+        # needs OUR seed with C even though C is covered here. Exceptions:
+        # a LONE survivor never discloses (its "aggregate" is its own model;
+        # the seeds would let a wire snoop unmask it, and no peer holds
+        # anything that needs them), and a node that is itself among the
+        # missing has nothing of its own in any aggregate to correct.
+        # Divergence note: if views differ AND a needed disclosure is still
+        # lost, some nodes recover while others no-op the round — they
+        # briefly hold different models, exactly like the reference's plain
+        # partial-timeout path, and the next round's aggregation re-converges
+        # them.
+        exp = state.experiment_name or ""
+        if recoverable and node.addr in covered and len(survivors) > 1:
+            disclose_for = set(missing)
+            for peer in survivors:
+                view = state.models_aggregated.get(peer)
+                if peer != node.addr and view:
+                    disclose_for |= train - set(view)
+            disclose_for -= {node.addr}
+            for j in sorted(disclose_for):
+                if j not in state.secagg_pubs:
+                    continue
+                seed = secagg.dh_pair_seed(state.secagg_priv, state.secagg_pubs[j][0], exp)
+                node.protocol.broadcast(
+                    node.protocol.build_msg("secagg_recover", [j, f"{seed:x}"], round=round_no)
+                )
+
+        needed = {(i, j) for i in survivors for j in missing if i != node.addr}
+        deadline = time.monotonic() + Settings.SECAGG_RECOVERY_TIMEOUT
+        while (
+            recoverable
+            and not all((round_no, j, i) in state.secagg_disclosed for i, j in needed)
+            and time.monotonic() < deadline
+            and not node.learning_interrupted()
+        ):
+            time.sleep(0.1)
+
+        seeds: dict[tuple[str, str], int] = {}
+        if recoverable:
+            for i, j in needed:
+                v = state.secagg_disclosed.get((round_no, j, i))
+                if v is None:
+                    recoverable = False
+                    break
+                seeds[(i, j)] = v
+        if recoverable and node.addr in covered:
+            for j in missing:
+                seeds[(node.addr, j)] = secagg.dh_pair_seed(
+                    state.secagg_priv, state.secagg_pubs[j][0], exp
+                )
+
+        if not recoverable:
+            # ADVICE r2: never apply or diffuse a known-noised model — give
+            # the round up instead, keeping the round-start global
+            logger.error(
+                node.addr,
+                "SecAgg: seed recovery incomplete — discarding the noised "
+                "aggregate; this round is a no-op (round-start global kept)",
+            )
+            prev = getattr(node, "round_start_params", None)
+            if prev is None:
+                prev = node.learner.get_parameters()
+            return ModelUpdate(prev, sorted(train), max(int(agg.num_samples), 1))
+
+        correction = secagg.dropout_correction(
+            agg.params, survivors, missing, seeds, weights, round_no
+        )
+        params = secagg.apply_dropout_correction(
+            agg.params, correction, float(agg.num_samples)
+        )
+        logger.info(
+            node.addr,
+            f"SecAgg: recovered the survivors' clean aggregate ({len(survivors)} "
+            f"of {len(train)} members, {len(missing)} seed set(s) disclosed)",
+        )
+        return ModelUpdate(params, list(agg.contributors), agg.num_samples)
 
 
 class RoundFinishedStage(Stage):
@@ -408,5 +548,8 @@ class RoundFinishedStage(Stage):
         for k, v in (metrics or {}).items():
             logger.log_metric(node.addr, k, float(v), round=state.round, experiment=state.experiment_name)
         logger.experiment_finished(node.addr)
+        # NOTE: cross-round strategy state (FedOpt moments, clip centers) is
+        # NOT wiped here — it stays inspectable after the run; the next
+        # experiment's StartLearningStage resets it before anything happens
         state.clear()
         return None

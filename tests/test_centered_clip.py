@@ -50,6 +50,21 @@ def test_centered_clip_passes_honest_mean():
     )
 
 
+def test_centered_clip_experiment_reset():
+    """ADVICE r2 (low): the clip center must not survive an experiment
+    boundary — a second experiment would otherwise clip its round 0
+    against the previous experiment's final model, pinning early progress
+    to tau per round from a stale center."""
+    agg = CenteredClip("test", tau=1.0)
+    m = [ModelUpdate({"w": jnp.full((4,), v)}, [f"n{i}"], 1) for i, v in enumerate([1.0, 2.0])]
+    agg.aggregate(m)
+    assert agg._center is not None
+    agg.clear()  # per-round clear keeps the center (history-aware by design)
+    assert agg._center is not None
+    agg.reset_experiment()  # experiment boundary drops it
+    assert agg._center is None
+
+
 class _ByzantineLearner(JaxLearner):
     """fit() discards the real update and emits huge Gaussian noise."""
 

@@ -47,6 +47,23 @@ def test_fedopt_contributors_and_state_survive_clear():
     assert agg._t == 1  # server stepped, state survived
 
 
+def test_fedopt_experiment_reset_drops_server_state():
+    """ADVICE r2: a SECOND experiment on the same node must not server-step
+    its round 0 against the previous experiment's final global — the
+    experiment-boundary hook wipes moments and the previous-global anchor
+    (per-round clear() deliberately keeps them)."""
+    agg = FedAdam("test")
+    agg.aggregate(_updates([1.0, 1.0]))
+    agg.aggregate(_updates([0.0, 0.0]))
+    assert agg._t == 1 and agg._prev is not None
+    agg.reset_experiment()
+    assert agg._t == 0 and agg._prev is None and agg._m is None and agg._v is None
+    # fresh experiment bootstraps like round 0 again (adopts the average)
+    r = agg.aggregate(_updates([3.0, 5.0]))
+    assert agg._t == 0
+    np.testing.assert_allclose(np.asarray(r.params["w"]).mean(), 4.0)
+
+
 def test_fedopt_node_federation_converges():
     """2-node federation with FedAdam aggregation through the full stack."""
     from p2pfl_tpu.learning.learner import JaxLearner
@@ -68,6 +85,16 @@ def test_fedopt_node_federation_converges():
         wait_to_finish(nodes, timeout=120)
         check_equal_models(nodes)
         assert nodes[0].learner.evaluate()["test_acc"] > 0.5
+        # back-to-back SECOND experiment on the same nodes (ADVICE r2): the
+        # stage wiring must reset server state — _t counts this experiment's
+        # server steps only (3 rounds → ≤2 steps; stale state would carry
+        # the first experiment's count past that)
+        ts_after_first = max(n.aggregator._t for n in nodes)
+        assert 1 <= ts_after_first <= 2
+        nodes[0].set_start_learning(rounds=3, epochs=1)
+        wait_to_finish(nodes, timeout=120)
+        check_equal_models(nodes)
+        assert max(n.aggregator._t for n in nodes) <= 2
     finally:
         for n in nodes:
             n.stop()

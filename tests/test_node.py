@@ -202,3 +202,34 @@ def test_stale_round_add_model_rejected():
         assert node.aggregator.get_aggregated_models() == sorted([node.addr, "peer"])
     finally:
         node.stop()
+
+
+def test_future_round_individual_rejected():
+    """ADVICE r2 (low): a fast peer one round ahead gossips its round-r+1
+    INDIVIDUAL model; folding it into the round-r window would mix two
+    rounds' models. Only a full-coverage future aggregate (the catch-up
+    case) may pass."""
+    from p2pfl_tpu.commands.learning import AddModelCommand
+    from p2pfl_tpu.learning.weights import ModelUpdate
+
+    learner = JaxLearner(mlp(), _data(0, 2), batch_size=64)
+    node = Node(learner=learner)
+    node.start()
+    try:
+        node.state.model_initialized_event.set()
+        node.state.round = 1
+        node.state.train_set = [node.addr, "peer"]
+        node.aggregator.set_nodes_to_aggregate([node.addr, "peer"])
+        cmd = AddModelCommand(node)
+
+        # future-round INDIVIDUAL contribution: rejected by the gate
+        indiv = ModelUpdate(learner.get_parameters(), ["peer"], 10)
+        cmd.execute("peer", 2, update=indiv)
+        assert node.aggregator.get_aggregated_models() == []
+
+        # future-round FULL aggregate: the liveness/catch-up case, accepted
+        full = ModelUpdate(learner.get_parameters(), [node.addr, "peer"], 10)
+        cmd.execute("peer", 2, update=full)
+        assert node.aggregator.get_aggregated_models() == sorted([node.addr, "peer"])
+    finally:
+        node.stop()

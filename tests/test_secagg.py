@@ -186,6 +186,197 @@ def test_secure_federation_end_to_end():
         n.stop()
 
 
+def test_mask_stream_is_version_stable():
+    """The mask PRG is SHAKE-256 counter mode (ADVICE r2): its byte stream
+    is defined by the hash standard, not by NumPy's generator internals.
+    Golden values pin the stream; tolerance is a few float32 ulps because
+    Box–Muller's log/cos/sin are not correctly rounded across libm builds
+    (an ulp-level, bounded divergence — unlike PCG64 version drift)."""
+    m = secagg._leaf_mask(123456789, 3, (4,), 1)
+    np.testing.assert_allclose(
+        m, np.array([0.7085209, 0.7587952, -0.349858, 0.37594432], np.float32),
+        rtol=1e-5,
+    )
+    # and it is a credible standard normal
+    big = secagg._leaf_mask(7, 0, (100000,), 0)
+    assert abs(float(big.mean())) < 0.02 and abs(float(big.std()) - 1.0) < 0.02
+
+
+def test_secagg_pub_first_key_latched():
+    """ADVICE r2 (medium): the gossip plane is unauthenticated — a later
+    secagg_pub claiming an already-known source must NOT replace the
+    latched key (an attacker could otherwise swap in a key they control
+    and strip the victim's masks). Identical re-delivery is fine."""
+    from p2pfl_tpu.commands.control import SecAggPubCommand
+    from p2pfl_tpu.node_state import NodeState
+
+    state = NodeState("me")
+    cmd = SecAggPubCommand(state)
+    _, first = secagg.dh_keypair()
+    _, attacker = secagg.dh_keypair()
+    cmd.execute("victim", 0, f"{first:x}", "5")
+    assert state.secagg_pubs["victim"] == (first, 5)
+    cmd.execute("victim", 0, f"{attacker:x}", "5")  # spoofed replacement
+    assert state.secagg_pubs["victim"] == (first, 5)
+    cmd.execute("victim", 0, f"{first:x}", "7")  # same key, new count: also latched
+    assert state.secagg_pubs["victim"] == (first, 5)
+    cmd.execute("victim", 0, f"{first:x}", "5")  # identical re-delivery ok
+    assert state.secagg_pubs["victim"] == (first, 5)
+    # a new experiment clears the latch
+    state.clear()
+    cmd.execute("victim", 0, f"{attacker:x}", "5")
+    assert state.secagg_pubs["victim"] == (attacker, 5)
+
+
+def test_announced_sample_count_latched():
+    """ADVICE r2 (low): peers scale their mask halves with the count we
+    ANNOUNCED; masking with a diverged actual count would leave an
+    undetectable residual in a full-coverage aggregate — refuse loudly."""
+    from p2pfl_tpu.exceptions import SecAggError
+
+    addrs = ["a", "b"]
+    priv, _ = secagg.dh_keypair()
+    _, pub_b = secagg.dh_keypair()
+    p = {"w": np.ones((2, 2), np.float32)}
+    with pytest.raises(SecAggError, match="changed since"):
+        secagg.mask_update(
+            ModelUpdate(p, ["a"], 7), "a", addrs, priv, {"b": (pub_b, 5)}, "exp", 0,
+            announced_samples=5,
+        )
+    # matching count masks fine
+    out = secagg.mask_update(
+        ModelUpdate(p, ["a"], 5), "a", addrs, priv, {"b": (pub_b, 5)}, "exp", 0,
+        announced_samples=5,
+    )
+    assert out is not None
+
+
+def test_dropout_correction_recovers_survivor_mean():
+    """Bonawitz-style recovery math: with one member missing, subtracting
+    dropout_correction/W from the survivors' weighted mean recovers their
+    TRUE mean exactly (up to float32 rounding)."""
+    addrs = ["a", "b", "c", "d"]
+    keys = {n: secagg.dh_keypair() for n in addrs}
+    privs = {n: k[0] for n, k in keys.items()}
+    weights = {"a": 10, "b": 20, "c": 30, "d": 40}
+    pubs = {n: (keys[n][1], weights[n]) for n in addrs}
+    rng = np.random.default_rng(1)
+    params = {n: {"w": rng.normal(size=(16, 8)).astype(np.float32)} for n in addrs}
+    masked = {
+        n: _mask_for(n, addrs, privs, pubs, params[n], weights[n]) for n in addrs
+    }
+
+    survivors, missing = ["a", "b", "c"], ["d"]
+    w_s = sum(weights[n] for n in survivors)
+    noised = sum(
+        weights[n] * np.asarray(masked[n].params["w"], np.float64) for n in survivors
+    ) / w_s
+    true_mean = sum(weights[n] * params[n]["w"] for n in survivors) / w_s
+    assert np.abs(noised - true_mean).max() > 10  # the dropout DID noise it
+
+    # each survivor re-discloses its pair seed with the dropped node
+    seeds = {
+        (i, "d"): secagg.dh_pair_seed(privs[i], pubs["d"][0], "exp") for i in survivors
+    }
+    corr = secagg.dropout_correction(params["a"], survivors, missing, seeds, weights, 0)
+    fixed = secagg.apply_dropout_correction(
+        {"w": np.asarray(noised, np.float32)}, corr, float(w_s)
+    )
+    np.testing.assert_allclose(
+        np.asarray(fixed["w"], np.float64), true_mean, atol=1e-3
+    )
+
+
+class _SlowFitLearner(JaxLearner):
+    """Fit stalls long enough for the test to kill the node mid-round."""
+
+    def fit(self):
+        self._interrupt.wait(timeout=30)
+        super().fit()
+
+
+@pytest.mark.slow
+def test_secagg_dropout_recovery_end_to_end():
+    """Kill a train-set member mid-fit with SECURE_AGGREGATION on: the
+    survivors must run seed recovery and converge to a WORKING model (the
+    pre-recovery behavior left every node with Gaussian noise)."""
+    Settings.SECURE_AGGREGATION = True
+    full = FederatedDataset.synthetic_mnist(n_train=1024, n_test=256)
+    nodes = []
+    for i in range(4):
+        cls = _SlowFitLearner if i == 3 else JaxLearner
+        learner = cls(mlp(seed=i), full.partition(i, 4), batch_size=64)
+        node = Node(learner=learner)
+        node.start()
+        nodes.append(node)
+    try:
+        for n in nodes:
+            full_connection(n, nodes)
+        wait_convergence(nodes, 3, only_direct=True)
+        nodes[0].set_start_learning(rounds=1, epochs=1)
+        # node 3 dies mid-fit, after announcing its DH key but before
+        # contributing
+        time.sleep(3.0)
+        nodes[3].stop()
+        wait_to_finish(nodes[:3], timeout=120)
+        check_equal_models(nodes[:3])
+        acc = nodes[0].learner.evaluate()["test_acc"]
+        assert acc > 0.7, acc  # masks recovered — not noise
+    finally:
+        for n in nodes:
+            n.stop()
+
+
+def test_secagg_unrecoverable_round_is_noop():
+    """ADVICE r2 (medium): when seed disclosures never arrive, the noised
+    aggregate must be DISCARDED — the round resolves to the round-start
+    global instead of applying and diffusing a destroyed model."""
+    from p2pfl_tpu.stages.learning_stages import GossipModelStage
+    from p2pfl_tpu.node_state import NodeState
+
+    Settings.SECURE_AGGREGATION = True
+    Settings.SECAGG_RECOVERY_TIMEOUT = 0.3
+
+    state = NodeState("a")
+    state.set_experiment("exp", 1)
+    state.train_set = ["a", "b", "c"]
+    priv, pub = secagg.dh_keypair()
+    state.secagg_priv = priv
+    state.secagg_samples = 10
+    for peer in ("b", "c"):
+        _, p = secagg.dh_keypair()
+        state.secagg_pubs[peer] = (p, 10)
+
+    class _FakeProto:
+        def broadcast(self, msg):
+            pass
+
+        def build_msg(self, *a, **k):
+            return {}
+
+    class _FakeLearner:
+        def get_parameters(self):
+            return {"w": np.full((2, 2), 7.0, np.float32)}
+
+    class _FakeNode:
+        addr = "a"
+
+        def __init__(self):
+            self.state = state
+            self.protocol = _FakeProto()
+            self.learner = _FakeLearner()
+            self.round_start_params = {"w": np.full((2, 2), 7.0, np.float32)}
+
+        def learning_interrupted(self):
+            return False
+
+    noised = ModelUpdate({"w": np.full((2, 2), 999.0, np.float32)}, ["a", "b"], 20)
+    out = GossipModelStage._secagg_finalize(_FakeNode(), noised)
+    # "c"'s masks never got disclosed ("b" said nothing): round is a no-op
+    np.testing.assert_array_equal(np.asarray(out.params["w"]), 7.0)
+    assert set(out.contributors) == {"a", "b", "c"}
+
+
 def test_masked_stack_on_mesh():
     """Device-side op: masking a node-stacked pytree leaves the weighted
     FedAvg unchanged while each slot's params are drowned in noise."""
