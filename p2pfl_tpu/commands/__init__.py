@@ -13,6 +13,7 @@ from p2pfl_tpu.commands.control import (
     ModelsAggregatedCommand,
     ModelsReadyCommand,
     SecAggPubCommand,
+    SecAggNeedCommand,
     SecAggRecoverCommand,
     VoteTrainSetCommand,
 )
@@ -35,6 +36,7 @@ __all__ = [
     "ModelsReadyCommand",
     "MetricsCommand",
     "SecAggPubCommand",
+    "SecAggNeedCommand",
     "SecAggRecoverCommand",
     "InitModelCommand",
     "AddModelCommand",

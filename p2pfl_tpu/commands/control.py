@@ -125,6 +125,52 @@ class SecAggRecoverCommand(Command):
         st.secagg_disclosed.setdefault(key, seed)
 
 
+class SecAggNeedCommand(Command):
+    """A recovering peer announced which members' masks it cannot cancel.
+
+    Args: the missing addresses. Every train-set member answers by
+    re-disclosing its pair seed for exactly those members — INCLUDING
+    members whose own coverage reached full (they finalize early and would
+    otherwise never disclose, leaving a peer with a smaller coverage view
+    to burn its recovery timeout for nothing). Pair seeds are
+    per-experiment, so answering for an earlier round than the responder's
+    current one is safe. Needs the Node (not just state) for the reply
+    broadcast.
+    """
+
+    def __init__(self, node) -> None:  # "Node"; untyped to avoid the import cycle
+        self._node = node
+
+    @staticmethod
+    def get_name() -> str:
+        return "secagg_need"
+
+    def execute(self, source: str, round: int, *args, **kwargs) -> None:  # noqa: A002
+        from p2pfl_tpu.learning import secagg
+
+        node = self._node
+        st = node.state
+        if st.secagg_priv is None or not args or st.round is None or round > st.round:
+            return
+        train = set(st.train_set)
+        if node.addr not in train or len(train) <= 2:
+            # in a 2-member set the only pair seed IS the full mask of the
+            # other member's update — never disclose it
+            return
+        exp = st.experiment_name or ""
+        for j in args:
+            if j == node.addr or j == source or j not in train or j not in st.secagg_pubs:
+                continue
+            key = (round, j)
+            if key in st.secagg_disclosure_sent:
+                continue
+            st.secagg_disclosure_sent.add(key)
+            seed = secagg.dh_pair_seed(st.secagg_priv, st.secagg_pubs[j][0], exp)
+            node.protocol.broadcast(
+                node.protocol.build_msg("secagg_recover", [j, f"{seed:x}"], round=round)
+            )
+
+
 class VoteTrainSetCommand(Command):
     """Train-set vote: flat ``[name, weight, name, weight, ...]`` pairs.
 

@@ -122,7 +122,12 @@ class AddModelCommand(Command):
             # future-round individual or partial contribution must not fold
             # into THIS round's window: the train set is reused across
             # rounds, so the aggregator would accept it as a disjoint
-            # round-r contributor and mix two rounds' models.
+            # round-r contributor and mix two rounds' models. Under
+            # VOTE_EVERY_ROUND a future aggregate from a re-voted DIFFERENT
+            # train set is rejected here too — no loss: the aggregator's
+            # own contributor checks (waiting mode requires an exact
+            # train-set match) would reject it anyway, and the behind node
+            # recovers via its normal timeout path.
             if not state.train_set or set(update.contributors) != set(state.train_set):
                 logger.debug(
                     state.addr,

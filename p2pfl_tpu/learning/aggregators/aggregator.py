@@ -92,8 +92,10 @@ class Aggregator:
         across rounds (FedOpt moments, CenteredClip's center); a new
         experiment must not inherit it — round 0 would otherwise be
         server-stepped/clipped against the PREVIOUS experiment's final
-        model. Called from experiment start, experiment end, and
-        stop-learning (``stages/learning_stages.py``, ``node.py``).
+        model. Called at experiment START (StartLearningStage — the
+        authoritative reset) and on stop-learning (``node.py``); a
+        naturally-finished experiment does NOT reset, so the final strategy
+        state stays inspectable after the run.
         """
 
     # ---- collection ----

@@ -20,6 +20,7 @@ from p2pfl_tpu.commands import (
     ModelsAggregatedCommand,
     ModelsReadyCommand,
     SecAggPubCommand,
+    SecAggNeedCommand,
     SecAggRecoverCommand,
     StartLearningCommand,
     StopLearningCommand,
@@ -109,6 +110,7 @@ class Node:
             MetricsCommand(self.state),
             SecAggPubCommand(self.state),
             SecAggRecoverCommand(self.state),
+            SecAggNeedCommand(self),
             InitModelCommand(self),
             AddModelCommand(self),
         ):

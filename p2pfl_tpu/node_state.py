@@ -44,6 +44,9 @@ class NodeState:
         # dropout recovery: (round, dropped_addr, survivor_addr) -> pair
         # seed the survivor re-disclosed via secagg_recover
         self.secagg_disclosed: Dict[tuple, int] = {}
+        # (round, dropped_addr) pairs THIS node already disclosed its seed
+        # for (proactively or answering secagg_need) — disclose once
+        self.secagg_disclosure_sent: set = set()
 
         # monotonically counts experiments entered; lets harnesses distinguish
         # "never started" from "finished" (both have round None)
@@ -89,5 +92,6 @@ class NodeState:
         self.secagg_pubs = {}
         self.secagg_samples = None
         self.secagg_disclosed = {}
+        self.secagg_disclosure_sent = set()
         self.votes_ready_event.clear()
         self.model_initialized_event.clear()

@@ -427,37 +427,42 @@ class GossipModelStage(Stage):
             weights[node.addr] = state.secagg_samples
         recoverable = all(n in weights for n in set(survivors) | set(missing))
 
-        # Disclose own pair seeds for every member missing from ANY
-        # survivor's announced coverage (models_aggregated broadcasts), not
-        # just our own: coverage views can differ at timeout (a partial that
-        # reached us may have been lost to a peer), and a peer missing {C}
-        # needs OUR seed with C even though C is covered here. Exceptions:
-        # a LONE survivor never discloses (its "aggregate" is its own model;
-        # the seeds would let a wire snoop unmask it, and no peer holds
-        # anything that needs them), and a node that is itself among the
-        # missing has nothing of its own in any aggregate to correct.
-        # Divergence note: if views differ AND a needed disclosure is still
-        # lost, some nodes recover while others no-op the round — they
-        # briefly hold different models, exactly like the reference's plain
-        # partial-timeout path, and the next round's aggregation re-converges
-        # them.
+        # Recovery is request/response: broadcast WHICH members' masks we
+        # cannot cancel (secagg_need) — every train-set member answers with
+        # its pair seed for exactly those members (SecAggNeedCommand),
+        # INCLUDING peers whose own coverage reached full and finalized
+        # early (coverage views can differ at timeout: a partial that
+        # reached us may have been lost to a peer). Proactively disclose our
+        # own seeds for our own missing set too — peers recovering the same
+        # view get them without a round trip. A LONE survivor never
+        # discloses (its "aggregate" is its own model; the seeds would let
+        # a wire snoop unmask it, and no peer holds anything that needs
+        # them). Divergence note: if a needed disclosure is still lost,
+        # some nodes recover while others no-op the round — they briefly
+        # hold different models, exactly like the reference's plain
+        # partial-timeout path, and the next round's aggregation
+        # re-converges them.
         exp = state.experiment_name or ""
+        ask_for = [j for j in missing if j != node.addr]
+        if recoverable and ask_for:
+            node.protocol.broadcast(
+                node.protocol.build_msg("secagg_need", ask_for, round=round_no)
+            )
         if recoverable and node.addr in covered and len(survivors) > 1:
-            disclose_for = set(missing)
-            for peer in survivors:
-                view = state.models_aggregated.get(peer)
-                if peer != node.addr and view:
-                    disclose_for |= train - set(view)
-            disclose_for -= {node.addr}
-            for j in sorted(disclose_for):
-                if j not in state.secagg_pubs:
+            for j in ask_for:
+                if j not in state.secagg_pubs or (round_no, j) in state.secagg_disclosure_sent:
                     continue
+                state.secagg_disclosure_sent.add((round_no, j))
                 seed = secagg.dh_pair_seed(state.secagg_priv, state.secagg_pubs[j][0], exp)
                 node.protocol.broadcast(
                     node.protocol.build_msg("secagg_recover", [j, f"{seed:x}"], round=round_no)
                 )
 
-        needed = {(i, j) for i in survivors for j in missing if i != node.addr}
+        # pairs involving this node are locally computable by DH symmetry —
+        # only wait the gossip plane for the strictly-foreign pairs
+        needed = {
+            (i, j) for i in survivors for j in missing if node.addr not in (i, j)
+        }
         deadline = time.monotonic() + Settings.SECAGG_RECOVERY_TIMEOUT
         while (
             recoverable
@@ -475,11 +480,17 @@ class GossipModelStage(Stage):
                     recoverable = False
                     break
                 seeds[(i, j)] = v
-        if recoverable and node.addr in covered:
-            for j in missing:
-                seeds[(node.addr, j)] = secagg.dh_pair_seed(
-                    state.secagg_priv, state.secagg_pubs[j][0], exp
-                )
+        if recoverable:
+            for i in survivors:
+                for j in missing:
+                    if node.addr == i:
+                        seeds[(i, j)] = secagg.dh_pair_seed(
+                            state.secagg_priv, state.secagg_pubs[j][0], exp
+                        )
+                    elif node.addr == j:
+                        seeds[(i, j)] = secagg.dh_pair_seed(
+                            state.secagg_priv, state.secagg_pubs[i][0], exp
+                        )
 
         if not recoverable:
             # ADVICE r2: never apply or diffuse a known-noised model — give

@@ -377,6 +377,59 @@ def test_secagg_unrecoverable_round_is_noop():
     assert set(out.contributors) == {"a", "b", "c"}
 
 
+def test_secagg_need_answered_by_full_coverage_peer():
+    """Coverage views can differ at timeout: a peer whose OWN aggregate
+    reached full coverage finalizes early and would never disclose on its
+    own — it must still answer a recovering peer's secagg_need broadcast
+    (and never for a 2-member train set, where the only pair seed IS the
+    other member's full mask)."""
+    from p2pfl_tpu.commands.control import SecAggNeedCommand
+    from p2pfl_tpu.node_state import NodeState
+
+    sent = []
+
+    class _Proto:
+        def broadcast(self, msg):
+            sent.append(msg)
+
+        def build_msg(self, cmd, args, round=0):  # noqa: A002
+            return (cmd, list(args), round)
+
+    class _FakeNode:
+        def __init__(self, addr, train):
+            self.addr = addr
+            self.state = NodeState(addr)
+            self.state.set_experiment("exp", 1)
+            self.state.train_set = list(train)
+            self.protocol = _Proto()
+
+    node = _FakeNode("a", ["a", "b", "c", "d"])
+    priv, _ = secagg.dh_keypair()
+    node.state.secagg_priv = priv
+    for peer in ("b", "c", "d"):
+        _, p = secagg.dh_keypair()
+        node.state.secagg_pubs[peer] = (p, 10)
+
+    cmd = SecAggNeedCommand(node)
+    cmd.execute("b", 0, "d")  # b cannot cancel d's masks
+    assert len(sent) == 1 and sent[0][0] == "secagg_recover" and sent[0][1][0] == "d"
+    expected = secagg.dh_pair_seed(priv, node.state.secagg_pubs["d"][0], "exp")
+    assert int(sent[0][1][1], 16) == expected
+    cmd.execute("c", 0, "d")  # second request: already disclosed, no re-send
+    assert len(sent) == 1
+    cmd.execute("b", 0, "a", "b", "zz")  # self / requester / unknown: ignored
+    assert len(sent) == 1
+
+    # 2-member train set never discloses
+    sent.clear()
+    pair = _FakeNode("a", ["a", "b"])
+    pair.state.secagg_priv = priv
+    pair.state.secagg_pubs["b"] = node.state.secagg_pubs["b"]
+    pair.protocol = node.protocol  # reuse the recorder
+    SecAggNeedCommand(pair).execute("b", 0, "b")
+    assert sent == []
+
+
 def test_masked_stack_on_mesh():
     """Device-side op: masking a node-stacked pytree leaves the weighted
     FedAvg unchanged while each slot's params are drowned in noise."""
