@@ -128,14 +128,24 @@ class SecAggRecoverCommand(Command):
 class SecAggNeedCommand(Command):
     """A recovering peer announced which members' masks it cannot cancel.
 
-    Args: the missing addresses. Every train-set member answers by
-    re-disclosing its pair seed for exactly those members — INCLUDING
-    members whose own coverage reached full (they finalize early and would
-    otherwise never disclose, leaving a peer with a smaller coverage view
-    to burn its recovery timeout for nothing). Pair seeds are
-    per-experiment, so answering for an earlier round than the responder's
-    current one is safe. Needs the Node (not just state) for the reply
-    broadcast.
+    Args: ``[experiment_name, missing...]``. A train-set member answers by
+    re-disclosing its pair seed for the named members — INCLUDING when its
+    own coverage reached full (early finalizers would otherwise never
+    disclose, leaving a peer with a smaller coverage view to burn its
+    recovery timeout for nothing). Pair seeds are per-experiment, so
+    answering for the previous round is safe; the experiment name in the
+    request guards against latching a wrong-experiment seed.
+
+    A request is a claim, not proof — the responder demands its OWN
+    evidence before disclosing anything: it only answers for members that
+    are no longer live on the overlay (heartbeat-evicted; a genuinely
+    dropped node disappears within HEARTBEAT_TIMEOUT, long before any
+    AGGREGATION_TIMEOUT fires). A forged secagg_need naming a live member
+    is refused — the requester then no-ops its round (availability
+    sacrificed, the live member's masks kept). Requests must also come
+    from a train-set member. Under VOTE_EVERY_ROUND a re-voted train set
+    can make cross-round requests unanswerable (``j not in train``) — the
+    requester degrades to a no-op round.
     """
 
     def __init__(self, node) -> None:  # "Node"; untyped to avoid the import cycle
@@ -150,16 +160,30 @@ class SecAggNeedCommand(Command):
 
         node = self._node
         st = node.state
-        if st.secagg_priv is None or not args or st.round is None or round > st.round:
+        if st.secagg_priv is None or len(args) < 2 or st.round is None:
             return
-        train = set(st.train_set)
-        if node.addr not in train or len(train) <= 2:
-            # in a 2-member set the only pair seed IS the full mask of the
-            # other member's update — never disclose it
+        if round not in (st.round - 1, st.round):
             return
         exp = st.experiment_name or ""
-        for j in args:
+        if args[0] != exp:
+            logger.debug(st.addr, f"secagg_need from {source} for experiment {args[0]!r} — ignored")
+            return
+        train = set(st.train_set)
+        if node.addr not in train or source not in train or len(train) <= 2:
+            # non-members have no standing to request; in a 2-member train
+            # set the only pair seed IS the full mask of the other member's
+            # update — never disclose it
+            return
+        live = set(node.protocol.get_neighbors(only_direct=False))
+        for j in args[1:]:
             if j == node.addr or j == source or j not in train or j not in st.secagg_pubs:
+                continue
+            if j in live:
+                logger.warning(
+                    st.addr,
+                    f"secagg_need from {source} names {j}, which is still live "
+                    "here — refusing to disclose its pair seed",
+                )
                 continue
             key = (round, j)
             if key in st.secagg_disclosure_sent:

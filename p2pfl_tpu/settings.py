@@ -88,6 +88,44 @@ class Settings:
     SECAGG_RECOVERY_TIMEOUT: float = 30.0
 
 
+def set_low_latency_settings() -> None:
+    """Documented low-latency profile for reliable local networks.
+
+    The defaults above mirror the reference's knobs, which are tuned for
+    lossy wide-area overlays (1 s model-gossip ticks, 2 s heartbeats,
+    60 s vote windows). On a reliable local network — one host, a rack,
+    or a TPU-pod's DCN — those quantize every round to multiples of
+    whole seconds for no benefit. This profile keeps EVERY semantic
+    (same verbs, same stall/timeout exits, same vote formula; only the
+    clocks shrink) while cutting protocol overhead per round to
+    sub-second (fan-out and capacity knobs like GOSSIP_MODELS_PER_ROUND
+    are deliberately untouched):
+
+    - model-gossip tick 1 s → 0.05 s: the tick loop re-checks peer
+      status 20×/s instead of 1×/s, so the diffusion/partial loops exit
+      ~0.5 s after the decisive message instead of up to 1 s + stall
+      window (stall exit stays at GOSSIP_EXIT_ON_X_EQUAL_ROUNDS ticks —
+      the same number of unchanged observations).
+    - heartbeats 2/5 s → 0.3/1.5 s: membership converges in ~0.3 s; the
+      WAIT_HEARTBEATS_CONVERGENCE pause shrinks to match.
+    - vote/aggregation ceilings 60/300 s → 15/60 s: failure detection
+      latency, not steady-state cost — rounds that complete never see
+      them.
+
+    Measured effect (BASELINE config 1, 2-node MNIST MLP, CPU): protocol
+    overhead drops under the per-round compute (fit + eval dominate).
+    """
+    Settings.GRPC_TIMEOUT = 2.0
+    Settings.HEARTBEAT_PERIOD = 0.3
+    Settings.HEARTBEAT_TIMEOUT = 1.5
+    Settings.GOSSIP_PERIOD = 0.02
+    Settings.GOSSIP_MODELS_PERIOD = 0.05
+    Settings.VOTE_TIMEOUT = 15.0
+    Settings.AGGREGATION_TIMEOUT = 60.0
+    Settings.SECAGG_RECOVERY_TIMEOUT = 10.0
+    Settings.WAIT_HEARTBEATS_CONVERGENCE = 0.4
+
+
 def set_test_settings() -> None:
     """Shrink every timeout for fast tests.
 

@@ -442,14 +442,24 @@ class GossipModelStage(Stage):
         # hold different models, exactly like the reference's plain
         # partial-timeout path, and the next round's aggregation
         # re-converges them.
+        # pairs involving this node are locally computable by DH symmetry —
+        # only the strictly-foreign pairs need the gossip plane, and only
+        # when some exist is a secagg_need broadcast justified (a lone
+        # survivor asking would solicit disclosures nobody uses)
+        needed = {
+            (i, j) for i in survivors for j in missing if node.addr not in (i, j)
+        }
         exp = state.experiment_name or ""
-        ask_for = [j for j in missing if j != node.addr]
-        if recoverable and ask_for:
+        if recoverable and needed:
             node.protocol.broadcast(
-                node.protocol.build_msg("secagg_need", ask_for, round=round_no)
+                node.protocol.build_msg(
+                    "secagg_need",
+                    [exp] + sorted({j for _i, j in needed}),
+                    round=round_no,
+                )
             )
         if recoverable and node.addr in covered and len(survivors) > 1:
-            for j in ask_for:
+            for j in missing:
                 if j not in state.secagg_pubs or (round_no, j) in state.secagg_disclosure_sent:
                     continue
                 state.secagg_disclosure_sent.add((round_no, j))
@@ -457,12 +467,6 @@ class GossipModelStage(Stage):
                 node.protocol.broadcast(
                     node.protocol.build_msg("secagg_recover", [j, f"{seed:x}"], round=round_no)
                 )
-
-        # pairs involving this node are locally computable by DH symmetry —
-        # only wait the gossip plane for the strictly-foreign pairs
-        needed = {
-            (i, j) for i in survivors for j in missing if node.addr not in (i, j)
-        }
         deadline = time.monotonic() + Settings.SECAGG_RECOVERY_TIMEOUT
         while (
             recoverable

@@ -76,6 +76,7 @@ class _ByzantineLearner(JaxLearner):
         )
 
 
+@pytest.mark.slow
 def test_host_centered_clip_resists_byzantine_gossip():
     """3-node gossip federation, one ACTIVELY malicious node emitting
     100-sigma noise every round: CenteredClip keeps the federation training
@@ -100,6 +101,7 @@ def test_host_centered_clip_resists_byzantine_gossip():
         n.stop()
 
 
+@pytest.mark.slow
 def test_spmd_centered_clip_resists_byzantine():
     full = FederatedDataset.synthetic_mnist(n_train=1024, n_test=256)
     fed = SpmdFederation.from_dataset(

@@ -15,6 +15,7 @@ def _fed(n=4, **kw):
     return SpmdFederation.from_dataset(mlp(), data, n_nodes=n, batch_size=64, **kw)
 
 
+@pytest.mark.slow
 def test_drop_node_mid_training():
     """A dropped node stops contributing; the federation keeps converging."""
     fed = _fed()

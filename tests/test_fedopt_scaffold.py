@@ -64,6 +64,7 @@ def test_fedopt_experiment_reset_drops_server_state():
     np.testing.assert_allclose(np.asarray(r.params["w"]).mean(), 4.0)
 
 
+@pytest.mark.slow
 def test_fedopt_node_federation_converges():
     """2-node federation with FedAdam aggregation through the full stack."""
     from p2pfl_tpu.learning.learner import JaxLearner
@@ -100,6 +101,7 @@ def test_fedopt_node_federation_converges():
             n.stop()
 
 
+@pytest.mark.slow
 def test_fedopt_gossips_individual_models():
     """FedOpt is stateful+nonlinear: it must NOT pre-aggregate gossip
     partials (that would advance server moments mid-round and emit
@@ -146,6 +148,7 @@ def test_fedopt_gossips_individual_models():
             n.stop()
 
 
+@pytest.mark.slow
 def test_scaffold_fedopt_checkpoint_roundtrip(tmp_path):
     """save/restore must carry SCAFFOLD variates and FedOpt server moments —
     silently zeroing them on resume degrades the algorithm."""
@@ -171,6 +174,7 @@ def test_scaffold_fedopt_checkpoint_roundtrip(tmp_path):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow
 def test_fedprox_pulls_toward_anchor():
     """Large μ keeps the trained params measurably closer to the start."""
     from p2pfl_tpu.learning.learner import JaxLearner
@@ -189,6 +193,7 @@ def test_fedprox_pulls_toward_anchor():
     assert drift(mu=10.0) < drift(mu=0.0) * 0.8
 
 
+@pytest.mark.slow
 def test_spmd_fedprox_learns():
     data = FederatedDataset.synthetic_mnist(n_train=1024, n_test=256)
     fed = SpmdFederation.from_dataset(
@@ -212,6 +217,7 @@ def test_spmd_scaffold_learns_and_updates_variates():
     assert max(float(jnp.abs(x).max()) for x in jax.tree.leaves(fed.c_global)) > 0
 
 
+@pytest.mark.slow
 def test_spmd_scaffold_partial_train_set():
     """Variates only update for elected nodes; the round still runs."""
     from p2pfl_tpu.settings import Settings
@@ -235,6 +241,7 @@ def test_spmd_scaffold_partial_train_set():
         Settings.TRAIN_SET_SIZE = old
 
 
+@pytest.mark.slow
 def test_spmd_server_opt_learns():
     """SPMD FedOpt: server Adam on the pseudo-gradient, moments carried."""
     data = FederatedDataset.synthetic_mnist(n_train=1024, n_test=256)

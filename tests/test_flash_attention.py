@@ -39,6 +39,7 @@ def test_flash_uneven_blocks():
     np.testing.assert_allclose(np.asarray(want), np.asarray(got), atol=2e-5)
 
 
+@pytest.mark.slow
 def test_flash_gradient_matches_dense():
     q, k, v = _qkv(b=1, t=32, h=2, d=16)
 
@@ -54,6 +55,7 @@ def test_flash_gradient_matches_dense():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
 
 
+@pytest.mark.slow
 def test_flash_in_transformer():
     """The attn="flash" selector wires the kernel into the model."""
     from p2pfl_tpu.models.transformer import TransformerConfig, tiny_transformer
@@ -67,6 +69,7 @@ def test_flash_in_transformer():
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-2)
 
 
+@pytest.mark.slow
 def test_flash_transformer_training_grads_match_dense():
     """Training the transformer with flash attention: full LM-loss gradients
     match the dense model's (pattern of test_ring_training.py)."""

@@ -120,6 +120,7 @@ def test_grpc_int8_wire_compression_end_to_end():
             n.stop()
 
 
+@pytest.mark.slow
 def test_two_process_grpc_demo():
     """examples/node1.py + node2.py: two OS processes, real loopback sockets
     (the reference's node1/node2 demo, ``p2pfl/examples/node1.py``)."""

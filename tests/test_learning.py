@@ -269,6 +269,7 @@ def test_double_start_raises():
     agg.set_nodes_to_aggregate(["a"])  # ok after clear
 
 
+@pytest.mark.slow
 def test_vit_forward_and_federated_training():
     """ViT (attention-based vision model — beyond the reference's MLP/CNN):
     forward shape, then an SPMD federation learns on CIFAR-shaped data."""
@@ -302,6 +303,7 @@ def test_vit_forward_and_federated_training():
     assert after > max(before, 0.5)
 
 
+@pytest.mark.slow
 def test_bulyan_resists_coordinate_attack():
     """Bulyan (Krum select + trimmed mean) survives both large-distance
     outliers AND the 'a little is enough' per-coordinate attack; needs

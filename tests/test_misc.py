@@ -9,6 +9,37 @@ import numpy as np
 import pytest
 
 
+def test_low_latency_profile_preserves_semantic_knobs():
+    """The documented low-latency profile only shrinks clocks; semantic
+    knobs (train-set size, TTL, stall-exit tick count, vote formula) stay
+    untouched so round outcomes match the defaults."""
+    from p2pfl_tpu.settings import Settings, set_low_latency_settings
+
+    semantic_before = (
+        Settings.TRAIN_SET_SIZE,
+        Settings.TTL,
+        Settings.GOSSIP_EXIT_ON_X_EQUAL_ROUNDS,
+        Settings.VOTE_EVERY_ROUND,
+        Settings.WIRE_COMPRESSION,
+    )
+    set_low_latency_settings()
+    try:
+        assert (
+            Settings.TRAIN_SET_SIZE,
+            Settings.TTL,
+            Settings.GOSSIP_EXIT_ON_X_EQUAL_ROUNDS,
+            Settings.VOTE_EVERY_ROUND,
+            Settings.WIRE_COMPRESSION,
+        ) == semantic_before
+        assert Settings.GOSSIP_MODELS_PERIOD <= 0.1
+        assert Settings.HEARTBEAT_PERIOD <= 0.5
+        assert Settings.VOTE_TIMEOUT < 60.0
+    finally:
+        from p2pfl_tpu.settings import set_test_settings
+
+        set_test_settings()
+
+
 def test_stopwatch_sections():
     from p2pfl_tpu.management.profiling import Stopwatch
 
@@ -43,6 +74,7 @@ def test_stage_factory():
         StageFactory.get_stage("NoSuchStage")
 
 
+@pytest.mark.slow
 def test_resnet_forward_and_grad():
     from p2pfl_tpu.models import resnet18
 

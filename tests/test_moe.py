@@ -32,6 +32,7 @@ def _moe_cfg(**kw):
     return TransformerConfig(**base)
 
 
+@pytest.mark.slow
 def test_moe_forward_shape_and_aux():
     m = tiny_transformer(seq_len=16, cfg=_moe_cfg())
     x = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 64)
@@ -43,6 +44,7 @@ def test_moe_forward_shape_and_aux():
     np.testing.assert_allclose(np.asarray(m.apply(m.params, x)), np.asarray(logits))
 
 
+@pytest.mark.slow
 def test_dense_model_aux_is_zero():
     m = tiny_transformer(seq_len=16, cfg=_moe_cfg(n_experts=0))
     x = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)
@@ -50,6 +52,7 @@ def test_dense_model_aux_is_zero():
     assert float(aux) == 0.0
 
 
+@pytest.mark.slow
 def test_moe_single_expert_is_plain_swiglu():
     """E=1, k=1, ample capacity: routing is the identity, so the layer must
     equal the SwiGLU computed directly from the (single) expert's weights."""
@@ -69,6 +72,7 @@ def test_moe_single_expert_is_plain_swiglu():
     )
 
 
+@pytest.mark.slow
 def test_moe_router_learns_and_loss_decreases():
     m = tiny_transformer(seq_len=16, cfg=_moe_cfg())
     x = jax.random.randint(jax.random.PRNGKey(2), (8, 16), 0, 64)
@@ -100,6 +104,7 @@ def test_moe_router_learns_and_loss_decreases():
     assert float(l) < float(l0)
 
 
+@pytest.mark.slow
 def test_moe_tight_capacity_still_runs():
     """Over-capacity tokens are dropped (ride the residual), never crash."""
     cfg = _moe_cfg(moe_capacity=0.25, moe_top_k=1)
@@ -109,6 +114,7 @@ def test_moe_tight_capacity_still_runs():
     assert bool(jnp.isfinite(logits).all()) and bool(jnp.isfinite(aux))
 
 
+@pytest.mark.slow
 def test_moe_expert_parallel_matches_replicated():
     """Grads with the expert axis sharded over 8 devices == unsharded grads."""
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -148,6 +154,7 @@ def test_moe_expert_parallel_matches_replicated():
         )
 
 
+@pytest.mark.slow
 def test_moe_learner_fit():
     """JaxLearner trains an MoE LM end to end (aux loss included in the step)."""
     from p2pfl_tpu.learning.dataset import FederatedDataset

@@ -64,6 +64,7 @@ def test_convergence_two_nodes(rounds):
     _stop_all(nodes)
 
 
+@pytest.mark.slow
 def test_convergence_four_nodes_line_with_training():
     """4 nodes on a line topology, one epoch of real training each round."""
     nodes = _mk_ml_nodes(4)
@@ -96,6 +97,7 @@ def test_eight_node_training_improves_accuracy_memory():
     _stop_all(nodes)
 
 
+@pytest.mark.slow
 def test_eight_node_training_improves_accuracy_grpc():
     """Same as above over real gRPC sockets (wire-encoded weights)."""
     from p2pfl_tpu.communication.grpc_transport import GrpcProtocol
@@ -161,6 +163,7 @@ def test_node_down_on_learning():
     _stop_all(nodes[:-1])
 
 
+@pytest.mark.slow
 def test_wrong_model_does_not_hang():
     """MLP vs CNN (reference :155-176): mismatched node stops, net finishes."""
     Settings.VOTE_TIMEOUT = 3.0

@@ -77,6 +77,7 @@ def test_bad_personal_prefixes_rejected():
         PersonalizedLearner(mlp(), full.partition(0, 2), batch_size=64, personal=())
 
 
+@pytest.mark.slow
 def test_personalized_federation_over_grpc():
     """Uniform personalized federation over real sockets: body-only
     payloads cross as bytes through materialize() and reconstruct against

@@ -42,6 +42,7 @@ def _sequential(layers, x):
 
 
 @pytest.mark.parametrize("n_stages,n_micro", [(4, 4), (4, 8), (8, 8), (2, 6)])
+@pytest.mark.slow
 def test_pipeline_forward_matches_sequential(n_stages, n_micro):
     layers = _toy_layers(n_layers=n_stages * 2 if n_stages == 2 else n_stages)
     x = jax.random.normal(jax.random.PRNGKey(9), (n_micro, 4, 16))
@@ -51,6 +52,7 @@ def test_pipeline_forward_matches_sequential(n_stages, n_micro):
     )
 
 
+@pytest.mark.slow
 def test_pipeline_grads_match_sequential():
     layers = _toy_layers()
     stacked = stack_layers(layers)
@@ -91,6 +93,7 @@ def _lm_cfg():
     )
 
 
+@pytest.mark.slow
 def test_pipelined_transformer_matches_monolithic():
     cfg = _lm_cfg()
     m = tiny_transformer(seq_len=16, cfg=cfg)
@@ -101,6 +104,7 @@ def test_pipelined_transformer_matches_monolithic():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_pipelined_moe_aux_flows():
     """MoE blocks in the pipeline: router losses are collected per stage and
     router grads flow; silently dropping aux is rejected."""
@@ -134,6 +138,7 @@ def test_pipelined_moe_aux_flows():
     assert router_gs and all(float(jnp.abs(v).max()) > 0 for v in router_gs)
 
 
+@pytest.mark.slow
 def test_pipelined_transformer_train_step():
     cfg = _lm_cfg()
     m = tiny_transformer(seq_len=16, cfg=cfg)

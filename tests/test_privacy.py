@@ -82,6 +82,7 @@ def test_accountant_monotone_and_sane():
         PrivacyAccountant(noise=0.0, q=0.5)
 
 
+@pytest.mark.slow
 def test_dp_learner_trains_and_accounts():
     data = FederatedDataset.synthetic_mnist(n_train=512, n_test=128)
     learner = JaxLearner(mlp(), data, epochs=2, batch_size=64, dp_clip=1.0, dp_noise=1.0)
@@ -92,6 +93,7 @@ def test_dp_learner_trains_and_accounts():
     assert learner.accountant.epsilon(1e-5) > 0
 
 
+@pytest.mark.slow
 def test_spmd_dp_federation_learns():
     data = FederatedDataset.synthetic_mnist(n_train=1024, n_test=256)
     fed = SpmdFederation.from_dataset(
@@ -113,6 +115,7 @@ def test_dp_noise_without_clip_rejected():
         SpmdFederation.from_dataset(mlp(), data, n_nodes=2, batch_size=32, dp_noise=1.0)
 
 
+@pytest.mark.slow
 def test_spmd_dp_accountant_tracks_rounds():
     data = FederatedDataset.synthetic_mnist(n_train=512, n_test=128)
     fed = SpmdFederation.from_dataset(
@@ -148,6 +151,7 @@ def test_fedopt_on_result_then_aggregate():
     assert agg._t == 1  # server stepped off the adopted consensus x_t
 
 
+@pytest.mark.slow
 def test_spmd_dp_noise_perturbs_aggregate():
     """Same seed, dp on vs off: aggregates must differ (noise is real)."""
     data = FederatedDataset.synthetic_mnist(n_train=512, n_test=128)

@@ -20,6 +20,7 @@ def _qkv(t=64, b=2, h=2, d=16, seed=0):
     return tuple(jax.random.normal(s, (b, t, h, d), jnp.float32) for s in jax.random.split(key, 3))
 
 
+@pytest.mark.slow
 def test_block_offsets_cover_visibility_cases():
     """Diagonal (causal), fully-visible, and fully-masked offset blocks."""
     q, k, v = _qkv(t=16)
@@ -38,6 +39,7 @@ def test_block_offsets_cover_visibility_cases():
 
 
 @pytest.mark.parametrize("n_dev", [2, 4, 8])
+@pytest.mark.slow
 def test_ring_flash_matches_dense(n_dev):
     mesh = federation_mesh(model_parallel=n_dev)
     q, k, v = _qkv(t=64)
@@ -46,6 +48,7 @@ def test_ring_flash_matches_dense(n_dev):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_ring_flash_grads_match_dense():
     mesh = federation_mesh(model_parallel=4)
     q, k, v = _qkv(t=64, seed=3)
@@ -71,6 +74,7 @@ def test_ring_flash_rejects_non_causal():
         ring_attention(q, k, v, mesh, "model", causal=False, impl="flash")
 
 
+@pytest.mark.slow
 def test_transformer_trains_with_ring_flash():
     """attn='ring_flash' end to end: grads through the pipeline of embed →
     blocks(ring-flash attention) → head match the dense-attention model."""

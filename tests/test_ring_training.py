@@ -7,6 +7,7 @@ through ``shard_map`` + ``ppermute`` gives the reverse ring automatically.
 from functools import partial
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 import optax
@@ -26,6 +27,7 @@ def _loss_fn(model):
     return loss
 
 
+@pytest.mark.slow
 def test_ring_attention_gradients_match_dense():
     mesh = federation_mesh(model_parallel=4, devices=jax.devices()[:4])
     attn = partial(ring_attention, mesh=mesh, axis_name="model")
@@ -48,6 +50,7 @@ def test_ring_attention_gradients_match_dense():
         )
 
 
+@pytest.mark.slow
 def test_ring_transformer_train_step_reduces_loss():
     mesh = federation_mesh(model_parallel=8)
     attn = partial(ring_attention, mesh=mesh, axis_name="model")

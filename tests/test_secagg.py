@@ -389,21 +389,28 @@ def test_secagg_need_answered_by_full_coverage_peer():
     sent = []
 
     class _Proto:
+        def __init__(self, live):
+            self._live = live
+
         def broadcast(self, msg):
             sent.append(msg)
 
         def build_msg(self, cmd, args, round=0):  # noqa: A002
             return (cmd, list(args), round)
 
+        def get_neighbors(self, only_direct=False):
+            return dict.fromkeys(self._live)
+
     class _FakeNode:
-        def __init__(self, addr, train):
+        def __init__(self, addr, train, live):
             self.addr = addr
             self.state = NodeState(addr)
             self.state.set_experiment("exp", 1)
             self.state.train_set = list(train)
-            self.protocol = _Proto()
+            self.protocol = _Proto(live)
 
-    node = _FakeNode("a", ["a", "b", "c", "d"])
+    # b and c still heartbeat; d dropped off the overlay
+    node = _FakeNode("a", ["a", "b", "c", "d"], live=["b", "c"])
     priv, _ = secagg.dh_keypair()
     node.state.secagg_priv = priv
     for peer in ("b", "c", "d"):
@@ -411,25 +418,33 @@ def test_secagg_need_answered_by_full_coverage_peer():
         node.state.secagg_pubs[peer] = (p, 10)
 
     cmd = SecAggNeedCommand(node)
-    cmd.execute("b", 0, "d")  # b cannot cancel d's masks
+    cmd.execute("b", 0, "exp", "d")  # b cannot cancel d's masks
     assert len(sent) == 1 and sent[0][0] == "secagg_recover" and sent[0][1][0] == "d"
     expected = secagg.dh_pair_seed(priv, node.state.secagg_pubs["d"][0], "exp")
     assert int(sent[0][1][1], 16) == expected
-    cmd.execute("c", 0, "d")  # second request: already disclosed, no re-send
+    cmd.execute("c", 0, "exp", "d")  # second request: already disclosed, no re-send
     assert len(sent) == 1
-    cmd.execute("b", 0, "a", "b", "zz")  # self / requester / unknown: ignored
+    cmd.execute("b", 0, "exp", "a", "b", "zz")  # self / requester / unknown: ignored
+    assert len(sent) == 1
+    # a request naming a LIVE member is refused (the requester's claim is
+    # not evidence; only heartbeat eviction is)
+    cmd.execute("b", 0, "exp", "c")
+    assert len(sent) == 1
+    # non-member requesters have no standing; wrong experiment is ignored
+    cmd.execute("zz", 0, "exp", "d")
+    cmd.execute("b", 0, "other_exp", "d")
     assert len(sent) == 1
 
     # 2-member train set never discloses
     sent.clear()
-    pair = _FakeNode("a", ["a", "b"])
+    pair = _FakeNode("a", ["a", "b"], live=[])
     pair.state.secagg_priv = priv
     pair.state.secagg_pubs["b"] = node.state.secagg_pubs["b"]
-    pair.protocol = node.protocol  # reuse the recorder
-    SecAggNeedCommand(pair).execute("b", 0, "b")
+    SecAggNeedCommand(pair).execute("b", 0, "exp", "b")
     assert sent == []
 
 
+@pytest.mark.slow
 def test_masked_stack_on_mesh():
     """Device-side op: masking a node-stacked pytree leaves the weighted
     FedAvg unchanged while each slot's params are drowned in noise."""

@@ -23,6 +23,7 @@ def test_mesh_shapes():
     assert mesh2.shape["nodes"] == 4
 
 
+@pytest.mark.slow
 def test_spmd_federation_learns():
     fed = SpmdFederation.from_dataset(
         mlp(), _dataset(), n_nodes=8, batch_size=64, vote=False
@@ -34,6 +35,7 @@ def test_spmd_federation_learns():
     assert after > 0.9  # synthetic task is easy
 
 
+@pytest.mark.slow
 def test_spmd_nodes_all_equal_after_round():
     """Diffusion: after a round every node holds the same aggregated model."""
     fed = SpmdFederation.from_dataset(mlp(), _dataset(), n_nodes=4, batch_size=64, vote=False)
@@ -87,6 +89,7 @@ def test_spmd_robust_aggregators_resist_byzantine(agg):
     assert acc > 0.5  # fedavg would collapse to ~0.1 here
 
 
+@pytest.mark.slow
 def test_spmd_robust_agg_with_partial_mask_trains():
     """Regression (ADVICE r1 high): with TRAIN_SET_SIZE < N, robust
     aggregators must see elected rows only — stale non-elected copies
@@ -115,6 +118,7 @@ def test_spmd_trimmed_mean_trim_clamped():
     assert all(np.isfinite(np.asarray(x, np.float32)).all() for x in jax.tree.leaves(fed.params))
 
 
+@pytest.mark.slow
 def test_spmd_unequal_shards_sample_weighting():
     """Regression (ADVICE r1): unequal shards shuffle over their OWN sample
     range (not the truncated min), so FedAvg's sample-count weights match the
@@ -132,6 +136,7 @@ def test_spmd_unequal_shards_sample_weighting():
     assert fed.evaluate()["test_acc"] > 0.5
 
 
+@pytest.mark.slow
 def test_spmd_matches_node_mode_fedavg():
     """SPMD round == Node-mode round semantics: FedAvg of locally-trained models.
 
@@ -176,6 +181,7 @@ def test_spmd_matches_node_mode_fedavg():
         )
 
 
+@pytest.mark.slow
 def test_run_fused_matches_sequential_rounds():
     """R fused rounds (one dispatch) == R sequential run_round calls with
     the same RNG seed — identical math, amortized dispatch."""
@@ -192,6 +198,7 @@ def test_run_fused_matches_sequential_rounds():
         )
 
 
+@pytest.mark.slow
 def test_run_fused_composes_with_scaffold_and_fedopt():
     fed = SpmdFederation.from_dataset(
         mlp(), _dataset(), n_nodes=4, batch_size=64, vote=False,
@@ -217,6 +224,7 @@ def test_run_fused_rejects_per_round_election():
         Settings.VOTE_EVERY_ROUND = False
 
 
+@pytest.mark.slow
 def test_spmd_bulyan_survives_byzantine_noise():
     """Bulyan in the jitted round (iterated Krum + trimmed mean): 8 nodes,
     1 Byzantine slot overwritten with large noise each round — training

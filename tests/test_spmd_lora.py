@@ -1,6 +1,7 @@
 """SPMD LoRA federation + TP sharding rules tests."""
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 
@@ -15,6 +16,7 @@ def _data():
     return FederatedDataset.synthetic_lm(vocab_size=CFG.vocab_size, seq_len=32, n_train=512, n_test=64)
 
 
+@pytest.mark.slow
 def test_spmd_lora_learns_and_diffuses():
     # wider adapters + higher lr: the frozen base is random (not pretrained),
     # so the adapters carry all the learning in this test
@@ -47,6 +49,7 @@ def test_spmd_lora_state_is_adapters_only():
     assert stacked < base  # federation state is smaller than one base model
 
 
+@pytest.mark.slow
 def test_tp_sharding_rules():
     from p2pfl_tpu.parallel.mesh import federation_mesh
     from p2pfl_tpu.parallel.sharding import partition_spec_for, transformer_shardings
@@ -80,6 +83,7 @@ def test_tp_sharded_forward_matches_replicated():
     np.testing.assert_allclose(np.asarray(want), np.asarray(got), atol=2e-2)
 
 
+@pytest.mark.slow
 def test_lora_fused_matches_sequential():
     """run_fused(R) must produce the same adapters as R run_round calls
     with the same seed (one dispatch vs R dispatches)."""

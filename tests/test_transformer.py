@@ -13,6 +13,7 @@ from p2pfl_tpu.ops.attention import causal_attention, ring_attention
 CFG = TransformerConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2, ffn_hidden=128)
 
 
+@pytest.mark.slow
 def test_ring_attention_matches_dense():
     """Ring attention over the 8-device mesh == single-device causal attention."""
     from p2pfl_tpu.parallel.mesh import federation_mesh
@@ -30,6 +31,7 @@ def test_ring_attention_matches_dense():
     np.testing.assert_allclose(np.asarray(dense), np.asarray(ring), atol=2e-5)
 
 
+@pytest.mark.slow
 def test_ring_attention_non_causal():
     from p2pfl_tpu.parallel.mesh import federation_mesh
 
@@ -45,6 +47,7 @@ def test_ring_attention_non_causal():
     np.testing.assert_allclose(np.asarray(want), np.asarray(got), atol=2e-5)
 
 
+@pytest.mark.slow
 def test_transformer_forward_and_lora_split():
     model = tiny_transformer(seq_len=32, cfg=CFG)
     toks = jnp.zeros((2, 32), jnp.int32)
@@ -71,6 +74,7 @@ def test_lora_zero_init_is_identity():
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_lora_learner_trains_and_freezes_base():
     data = FederatedDataset.synthetic_lm(vocab_size=CFG.vocab_size, seq_len=32, n_train=64, n_test=16)
     model = tiny_transformer(seq_len=32, cfg=CFG)
