@@ -53,6 +53,52 @@ def _spmd_mfu(fed, sec_per_round: float):
     return flops, mfu(flops, sec_per_round, n_devices=n_dev)
 
 
+def _mfu_from(flops, seconds: float):
+    from p2pfl_tpu.management.profiling import mfu
+
+    return mfu(flops, seconds)
+
+
+def _reexec(config_key: str, timeout: int = 900, cpu: bool = True, virtual_devices: int = 0):
+    """Run one config in a child process and forward its JSON.
+
+    Single place for the child-env hygiene that previously diverged across
+    copies: ``cpu=True`` forces the CPU backend AND scrubs
+    PALLAS_AXON_POOL_IPS (the image's sitecustomize otherwise claims the
+    real chip in every python child — if the parent already holds it the
+    child aborts with a C++ exception); ``virtual_devices`` adds the
+    host-platform device-count flag for virtual-mesh children.
+    """
+    import os
+    import subprocess
+
+    env = dict(os.environ)
+    if cpu:
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+    if virtual_devices:
+        flags = [
+            f for f in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        ]
+        env["XLA_FLAGS"] = " ".join(
+            flags + [f"--xla_force_host_platform_device_count={virtual_devices}"]
+        )
+    proc = subprocess.run(
+        [sys.executable, __file__, config_key], env=env,
+        capture_output=True, text=True, timeout=timeout,
+    )
+    sys.stderr.write(proc.stderr[-2000:])
+    if proc.returncode == 0 and proc.stdout.strip():
+        sys.stdout.write(proc.stdout)
+        sys.stdout.flush()
+    else:
+        emit({
+            "metric": f"config{config_key}",
+            "error": f"re-exec rc={proc.returncode}: {proc.stderr[-300:]}",
+        })
+
+
 def config1_mnist_2node() -> None:
     """Reference CI anchor: 2 Node objects, in-memory transport, 1 epoch.
 
@@ -67,22 +113,10 @@ def config1_mnist_2node() -> None:
     the wall clock and gossip/aggregation waits are sub-second with the
     documented low-latency profile (``set_low_latency_settings``).
     """
-    import os
-    import subprocess
-
     if jax.default_backend() != "cpu":
         # re-exec on the CPU backend this row is defined on; the parent
         # (possibly holding the TPU) just forwards the child's JSON
-        env = dict(os.environ, JAX_PLATFORMS="cpu")
-        proc = subprocess.run(
-            [sys.executable, __file__, "1"], env=env, capture_output=True, text=True, timeout=600
-        )
-        sys.stderr.write(proc.stderr[-2000:])
-        if proc.returncode == 0 and proc.stdout.strip():
-            sys.stdout.write(proc.stdout)
-            sys.stdout.flush()
-        else:
-            emit({"metric": "config1", "error": f"cpu re-exec rc={proc.returncode}: {proc.stderr[-300:]}"})
+        _reexec("1", timeout=600)
         return
 
     import collections
@@ -152,6 +186,22 @@ def config1_mnist_2node() -> None:
 
 
 def config2_resnet18_8node() -> None:
+    """Two halves of the north-star metric (BASELINE.md:19-21):
+
+    1. TIME-TO-TARGET-ACCURACY (VERDICT r2 #1): 8-node ResNet-18 FedAvg on
+       synthetic-hard CIFAR-10 to ≥70%. Round 2's recipe (constant Adam
+       1e-3, per-round moment reset, 6-round budget) flatlined at 15% —
+       starved, not unlearnable (a centrally trained ResNet-18 reaches 92%
+       by step 200 with a warmup schedule). The fixed federated recipe:
+       warmup-cosine LR with ``keep_opt_state=True`` so the schedule and
+       Adam moments survive round boundaries.
+    2. SEC/ROUND + MFU at throughput settings. The MFU lever found in
+       round 3: amortize the round's fixed dispatch/aggregation cost over
+       more local steps (bigger shard × multi-epoch rounds) — convs were
+       already bf16, buffers already donated.
+    """
+    import optax
+
     from p2pfl_tpu.learning.dataset import FederatedDataset
     from p2pfl_tpu.models import resnet18
     from p2pfl_tpu.parallel import SpmdFederation
@@ -159,45 +209,70 @@ def config2_resnet18_8node() -> None:
     data = FederatedDataset.synthetic_mnist(
         n_train=8 * 1024, n_test=1024, dim=(32, 32, 3), modes=8, noise=0.7, proto_scale=0.5
     )
-    fed = SpmdFederation.from_dataset(
-        resnet18(), data, n_nodes=8, batch_size=64, vote=False, seed=3
+    # --- half 1: time to target accuracy ---
+    cap, spr_steps, target = 25, 16, 0.70
+    sched = optax.warmup_cosine_decay_schedule(
+        0.0, 3e-3, warmup_steps=2 * spr_steps, decay_steps=cap * spr_steps, end_value=1e-4
     )
-    log("config2: warm-up")
-    fed.run_round(epochs=1, eval=True)
-    fed.run_round(epochs=1)
-    fed.reset(seed=3)
+    fed = SpmdFederation.from_dataset(
+        resnet18(), data, n_nodes=8, batch_size=64, vote=False, seed=3,
+        tx=optax.adam(sched), keep_opt_state=True,
+    )
     curve = []
+    rounds_to_target = None
+    time_to_target = None
     t0 = time.monotonic()
-    for _ in range(6):
-        curve.append(round(float(fed.run_round(epochs=1, eval=True)["test_acc"]), 4))
-    elapsed = time.monotonic() - t0
-    sec_per_round = _steady_state(fed)
-    flops, round_mfu = _spmd_mfu(fed, sec_per_round)
-
-    # scaling point: the same federation at batch 256/node — 4x the work
-    # per round in barely more wall-clock (the chip is underfed at 64)
+    for r in range(cap):
+        acc = float(fed.run_round(epochs=1, eval=True)["test_acc"])
+        curve.append(round(acc, 4))
+        if rounds_to_target is None and acc >= target:
+            rounds_to_target = r + 1
+            time_to_target = time.monotonic() - t0
+            break
+    log(f"config2: target {target} at round {rounds_to_target} ({time_to_target})")
     del fed
     jax.clear_caches()
+
+    # --- half 2: throughput + MFU (2048-sample shards, batch 256) ---
+    data_big = FederatedDataset.synthetic_mnist(
+        n_train=8 * 2048, n_test=1024, dim=(32, 32, 3), modes=8, noise=0.7, proto_scale=0.5
+    )
     fed_big = SpmdFederation.from_dataset(
-        resnet18(), data, n_nodes=8, batch_size=256, vote=False, seed=3
+        resnet18(), data_big, n_nodes=8, batch_size=256, vote=False, seed=3
     )
     fed_big.run_round(epochs=1)
     force_execution(fed_big.params)
-    sec_big = _steady_state(fed_big)
-    flops_big, mfu_big = _spmd_mfu(fed_big, sec_big)
+    sec_per_round = _steady_state(fed_big)
+    flops, round_mfu = _spmd_mfu(fed_big, sec_per_round)
+    # multi-epoch rounds amortize the fixed per-round cost further
+    fed_big.run_round(epochs=4)
+    force_execution(fed_big.params)
+    t0 = time.monotonic()
+    for _ in range(3):
+        fed_big.run_round(epochs=4)
+    force_execution(fed_big.params)
+    sec_ep4 = (time.monotonic() - t0) / 3
+    flops_ep4 = fed_big.round_flops(epochs=4)
+    from p2pfl_tpu.management.profiling import mfu as _mfu
+
+    # same per-device normalization as the sibling mfu field
+    mfu_ep4 = _mfu(flops_ep4, sec_ep4, n_devices=len(set(fed_big.mesh.devices.flat)))
 
     emit({
         "metric": "config2_resnet18_cifar10_8node_fedavg",
         "value": round(sec_per_round, 4),
         "unit": "sec_per_round",
+        "target_acc": target,
+        "rounds_to_target": rounds_to_target,
+        "time_to_target_s": round(time_to_target, 2) if time_to_target else None,
         "accuracy_curve": curve,
-        "time_6_rounds_s": round(elapsed, 3),
+        "recipe": "adam warmup-cosine peak 3e-3, keep_opt_state, batch 64",
+        "throughput_point": "batch 256, 2048 samples/node",
         "flops_per_round": flops,
         "mfu": round(round_mfu, 4) if round_mfu is not None else None,
-        "batch256": {
-            "sec_per_round": round(sec_big, 4),
-            "flops_per_round": flops_big,
-            "mfu": round(mfu_big, 4) if mfu_big is not None else None,
+        "epochs4": {
+            "sec_per_round": round(sec_ep4, 4),
+            "mfu": round(mfu_ep4, 4) if mfu_ep4 is not None else None,
         },
         "data": "synthetic-hard (CIFAR-10 shaped)",
         "devices": len(jax.devices()),
@@ -230,6 +305,15 @@ def config3_resnet50_64node_dirichlet() -> None:
 
 
 def _config3_measure(n_nodes: int) -> None:
+    """ResNet-50 / CIFAR-100-shaped / Dirichlet(0.5) non-IID.
+
+    Round-3 recipe fix (VERDICT r2 #1): same warmup-cosine +
+    ``keep_opt_state`` treatment as config 2 — round 2 measured 4 flat
+    rounds at chance (0.98% on 100 classes); with the schedule the
+    non-IID federation climbs to the 50% target (measured: round ~28).
+    """
+    import optax
+
     from p2pfl_tpu.learning.dataset import FederatedDataset
     from p2pfl_tpu.models import resnet50
     from p2pfl_tpu.parallel import SpmdFederation
@@ -238,21 +322,47 @@ def _config3_measure(n_nodes: int) -> None:
         n_train=64 * 256, n_test=1024, dim=(32, 32, 3), num_classes=100,
         modes=2, noise=0.5, proto_scale=0.7,
     )
+    cap, target = 45, 0.50
+    spr_steps = (64 * 256 // n_nodes) // 32
+    sched = optax.warmup_cosine_decay_schedule(
+        0.0, 3e-3, warmup_steps=2 * spr_steps, decay_steps=40 * spr_steps, end_value=1e-4
+    )
     fed = SpmdFederation.from_dataset(
         resnet50(), data, n_nodes=n_nodes, strategy="dirichlet", alpha=0.5,
         batch_size=32, vote=False, seed=3, remat=True,
+        tx=optax.adam(sched), keep_opt_state=True,
     )
     fed.run_round(epochs=1)  # warm-up + OOM probe
     force_execution(fed.params)
     fed.evaluate()  # probe the eval path's memory too
+    fed.reset(seed=3)
+    curve = []
+    rounds_to_target = None
+    time_to_target = None
+    t0 = time.monotonic()
+    for r in range(cap):
+        acc = float(fed.run_round(epochs=1, eval=True)["test_acc"])
+        curve.append(round(acc, 4))
+        if rounds_to_target is None and acc >= target:
+            rounds_to_target = r + 1
+            time_to_target = time.monotonic() - t0
+            break
     sec_per_round = _steady_state(fed)
-    acc = fed.evaluate()["test_acc"]
+    flops, round_mfu = _spmd_mfu(fed, sec_per_round)
     emit({
         "metric": "config3_resnet50_cifar100_64node_dirichlet",
         "value": round(sec_per_round, 4),
         "unit": "sec_per_round",
         "n_nodes": n_nodes,
-        "acc_after_4_rounds": round(float(acc), 4),
+        "target_acc": target,
+        "rounds_to_target": rounds_to_target,
+        "time_to_target_s": round(time_to_target, 2) if time_to_target else None,
+        "accuracy_curve": curve,
+        "recipe": "adam warmup-cosine peak 3e-3, keep_opt_state, batch 32, remat",
+        "flops_per_round": flops,
+        # NOTE: remat recompute counts as executed FLOPs in the probe, so
+        # this is hardware utilization, slightly above model-FLOPs MFU
+        "mfu": round(round_mfu, 4) if round_mfu is not None else None,
         "partition": "dirichlet(0.5)",
         "data": "synthetic (CIFAR-100 shaped)",
         "devices": len(jax.devices()),
@@ -364,11 +474,20 @@ def config5_lora_32node() -> None:
     lora, base = split_lora(model.params)
     n_lora = sum(x.size for x in jax.tree.leaves(lora))
     n_base = sum(x.size for x in jax.tree.leaves(base))
+    from p2pfl_tpu.management.profiling import mfu as _mfu
+
+    flops = fed.round_flops()
     emit({
         "metric": "config5_lora_transformer_32node",
         "value": round(sec_per_round, 4),
         "unit": "sec_per_round",
         "sec_per_round_fused": round(sec_fused, 4),
+        "flops_per_round": flops,
+        # MFU on the UNFUSED round (VERDICT r2 #2); the 3.4M-param
+        # stand-in is dispatch-dominated (that's what fusing fixes), so
+        # this is a lower bound for the TinyLlama-scale target
+        "mfu": round(_mfu(flops, sec_per_round), 4) if flops else None,
+        "mfu_fused": round(_mfu(flops, sec_fused), 4) if flops else None,
         "pretrained_base_acc": round(float(base_acc), 4),
         "next_token_acc_after_4_rounds": round(float(acc), 4),
         "adapter_params": n_lora,
@@ -432,38 +551,84 @@ def config6_heterogeneous_algorithms() -> None:
 
 def config7_long_context_flash() -> None:
     """Long-context single-chip path: Pallas flash attention vs fused dense
-    XLA attention, training-step time across sequence lengths."""
+    XLA attention, training-step time across sequence lengths.
+
+    Sweeps the flash kernel's block size per length (VERDICT r2 #8): the
+    128-block default was chosen for divisibility, not speed; larger
+    blocks amortize the Pallas grid/bookkeeping overhead that makes dense
+    win at short lengths. Also reports which backend ``attn="auto"``
+    (``pick_attention``) selects per length so the policy can be checked
+    against the measurements.
+    """
     import optax
 
-    from p2pfl_tpu.models.transformer import TransformerConfig, tiny_transformer
+    from p2pfl_tpu.models.transformer import (
+        TransformerConfig,
+        pick_attention,
+        resolve_attention,
+        tiny_transformer,
+    )
+    from p2pfl_tpu.settings import Settings
 
     cfg_kw = dict(
         vocab_size=1024, dim=256, n_layers=4, n_heads=8, n_kv_heads=8,
         ffn_hidden=688, lora_rank=0,
     )
+
+    def measure(seq_len, attn, block=128):
+        # dense → attn_fn None (fused XLA path); flash → explicit kernel
+        # with the swept block size (attn_fn overrides tiny_transformer's
+        # own block choice)
+        from p2pfl_tpu.management.profiling import compiled_flops, mfu as _mfu
+
+        attn_fn = resolve_attention("flash", block=block) if attn == "flash" else None
+        m = tiny_transformer(
+            seq_len=seq_len, cfg=TransformerConfig(**cfg_kw), attn_fn=attn_fn
+        )
+        tokens = jax.random.randint(jax.random.PRNGKey(0), (8, seq_len), 0, 1024)
+        targets = jnp.roll(tokens, -1, axis=1)
+
+        def loss(p, m=m, tokens=tokens, targets=targets):
+            logits = m.apply(p, tokens)
+            return optax.softmax_cross_entropy_with_integer_labels(logits, targets).mean()
+
+        step = jax.jit(jax.value_and_grad(loss))
+        # no scan in the step → cost analysis counts everything exactly once.
+        # Pallas kernel FLOPs may be invisible to XLA's analysis, so MFU is
+        # comparable only via the DENSE program's count (reported per row).
+        flops = compiled_flops(step, m.params)
+        _l, g = step(m.params)
+        force_execution(g)  # compile barrier (real D2H fetch)
+        t0 = time.monotonic()
+        for _ in range(10):
+            _l, g = step(m.params)
+        force_execution(g)
+        sec = (time.monotonic() - t0) / 10
+        ms = round(sec * 1000, 2)
+        del m, step, g
+        jax.clear_caches()
+        return ms, flops, _mfu(flops, sec)
+
     results = {}
     for seq_len in (1024, 2048, 4096):
-        row = {}
-        for attn in ("dense", "flash"):
-            m = tiny_transformer(seq_len=seq_len, cfg=TransformerConfig(**cfg_kw), attn=attn)
-            tokens = jax.random.randint(jax.random.PRNGKey(0), (8, seq_len), 0, 1024)
-            targets = jnp.roll(tokens, -1, axis=1)
-
-            def loss(p, m=m, tokens=tokens, targets=targets):
-                logits = m.apply(p, tokens)
-                return optax.softmax_cross_entropy_with_integer_labels(logits, targets).mean()
-
-            step = jax.jit(jax.value_and_grad(loss))
-            l, g = step(m.params)
-            force_execution(g)  # compile barrier (real D2H fetch)
-            t0 = time.monotonic()
-            for _ in range(10):
-                l, g = step(m.params)
-            force_execution(g)
-            row[attn] = round((time.monotonic() - t0) / 10 * 1000, 2)  # ms
-            del m, step, g
-            jax.clear_caches()
+        dense_ms, dense_flops, dense_mfu = measure(seq_len, "dense")
+        row = {"dense": dense_ms}
+        if dense_mfu is not None:
+            row["dense_mfu"] = round(dense_mfu, 4)
+        blocks = [b for b in (128, 256, 512) if seq_len % b == 0]
+        sweep = {b: measure(seq_len, "flash", block=b)[0] for b in blocks}
+        best_block = min(sweep, key=sweep.get)
+        row["flash_block_sweep_ms"] = sweep
+        row["flash"] = sweep[best_block]
+        row["flash_best_block"] = best_block
+        # flash MFU from the DENSE program's model-FLOP count (the Pallas
+        # kernel's internal FLOPs are invisible to XLA's cost analysis;
+        # using the same numerator keeps dense/flash comparable)
+        flash_mfu = _mfu_from(dense_flops, sweep[best_block] / 1000.0)
+        if flash_mfu is not None:
+            row["flash_mfu"] = round(flash_mfu, 4)
         row["speedup"] = round(row["dense"] / row["flash"], 2)
+        row["auto_picks"] = pick_attention(seq_len)
         results[f"T{seq_len}"] = row
         log(f"config7 T={seq_len}: {row}")
 
@@ -472,6 +637,7 @@ def config7_long_context_flash() -> None:
         "value": results["T4096"]["speedup"],
         "unit": "x_speedup_at_4096",
         "ms_per_train_step": results,
+        "auto_threshold_seq_len": Settings.FLASH_MIN_SEQ_LEN,
         "batch": 8,
         "model": "4L/256d/8h transformer, bf16",
         "devices": len(jax.devices()),
@@ -534,6 +700,126 @@ def config8_wire_compression() -> None:
         "rounds": 2,
         "transport": "grpc loopback",
         "data": "synthetic",
+    })
+
+
+def config10_moe_gpipe_federation() -> None:
+    """(beyond reference) Federations training THROUGH MoE and GPipe.
+
+    VERDICT r2 weak #3: the ep/pp axes compiled but no federation trained
+    through them. Two rows:
+
+    - MoE: 8 nodes federate a switch-style MoE transformer (8 experts,
+      top-2, aux balance losses riding the federated loss) via
+      ``SpmdLmFederation`` — accuracy trajectory to a stated target plus
+      steady-state sec/round. Expert parallelism is mesh-width-bound: on
+      the single bench chip the ``model`` axis is 1 (the 2-way-ep layout
+      is proven on the 8-device virtual mesh in tests + dryrun).
+    - GPipe: pipeline stages need >1 device, so the pipelined federation
+      re-execs onto the virtual 8-device CPU mesh (4 stages × 2 nodes
+      time-sharing them) — provenance recorded; real-chip pp numbers need
+      real multi-chip hardware.
+    """
+    from p2pfl_tpu.learning.dataset import FederatedDataset
+    from p2pfl_tpu.models.transformer import TransformerConfig, tiny_transformer
+    from p2pfl_tpu.parallel import SpmdLmFederation
+
+    n = 8
+    cfg = TransformerConfig(
+        vocab_size=512, dim=128, n_layers=4, n_heads=8, n_kv_heads=8,
+        ffn_hidden=256, lora_rank=0, n_experts=8, moe_top_k=2,
+    )
+    model = tiny_transformer(seq_len=128, cfg=cfg)
+    data = FederatedDataset.synthetic_lm(vocab_size=512, n_train=n * 256, n_test=512)
+    fed = SpmdLmFederation.from_dataset(
+        model, data, n_nodes=n, batch_size=16, vote=False, seed=3
+    )
+    target = 0.60
+    curve = []
+    rounds_to_target = None
+    t0 = time.monotonic()
+    for r in range(12):
+        fed.run_round(epochs=1)
+        acc = fed.evaluate()["test_acc"]
+        curve.append(round(float(acc), 4))
+        log(f"config10 moe round {r + 1}: acc {acc:.4f}")
+        if rounds_to_target is None and acc >= target:
+            rounds_to_target = r + 1
+            time_to_target = time.monotonic() - t0
+            break
+    sec_per_round = _steady_state(fed, rounds=3)
+    flops, round_mfu = _spmd_mfu(fed, sec_per_round)
+    emit({
+        "metric": "config10_moe_federation",
+        "value": round(sec_per_round, 4),
+        "unit": "sec_per_round",
+        "flops_per_round": flops,
+        "mfu": round(round_mfu, 4) if round_mfu is not None else None,
+        "n_nodes": n,
+        "model": "4L/128d MoE transformer, 8 experts top-2, seq 128",
+        "acc_curve": curve,
+        "target_acc": target,
+        "rounds_to_target": rounds_to_target,
+        "time_to_target_s": round(time_to_target, 2) if rounds_to_target else None,
+        "expert_parallel": int(fed.mesh.shape.get("model", 1)),
+        "data": "synthetic_lm",
+        "devices": len(jax.devices()),
+    })
+
+    # GPipe federation: re-exec on a virtual multi-device mesh when the
+    # current backend cannot host >1 pipeline stage
+    if len(jax.devices()) >= 4:
+        _config10_gpipe_body()
+    else:
+        # pipeline stages need >1 device: virtual 8-device CPU mesh
+        _reexec("10pipe", timeout=1500, virtual_devices=8)
+
+
+def _config10_gpipe_body() -> None:
+    from p2pfl_tpu.learning.dataset import FederatedDataset
+    from p2pfl_tpu.models.transformer import TransformerConfig, tiny_transformer
+    from p2pfl_tpu.parallel import PipelineFederation
+
+    cfg = TransformerConfig(
+        vocab_size=512, dim=128, n_layers=4, n_heads=8, n_kv_heads=8,
+        ffn_hidden=344, lora_rank=0,
+    )
+    model = tiny_transformer(seq_len=128, cfg=cfg)
+    data = FederatedDataset.synthetic_lm(vocab_size=512, n_train=2 * 512, n_test=256)
+    shards = [data.partition(i, 2) for i in range(2)]
+    fed = PipelineFederation(model, shards, n_stages=4, batch_size=16, seed=3)
+    target = 0.60
+    curve = []
+    rounds_to_target = None
+    t0 = time.monotonic()
+    for r in range(10):
+        fed.run_round(epochs=1)
+        acc = fed.evaluate()["test_acc"]
+        curve.append(round(float(acc), 4))
+        log(f"config10 gpipe round {r + 1}: acc {acc:.4f}")
+        if rounds_to_target is None and acc >= target:
+            rounds_to_target = r + 1
+            time_to_target = time.monotonic() - t0
+            break
+    t0 = time.monotonic()
+    for _ in range(2):
+        fed.run_round(epochs=1)
+    force_execution(fed.params)
+    sec_per_round = (time.monotonic() - t0) / 2
+    emit({
+        "metric": "config10_gpipe_federation",
+        "value": round(sec_per_round, 4),
+        "unit": "sec_per_round",
+        "n_nodes": 2,
+        "pipeline_stages": 4,
+        "model": "4L/128d transformer, GPipe 4-stage, seq 128",
+        "acc_curve": curve,
+        "target_acc": target,
+        "rounds_to_target": rounds_to_target,
+        "time_to_target_s": round(time_to_target, 2) if rounds_to_target else None,
+        "data": "synthetic_lm",
+        "devices": len(jax.devices()),
+        "backend": jax.default_backend(),
     })
 
 
@@ -621,11 +907,13 @@ CONFIGS = {
     "7": config7_long_context_flash,
     "8": config8_wire_compression,
     "9": config9_personalization,
+    "10": config10_moe_gpipe_federation,
+    "10pipe": _config10_gpipe_body,  # internal: config10's multi-device re-exec
 }
 
 
 def main() -> None:
-    wanted = sys.argv[1:] or sorted(CONFIGS)
+    wanted = sys.argv[1:] or [k for k in sorted(CONFIGS, key=lambda s: (len(s), s)) if not k.endswith("pipe")]
     if len(wanted) == 1:
         CONFIGS[wanted[0]]()
         return

@@ -269,13 +269,34 @@ class CausalLM(nn.Module):
         return logits.astype(jnp.float32)
 
 
+def pick_attention(seq_len: int) -> str:
+    """The ``attn="auto"`` policy: dense vs flash by sequence length.
+
+    Uses the crossover measured on real hardware by bench config 7
+    (``Settings.FLASH_MIN_SEQ_LEN``): fused dense XLA attention wins at
+    short lengths (the O(T²) logits still fit in VMEM-friendly fusions and
+    the Pallas kernel's block bookkeeping costs more than it saves), flash
+    wins once the logits matrix stops fitting. Single-chip policy — the
+    ring variants shard the sequence over a mesh and are chosen
+    explicitly.
+    """
+    from p2pfl_tpu.settings import Settings
+
+    return "flash" if seq_len >= Settings.FLASH_MIN_SEQ_LEN else "dense"
+
+
 def resolve_attention(
     attn: str,
     mesh: Any = None,
     axis_name: str = "model",
     block: int = 128,
+    seq_len: Optional[int] = None,
 ) -> Optional[Callable]:
     """Map an attention backend name to an ``(q, k, v) -> out`` callable."""
+    if attn == "auto":
+        if seq_len is None:
+            raise ValueError("attn='auto' needs seq_len to pick a backend")
+        attn = pick_attention(seq_len)
     if attn == "dense":
         return None  # Attention falls back to the fused causal path
     if attn == "flash":
@@ -306,10 +327,15 @@ def tiny_transformer(
 ) -> FlaxModel:
     """A small LoRA-ready causal LM bound to concrete params.
 
-    ``attn`` selects the attention backend (``"dense" | "flash" | "ring"``);
-    ``attn_fn`` overrides it with an explicit callable.
+    ``attn`` selects the attention backend
+    (``"auto" | "dense" | "flash" | "ring" | "ring_flash"``); ``"auto"``
+    picks dense vs flash from the sequence length using the measured
+    crossover (:func:`pick_attention`). ``attn_fn`` overrides it with an
+    explicit callable.
     """
     cfg = cfg or TransformerConfig()
+    if attn == "auto":
+        attn = pick_attention(seq_len)
     if attn_fn is None:
         # flash blocks must divide the attended length: the GLOBAL sequence
         # for attn="flash", but the PER-DEVICE shard for "ring_flash" (each

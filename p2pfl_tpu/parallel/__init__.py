@@ -20,7 +20,9 @@ from p2pfl_tpu.parallel.pipeline import (
 from p2pfl_tpu.parallel.spmd import SpmdFederation
 
 __all__ = [
+    "PipelineFederation",
     "SpmdFederation",
+    "SpmdLmFederation",
     "SpmdLoraFederation",
     "federation_mesh",
     "pipeline_apply",
@@ -29,10 +31,16 @@ __all__ = [
     "stack_layers",
 ]
 
+_LAZY = {
+    "SpmdLoraFederation": "p2pfl_tpu.parallel.spmd_lora",
+    "SpmdLmFederation": "p2pfl_tpu.parallel.spmd_lm",
+    "PipelineFederation": "p2pfl_tpu.parallel.spmd_lm",
+}
+
 
 def __getattr__(name):
-    if name == "SpmdLoraFederation":  # lazy: avoid importing optax paths eagerly
-        from p2pfl_tpu.parallel.spmd_lora import SpmdLoraFederation
+    if name in _LAZY:  # lazy: avoid importing optax paths eagerly
+        import importlib
 
-        return SpmdLoraFederation
+        return getattr(importlib.import_module(_LAZY[name]), name)
     raise AttributeError(name)

@@ -508,6 +508,7 @@ class SpmdFederation:
         server_lr: float = 0.1,
         dp_clip: float = 0.0,
         dp_noise: float = 0.0,
+        tx: Optional[optax.GradientTransformation] = None,
     ) -> None:
         self.model = model
         self.module = model.module
@@ -516,11 +517,17 @@ class SpmdFederation:
             raise ValueError("need at least one dataset shard")
         self.datasets = datasets
         self.batch_size = batch_size
-        if scaffold and optimizer != "sgd":
+        if scaffold and (optimizer != "sgd" or tx is not None):
             # the (x − y_i)/(K·η) variate update assumes η-scaled SGD steps;
             # adaptive local steps break the correction's variance-reduction
             raise ValueError("scaffold=True requires optimizer='sgd'")
-        self.tx = sgd(learning_rate) if optimizer == "sgd" else adam(learning_rate)
+        if tx is not None:
+            # explicit optax transform — e.g. adam(warmup_cosine_schedule):
+            # with keep_opt_state=True the schedule's step count survives
+            # round boundaries, giving federated LR schedules (config 2)
+            self.tx = tx
+        else:
+            self.tx = sgd(learning_rate) if optimizer == "sgd" else adam(learning_rate)
         self.learning_rate = learning_rate
         # FedProx proximal strength (0 = plain FedAvg local steps)
         self.prox_mu = float(prox_mu)
@@ -937,6 +944,39 @@ class SpmdFederation:
         if step is None:
             return base
         return base + self.n * (epochs * self._nb - 1) * step
+
+    def _probe_step_flops(self, loss_fn) -> Optional[float]:
+        """Compiled FLOPs of ONE node's ONE SGD step, from shape-only probes.
+
+        ``loss_fn(params, bx, by) -> scalar``. Shared by the LoRA and
+        full-LM federations' ``round_flops`` (scan-trip-count pitfall: the
+        probe is scan-free, so cost analysis counts it exactly once);
+        honors ``remat`` so recompute shows up the same way it executes.
+        """
+        import optax
+
+        from p2pfl_tpu.management.profiling import compiled_flops
+
+        p1 = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape[1:], x.dtype), self.params
+        )
+        o1 = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape[1:], x.dtype), self.opt_state
+        )
+        bx = jax.ShapeDtypeStruct(
+            (self.batch_size,) + tuple(self.x_all.shape[2:]), self.x_all.dtype
+        )
+        by = jax.ShapeDtypeStruct(
+            (self.batch_size,) + tuple(self.y_all.shape[2:]), self.y_all.dtype
+        )
+
+        def one_step(p, o, bx_, by_):
+            lf = jax.checkpoint(loss_fn) if self.remat else loss_fn
+            _loss, grads = jax.value_and_grad(lf)(p, bx_, by_)
+            updates, o = self.tx.update(grads, o, p)
+            return optax.apply_updates(p, updates), o
+
+        return compiled_flops(jax.jit(one_step), p1, o1, bx, by)
 
     def _single_step_flops(self) -> Optional[float]:
         """Compiled FLOPs of ONE node's ONE SGD step (trip-count-1 scan, so

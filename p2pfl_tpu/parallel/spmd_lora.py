@@ -249,3 +249,22 @@ class SpmdLoraFederation(SpmdFederation):
             "test_acc": float(jnp.mean(acc)),
             "per_node_acc": np.asarray(acc).tolist(),
         }
+
+    def round_flops(self, epochs: int = 1) -> Optional[float]:
+        """FLOPs of one LoRA round (scan-trip-count aware, VERDICT r2 #2).
+
+        The base class's version lowers the FULL-model ``spmd_round``
+        program, which is not what this federation runs. A LoRA round is
+        step-dominated (the adapter aggregation is tiny next to the
+        transformer fwd/bwd through the frozen base), so: one node's ONE
+        SGD step from the shared scan-free probe × every step the round
+        executes.
+        """
+
+        def loss_fn(lo, bx, by):
+            return _lm_loss(lo, self.base, self.module, bx, by)[0]
+
+        step = self._probe_step_flops(loss_fn)
+        if step is None:
+            return None
+        return self.n * epochs * self._nb * step

@@ -82,6 +82,12 @@ class Settings:
     # keys), so the mask drowns the parameters regardless of how large the
     # local datasets are. Requires WIRE_COMPRESSION="none".
     SECAGG_MASK_STD: float = 100.0
+    # Sequence length at/above which attn="auto" picks the Pallas flash
+    # kernel over fused dense XLA attention. Crossover measured on the
+    # real chip by bench config 7 (BASELINE.md row 7): dense wins at
+    # T<=2048, flash wins from T=4096 (1.7x at default blocks). Re-tune
+    # with `python bench_suite.py 7` if the model shape changes.
+    FLASH_MIN_SEQ_LEN: int = 4096
     # How long a train-set node waits for peers' secagg_recover seed
     # disclosures after an aggregation timeout with dropouts, before giving
     # the round up (keeping the previous global instead of applying noise).

@@ -1,0 +1,113 @@
+"""Federations that train THROUGH MoE expert parallelism and GPipe.
+
+VERDICT r2 weak #3: the ep/pp axes compiled (unit tests + dryrun grad
+steps) but no federation trained through them end to end. These tests run
+real multi-round federated training on the 8-device virtual mesh:
+``SpmdLmFederation`` (dp × ep in one dispatch) and ``PipelineFederation``
+(nodes time-sharing a GPipe mesh, host FedAvg between rounds).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from p2pfl_tpu.learning.dataset import FederatedDataset
+from p2pfl_tpu.models.transformer import TransformerConfig, tiny_transformer
+from p2pfl_tpu.parallel import PipelineFederation, SpmdLmFederation
+from p2pfl_tpu.settings import Settings
+
+
+def _moe_cfg(**kw):
+    base = dict(
+        vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=4,
+        ffn_hidden=128, lora_rank=0, n_experts=4, moe_top_k=2,
+    )
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def test_moe_federation_expert_leaves_shard_over_model_axis():
+    """dp × ep state layout: expert stacks [N, E, ...] carry
+    P(nodes, model); routers and norms replicate over model."""
+    m = tiny_transformer(seq_len=32, cfg=_moe_cfg())
+    data = FederatedDataset.synthetic_lm(n_train=4 * 64, n_test=64, seq_len=32, vocab_size=256)
+    fed = SpmdLmFederation.from_dataset(
+        m, data, n_nodes=4, batch_size=16, vote=False, expert_parallel=2
+    )
+    assert dict(fed.mesh.shape) == {
+        Settings.MESH_NODES_AXIS: 4,
+        Settings.MESH_MODEL_AXIS: 2,
+    }
+    specs = {
+        "/".join(str(getattr(k, "key", k)) for k in path): leaf.sharding.spec
+    for path, leaf in jax.tree_util.tree_flatten_with_path(fed.params)[0]}
+    nodes, model = Settings.MESH_NODES_AXIS, Settings.MESH_MODEL_AXIS
+    assert specs["layer_0/mlp/w1"][:2] == (nodes, model)  # experts sharded
+    assert specs["layer_0/mlp/w2"][:2] == (nodes, model)
+    assert tuple(specs["layer_0/mlp/router"]) == (nodes,)  # router replicated
+    assert tuple(specs["layer_0/attn_norm/scale"]) == (nodes,)
+
+
+@pytest.mark.slow
+def test_moe_federation_trains_with_expert_parallelism():
+    """4 nodes × 2-way expert parallelism, 4 federated rounds: the loss
+    trajectory falls and next-token accuracy clears the floor — the MoE
+    routers learn THROUGH the federation (aux balance loss included)."""
+    m = tiny_transformer(seq_len=32, cfg=_moe_cfg())
+    data = FederatedDataset.synthetic_lm(n_train=4 * 128, n_test=128, seq_len=32, vocab_size=256)
+    fed = SpmdLmFederation.from_dataset(
+        m, data, n_nodes=4, batch_size=16, vote=False, expert_parallel=2, seed=0
+    )
+    losses = [float(fed.run_round(epochs=1)["train_loss"]) for _ in range(4)]
+    assert losses[-1] < losses[0] - 0.3, losses
+    acc = fed.evaluate()["test_acc"]
+    assert acc > 0.3, acc  # vocab 256 → chance is ~0.004
+
+
+@pytest.mark.slow
+def test_moe_federation_nodes_stay_synchronized():
+    """After a round every node's slot holds the SAME aggregated params
+    (broadcast over the node axis) — the mesh analogue of
+    check_equal_models."""
+    m = tiny_transformer(seq_len=32, cfg=_moe_cfg())
+    data = FederatedDataset.synthetic_lm(n_train=4 * 64, n_test=64, seq_len=32, vocab_size=256)
+    fed = SpmdLmFederation.from_dataset(
+        m, data, n_nodes=4, batch_size=16, vote=False, expert_parallel=2
+    )
+    fed.run_round(epochs=1)
+    leaf = np.asarray(jax.tree.leaves(fed.params)[0])
+    for i in range(1, leaf.shape[0]):
+        np.testing.assert_allclose(leaf[i], leaf[0], atol=1e-6)
+
+
+@pytest.mark.slow
+def test_pipeline_federation_trains():
+    """2 nodes × 4-stage GPipe pipeline: rounds reduce the loss and the
+    post-federation model beats the initial one."""
+    cfg = TransformerConfig(
+        vocab_size=256, dim=64, n_layers=4, n_heads=4, n_kv_heads=4,
+        ffn_hidden=128, lora_rank=0,
+    )
+    m = tiny_transformer(seq_len=32, cfg=cfg)
+    data = FederatedDataset.synthetic_lm(n_train=2 * 128, n_test=64, seq_len=32, vocab_size=256)
+    shards = [data.partition(i, 2) for i in range(2)]
+    fed = PipelineFederation(m, shards, n_stages=4, batch_size=8, seed=0)
+    acc0 = fed.evaluate()["test_acc"]
+    losses = [fed.run_round(epochs=1)["train_loss"] for _ in range(3)]
+    assert losses[-1] < losses[0] - 0.2, losses
+    acc = fed.evaluate()["test_acc"]
+    assert acc > acc0 + 0.05, (acc0, acc)
+
+
+@pytest.mark.slow
+def test_pipelined_moe_federation_trains():
+    """The full composition: MoE blocks inside a GPipe pipeline inside a
+    federation — router aux losses ride the pipeline (return_aux) and the
+    federation still learns."""
+    cfg = _moe_cfg(n_layers=4)
+    m = tiny_transformer(seq_len=32, cfg=cfg)
+    data = FederatedDataset.synthetic_lm(n_train=2 * 96, n_test=64, seq_len=32, vocab_size=256)
+    shards = [data.partition(i, 2) for i in range(2)]
+    fed = PipelineFederation(m, shards, n_stages=4, batch_size=8, seed=0)
+    losses = [fed.run_round(epochs=1)["train_loss"] for _ in range(3)]
+    assert losses[-1] < losses[0] - 0.15, losses
