@@ -269,19 +269,24 @@ class CausalLM(nn.Module):
         return logits.astype(jnp.float32)
 
 
-def pick_attention(seq_len: int) -> str:
+def pick_attention(seq_len: int, backend: Optional[str] = None) -> str:
     """The ``attn="auto"`` policy: dense vs flash by sequence length.
 
     Uses the crossover measured on real hardware by bench config 7
     (``Settings.FLASH_MIN_SEQ_LEN``): fused dense XLA attention wins at
     short lengths (the O(T²) logits still fit in VMEM-friendly fusions and
     the Pallas kernel's block bookkeeping costs more than it saves), flash
-    wins once the logits matrix stops fitting. Single-chip policy — the
-    ring variants shard the sequence over a mesh and are chosen
-    explicitly.
+    wins once the logits matrix stops fitting. TPU-only: on any other
+    backend the Pallas kernel runs in interpret mode (orders of magnitude
+    slower — a correctness path, not a performance one), so "auto" always
+    answers dense there. Single-chip policy — the ring variants shard the
+    sequence over a mesh and are chosen explicitly.
     """
     from p2pfl_tpu.settings import Settings
 
+    backend = jax.default_backend() if backend is None else backend
+    if backend != "tpu":
+        return "dense"
     return "flash" if seq_len >= Settings.FLASH_MIN_SEQ_LEN else "dense"
 
 
@@ -347,17 +352,24 @@ def tiny_transformer(
             from p2pfl_tpu.settings import Settings
 
             basis = seq_len // mesh.shape[Settings.MESH_MODEL_AXIS]
-        if basis <= 128:
+        if basis <= 512:
             block = basis  # block == T always satisfies the TPU tiling rule
         else:
-            # blocks must divide the basis and (on TPU Mosaic) be a multiple of 8
+            # blocks must divide the basis and (on TPU Mosaic) be a multiple
+            # of 8. Prefer the LARGEST block <= 512: bench config 7's sweep
+            # shows bigger blocks amortize the Pallas grid bookkeeping —
+            # block 512 beat 128 at every measured length (e.g. 194 -> 86 ms
+            # at T=4096)
             block = next(
-                (b for b in range(128, 7, -1) if basis % b == 0 and b % 8 == 0), None
+                (b for b in range(512, 7, -1) if basis % b == 0 and b % 8 == 0), None
             )
             if block is None and attn in ("flash", "ring_flash"):
+                # the sweep goes down to 8, so this only fires when the
+                # attended length itself is not a multiple of 8
                 raise ValueError(
-                    f"attn={attn!r} needs a length with a divisor <=128 that is "
-                    f"a multiple of 8; {basis} (seq_len per shard) has none"
+                    f"attn={attn!r} needs the attended length to be a "
+                    f"multiple of 8 (Mosaic tiling); got {basis} (seq_len "
+                    "per shard)"
                 )
         attn_fn = resolve_attention(attn, mesh=mesh, block=block)
     module = CausalLM(cfg, attn_fn)

@@ -83,11 +83,16 @@ class Settings:
     # local datasets are. Requires WIRE_COMPRESSION="none".
     SECAGG_MASK_STD: float = 100.0
     # Sequence length at/above which attn="auto" picks the Pallas flash
-    # kernel over fused dense XLA attention. Crossover measured on the
-    # real chip by bench config 7 (BASELINE.md row 7): dense wins at
-    # T<=2048, flash wins from T=4096 (1.7x at default blocks). Re-tune
-    # with `python bench_suite.py 7` if the model shape changes.
-    FLASH_MIN_SEQ_LEN: int = 4096
+    # kernel over fused dense XLA attention (TPU backends only — anywhere
+    # else the kernel runs in interpret mode and "auto" stays dense).
+    # Crossover measured on the real chip by bench config 7 (BASELINE.md
+    # row 7, BENCH_SUITE.json). Round-3 block tuning (the kernel's
+    # block_q/block_k swept per length) moved it from 4096 down to 1024:
+    # at block 512 flash beats dense 1.40x at T=1024, 1.67x at 2048,
+    # 3.84x at 4096. Below 1024 dense remains the default (unmeasured
+    # territory + the fused-logits path is already VMEM-resident there).
+    # Re-tune with `python bench_suite.py 7` if the model shape changes.
+    FLASH_MIN_SEQ_LEN: int = 1024
     # How long a train-set node waits for peers' secagg_recover seed
     # disclosures after an aggregation timeout with dropouts, before giving
     # the round up (keeping the previous global instead of applying noise).

@@ -70,9 +70,10 @@ def test_flash_in_transformer():
 
 
 def test_auto_attention_picks_by_length():
-    """attn="auto" (VERDICT r2 #8): dense below the measured crossover
-    (Settings.FLASH_MIN_SEQ_LEN, from bench config 7), flash at/above —
-    and the policy is overridable through the settings knob."""
+    """attn="auto" (VERDICT r2 #8): on TPU, dense below the measured
+    crossover (Settings.FLASH_MIN_SEQ_LEN, from bench config 7) and flash
+    at/above; on every OTHER backend always dense — interpret-mode Pallas
+    is a correctness path, not a performance one."""
     from p2pfl_tpu.models.transformer import (
         TransformerConfig,
         pick_attention,
@@ -81,25 +82,19 @@ def test_auto_attention_picks_by_length():
     )
     from p2pfl_tpu.settings import Settings
 
-    assert pick_attention(Settings.FLASH_MIN_SEQ_LEN - 1) == "dense"
-    assert pick_attention(Settings.FLASH_MIN_SEQ_LEN) == "flash"
-    # resolve_attention: dense → None (fused XLA path); flash → callable
-    assert resolve_attention("auto", seq_len=128) is None
-    assert callable(resolve_attention("auto", seq_len=Settings.FLASH_MIN_SEQ_LEN))
+    t = Settings.FLASH_MIN_SEQ_LEN
+    assert pick_attention(t - 1, backend="tpu") == "dense"
+    assert pick_attention(t, backend="tpu") == "flash"
+    assert pick_attention(t * 8, backend="cpu") == "dense"  # non-TPU gate
     with pytest.raises(ValueError, match="seq_len"):
         resolve_attention("auto")
-
-    # end to end through tiny_transformer: lower the knob so the flash
-    # path is exercised at a test-sized length, outputs match dense
+    # this suite runs on the CPU backend: auto resolves to the dense path
+    # (None) at any length, and the model builds/runs
+    assert resolve_attention("auto", seq_len=t * 8) is None
     cfg = TransformerConfig(
         vocab_size=64, dim=32, n_layers=1, n_heads=2, n_kv_heads=2, ffn_hidden=64
     )
-    old = Settings.FLASH_MIN_SEQ_LEN
-    try:
-        Settings.FLASH_MIN_SEQ_LEN = 32
-        m_auto = tiny_transformer(seq_len=32, cfg=cfg, attn="auto", seed=4)
-    finally:
-        Settings.FLASH_MIN_SEQ_LEN = old
+    m_auto = tiny_transformer(seq_len=32, cfg=cfg, attn="auto", seed=4)
     m_dense = tiny_transformer(seq_len=32, cfg=cfg, seed=4)
     toks = (jnp.arange(32, dtype=jnp.int32) % 64)[None]
     np.testing.assert_allclose(
