@@ -9,6 +9,13 @@ service uses gRPC *generic handlers* over raw bytes with a compact envelope
 codec (JSON header + the framework's own zero-pickle weights format from
 ``learning/weights.py``) — byte-layout documented in ``proto/node.proto``.
 
+Interop: ``Settings.WIRE_FORMAT="protobuf"`` switches OUTGOING frames to
+the reference's protobuf schema (``proto_wire.py``; same service path and
+method names either way), and every server entry point sniffs the frame
+format — so mixed-format federations, including a reference node on the
+control plane, interoperate frame by frame. Replies match the request's
+format.
+
 Weight payloads cross the wire as ``ModelUpdate.encoded`` bytes and are
 materialized against the receiving learner's parameter structure
 (name-aware, not positional — unlike the reference's zip-by-order decode,
@@ -24,6 +31,7 @@ from typing import Optional
 
 import grpc
 
+from p2pfl_tpu.communication import proto_wire as pw
 from p2pfl_tpu.communication.message import Message, WeightsEnvelope
 from p2pfl_tpu.communication.neighbors import Neighbors
 from p2pfl_tpu.communication.protocol import CommunicationProtocol
@@ -94,17 +102,43 @@ def _reply_ok(data: bytes) -> bool:
         return False
 
 
+# ---- wire-format dispatch (envelope default; protobuf = reference interop) ----
+
+
+def _pbuf() -> bool:
+    return Settings.WIRE_FORMAT == "protobuf"
+
+
+def _enc_handshake(addr: str) -> bytes:
+    return pw.encode_handshake_pb(addr) if _pbuf() else addr.encode()
+
+
+def _enc_message(msg: Message) -> bytes:
+    return pw.encode_message_pb(msg) if _pbuf() else encode_message(msg)
+
+
+def _enc_weights(env: WeightsEnvelope) -> bytes:
+    return pw.encode_weights_pb(env) if _pbuf() else encode_weights(env)
+
+
+def _resp_ok(data: bytes) -> bool:
+    return pw.decode_response_ok_pb(data) if _pbuf() else _reply_ok(data)
+
+
 # ---- transport pieces ----
 
 
 class GrpcNeighbors(Neighbors):
     def _connect(self, addr: str, handshake: bool):
+        # encode before opening the channel: a misconfigured WIRE_FORMAT
+        # (protobuf runtime absent) must raise without leaking a channel
+        payload = _enc_handshake(self.self_addr) if handshake else b""
         channel = grpc.insecure_channel(addr)
         if handshake:
             try:
                 caller = channel.unary_unary(_SERVICE + "handshake")
-                resp = caller(self.self_addr.encode(), timeout=Settings.GRPC_TIMEOUT)
-                if not _reply_ok(resp):
+                resp = caller(payload, timeout=Settings.GRPC_TIMEOUT)
+                if not _resp_ok(resp):
                     raise NeighborNotConnectedError(f"handshake rejected by {addr}")
             except grpc.RpcError as exc:
                 channel.close()
@@ -117,9 +151,11 @@ class GrpcNeighbors(Neighbors):
         if notify:
             try:
                 conn.unary_unary(_SERVICE + "disconnect")(
-                    self.self_addr.encode(), timeout=Settings.GRPC_TIMEOUT
+                    _enc_handshake(self.self_addr), timeout=Settings.GRPC_TIMEOUT
                 )
-            except grpc.RpcError:
+            except (grpc.RpcError, RuntimeError):
+                # RuntimeError: WIRE_FORMAT='protobuf' without the runtime —
+                # best-effort notify must still close the channel below
                 pass
         conn.close()
 
@@ -180,19 +216,19 @@ class GrpcProtocol(CommunicationProtocol):
         try:
             kind = "weights" if isinstance(env, WeightsEnvelope) else "control"
             if kind == "weights":
-                payload = encode_weights(env)
+                payload = _enc_weights(env)
                 resp = channel.unary_unary(_SERVICE + "send_weights")(
                     payload, timeout=Settings.GRPC_TIMEOUT
                 )
             else:
-                payload = encode_message(env)
+                payload = _enc_message(env)
                 resp = channel.unary_unary(_SERVICE + "send_message")(
                     payload, timeout=Settings.GRPC_TIMEOUT
                 )
             with self._lock:
                 self.wire_stats[f"{kind}_bytes"] += len(payload)
                 self.wire_stats[f"{kind}_msgs"] += 1
-            return _reply_ok(resp)
+            return _resp_ok(resp)
         except grpc.RpcError:
             return False
         finally:
@@ -201,27 +237,40 @@ class GrpcProtocol(CommunicationProtocol):
 
     # ---- server-side entry points ----
 
+    # every entry point sniffs the frame format and replies in kind, so a
+    # mixed-format federation (or a reference node) interoperates without
+    # any receiver-side configuration
+
+    @staticmethod
+    def _reply_as(pbuf: bool, ok: bool, error: str = "") -> bytes:
+        return pw.encode_response_pb(ok, error) if pbuf else _reply(ok, error)
+
     def rpc_handshake(self, data: bytes, context) -> bytes:
-        source = data.decode()
+        pbuf = pw.HAVE_PROTOBUF and pw.is_protobuf_handshake(data)
+        source = pw.decode_handshake_pb(data) if pbuf else data.decode()
         self.neighbors.add(source, non_direct=False, handshake=False)
-        return _reply(True)
+        return self._reply_as(pbuf, True)
 
     def rpc_disconnect(self, data: bytes, context) -> bytes:
-        self.neighbors.remove(data.decode())
-        return _reply(True)
+        pbuf = pw.HAVE_PROTOBUF and pw.is_protobuf_handshake(data)
+        self.neighbors.remove(pw.decode_handshake_pb(data) if pbuf else data.decode())
+        return self._reply_as(pbuf, True)
 
     def rpc_send_message(self, data: bytes, context) -> bytes:
-        res = self.handle_message(decode_message(data))
-        return _reply(res.ok, res.error or "")
+        pbuf = pw.HAVE_PROTOBUF and pw.is_protobuf_message(data)
+        msg = pw.decode_message_pb(data) if pbuf else decode_message(data)
+        res = self.handle_message(msg)
+        return self._reply_as(pbuf, res.ok, res.error or "")
 
     def rpc_send_weights(self, data: bytes, context) -> bytes:
+        pbuf = pw.HAVE_PROTOBUF and pw.is_protobuf_weights(data)
         try:
-            env = decode_weights(data)
+            env = pw.decode_weights_pb(data) if pbuf else decode_weights(data)
         except Exception as exc:  # noqa: BLE001 — malformed payload
             logger.error(self._address, f"Malformed weights payload: {exc}")
-            return _reply(False, "malformed weights payload")
+            return self._reply_as(pbuf, False, "malformed weights payload")
         res = self.handle_weights(env)
-        return _reply(res.ok, res.error or "")
+        return self._reply_as(pbuf, res.ok, res.error or "")
 
 
 class _Handler(grpc.GenericRpcHandler):

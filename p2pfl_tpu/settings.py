@@ -60,6 +60,13 @@ class Settings:
     # Mesh axis names used by the parallel runtime.
     MESH_NODES_AXIS: str = "nodes"
     MESH_MODEL_AXIS: str = "model"
+    # Outgoing gRPC frame format: "envelope" (compact JSON-header frames,
+    # the default) | "protobuf" (the reference's node.proto schema —
+    # communication/proto_wire.py; control plane fully interoperable with
+    # a reference node, weight payloads stay the safe P2TW codec).
+    # Receivers sniff per frame, so mixed-format federations interoperate
+    # regardless of this knob.
+    WIRE_FORMAT: str = "envelope"
     # Wire compression for network transports: "none" | "int8" | "topk8"
     # (int8 = symmetric per-tensor quantization, 4x smaller gossip payloads,
     # native C++ hot loop when p2pfl_tpu/native is built; topk8 = top-k
