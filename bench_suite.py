@@ -749,6 +749,11 @@ def config10_moe_gpipe_federation() -> None:
             break
     sec_per_round = _steady_state(fed, rounds=3)
     flops, round_mfu = _spmd_mfu(fed, sec_per_round)
+    # NOT fused: measured on the chip, run_fused SLOWS this federation
+    # (0.78 -> 3.4 s/round) — full-param MoE rounds are compute-bound, so
+    # the fused scan's carry costs more than the one dispatch it saves
+    # (see SpmdLmFederation.run_fused's docstring; fusing pays only for
+    # dispatch-dominated tiny-state rounds like config 5's adapters)
     emit({
         "metric": "config10_moe_federation",
         "value": round(sec_per_round, 4),

@@ -71,8 +71,11 @@ def _hash64(msg_id: str) -> int:
     must round-trip to the SAME integer when relayed — re-hashing would
     give every gossip hop a fresh dedup id and the flood would never be
     suppressed (each receiver dispatching the same command once per hop).
+    Reference nodes derive the hash from Python's SIGNED hash, so negative
+    values round-trip too.
     """
-    if msg_id.isdigit() and int(msg_id) < (1 << 63):
+    digits = msg_id[1:] if msg_id.startswith("-") else msg_id
+    if digits.isdigit() and abs(int(msg_id)) < (1 << 63):
         return int(msg_id)
     return int.from_bytes(hashlib.sha256(msg_id.encode()).digest()[:8], "big") >> 1
 

@@ -139,6 +139,36 @@ def spmd_lm_round(stacked, opt_states, x_all, y_all, perm, mask, weights, sel_id
     )
 
 
+@partial(jax.jit, static_argnames=_LM_STATICS, donate_argnums=(0, 1))
+def spmd_lm_rounds_fused(
+    stacked, opt_states, x_all, y_all, perms, mask, weights, sel_idx, **kw
+):
+    """R LM-federation rounds as ONE device dispatch (``lax.scan``).
+
+    ``perms``: [R, N, epochs, nb, bs]. Fixed train set for the span (no
+    per-round voting). Returns (params', opt', losses [R]).
+
+    When fusing pays, measured: it amortizes the host↔device round trip,
+    which only matters when rounds are DISPATCH-dominated — tiny federated
+    state like config 5's LoRA adapters (0.40 → 0.15 s/round). For
+    compute-bound full-parameter federations the fused scan's whole-state
+    carry makes XLA's scheduling WORSE, not better: config 10's MoE
+    federation measured 0.78 s/round unfused vs 3.4 s/round fused on the
+    chip. Default to :meth:`SpmdLmFederation.run_round`; reach for fused
+    only after measuring.
+    """
+
+    def body(carry, perm):
+        p, o = carry
+        out_p, out_o, loss = _lm_round_core(
+            p, o, x_all, y_all, perm, mask, weights, sel_idx, **kw
+        )
+        return (out_p, out_o), loss
+
+    (p, o), losses = jax.lax.scan(body, (stacked, opt_states), perms)
+    return p, o, losses
+
+
 @partial(jax.jit, static_argnames=("module",))
 def spmd_lm_eval(stacked, x_test, y_test, *, module):
     def node_eval(p, x, y):
@@ -249,10 +279,23 @@ class SpmdLmFederation(SpmdFederation):
         self.history.append(entry)
         return entry
 
-    def run_fused(self, *a, **k):
-        raise NotImplementedError(
-            "SpmdLmFederation has no fused-rounds program yet; loop run_round"
+    def run_fused(self, rounds: int, epochs: int = 1) -> list[dict]:
+        """R rounds in ONE dispatch (fixed train set for the span)."""
+        perms, mask, sel_idx = self._fused_inputs(rounds, epochs)
+        self.params, self.opt_state, losses = spmd_lm_rounds_fused(
+            self.params, self.opt_state, self.x_all, self.y_all,
+            perms, mask, self._samples, sel_idx,
+            module=self.module, tx=self.tx, agg=self.aggregator, trim=self.trim,
+            out_sharding=self._out_sharding_static(),
+            keep_opt_state=self.keep_opt_state, remat=self.remat,
         )
+        entries = []
+        for r in range(rounds):
+            self.round += 1
+            entry = {"round": self.round, "train_loss": losses[r]}
+            self.history.append(entry)
+            entries.append(entry)
+        return entries
 
     def evaluate(self) -> dict:
         loss, acc = spmd_lm_eval(self.params, self.x_test, self.y_test, module=self.module)

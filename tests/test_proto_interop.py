@@ -55,11 +55,17 @@ def test_message_roundtrip_and_sniffing():
 def test_relay_keeps_dedup_hash_stable():
     """A relayed protobuf message must carry the SAME int64 hash on every
     hop — re-hashing per hop would defeat gossip dedup entirely (each
-    receiver would dispatch the same command once per hop)."""
+    receiver would dispatch the same command once per hop). Reference
+    nodes use Python's SIGNED hash, so negative values round-trip too."""
     msg = Message("n1:1", "vote_train_set", ("a", "1"), round=0, ttl=5)
     hop1 = pw.decode_message_pb(pw.encode_message_pb(msg))
     hop2 = pw.decode_message_pb(pw.encode_message_pb(hop1))  # the relay
     assert hop1.msg_id == hop2.msg_id
+
+    neg = pw.pb.Message(source="ref:1", ttl=5, hash=-1234, cmd="beat").SerializeToString()
+    ref_hop1 = pw.decode_message_pb(neg)
+    ref_hop2 = pw.decode_message_pb(pw.encode_message_pb(ref_hop1))
+    assert ref_hop1.msg_id == ref_hop2.msg_id == "-1234"
 
 
 def test_sniffing_survives_large_envelope_headers():
@@ -114,10 +120,13 @@ def test_handshake_and_response_frames():
 
 
 @pytest.mark.slow
-def test_mixed_format_federation_end_to_end():
-    """One node sends protobuf frames, the other envelope frames — the
-    receivers sniff per frame and the federation converges over real
-    sockets exactly as a single-format one."""
+def test_protobuf_federation_end_to_end():
+    """The whole federation in WIRE_FORMAT='protobuf': every frame that
+    crosses the real sockets is reference-schema protobuf, and the
+    sniffing receivers converge exactly as the envelope format does.
+    (Per-frame MIXED format is covered by the unit sniff tests — the
+    format knob is process-global, so a true two-format two-node run in
+    one process would race on it.)"""
     full = FederatedDataset.synthetic_mnist(n_train=512, n_test=128)
     nodes = []
     try:
@@ -128,13 +137,6 @@ def test_mixed_format_federation_end_to_end():
         )
         n0.start()
         nodes.append(n0)
-        # NOTE: WIRE_FORMAT is read at SEND time, so with a global knob the
-        # whole process would flip together; emulate a mixed network by
-        # flipping the knob while each node's sends happen is racy — instead
-        # run the whole federation in protobuf mode (every frame crossing
-        # the wire is reference-schema protobuf), which also covers the
-        # sniffing receivers. The per-frame mixed case is covered by the
-        # unit sniff tests above.
         n1 = Node(
             learner=JaxLearner(mlp(seed=1), full.partition(1, 2), batch_size=64),
             protocol=GrpcProtocol("127.0.0.1:0"),
