@@ -246,47 +246,48 @@ class GrpcProtocol(CommunicationProtocol):
         return pw.encode_response_pb(ok, error) if pbuf else _reply(ok, error)
 
     def _sniff(self, data: bytes, looks_protobuf: bool):
-        """(is_protobuf, rejected): a frame that LOOKS protobuf while the
-        runtime is absent must be refused — decoding it as an envelope
-        would silently accept garbage (e.g. a corrupt neighbor address)."""
+        """(is_protobuf, rejection_reply_or_None): a frame that LOOKS
+        protobuf while the runtime is absent must be refused — decoding it
+        as an envelope would silently accept garbage (e.g. a corrupt
+        neighbor address)."""
         if not looks_protobuf:
-            return False, False
+            return False, None
         if not pw.HAVE_PROTOBUF:
             logger.error(
                 self._address,
                 "Received a protobuf frame but google.protobuf is not "
                 "installed — rejecting (pip install protobuf for interop)",
             )
-            return False, True
-        return True, False
+            return False, self._reply_as(False, False, "protobuf runtime unavailable")
+        return True, None
 
     def rpc_handshake(self, data: bytes, context) -> bytes:
-        pbuf, rejected = self._sniff(data, pw.is_protobuf_handshake(data))
-        if rejected:
-            return self._reply_as(False, False, "protobuf runtime unavailable")
+        pbuf, rejection = self._sniff(data, pw.is_protobuf_handshake(data))
+        if rejection is not None:
+            return rejection
         source = pw.decode_handshake_pb(data) if pbuf else data.decode()
         self.neighbors.add(source, non_direct=False, handshake=False)
         return self._reply_as(pbuf, True)
 
     def rpc_disconnect(self, data: bytes, context) -> bytes:
-        pbuf, rejected = self._sniff(data, pw.is_protobuf_handshake(data))
-        if rejected:
-            return self._reply_as(False, False, "protobuf runtime unavailable")
+        pbuf, rejection = self._sniff(data, pw.is_protobuf_handshake(data))
+        if rejection is not None:
+            return rejection
         self.neighbors.remove(pw.decode_handshake_pb(data) if pbuf else data.decode())
         return self._reply_as(pbuf, True)
 
     def rpc_send_message(self, data: bytes, context) -> bytes:
-        pbuf, rejected = self._sniff(data, pw.is_protobuf_message(data))
-        if rejected:
-            return self._reply_as(False, False, "protobuf runtime unavailable")
+        pbuf, rejection = self._sniff(data, pw.is_protobuf_message(data))
+        if rejection is not None:
+            return rejection
         msg = pw.decode_message_pb(data) if pbuf else decode_message(data)
         res = self.handle_message(msg)
         return self._reply_as(pbuf, res.ok, res.error or "")
 
     def rpc_send_weights(self, data: bytes, context) -> bytes:
-        pbuf, rejected = self._sniff(data, pw.is_protobuf_weights(data))
-        if rejected:
-            return self._reply_as(False, False, "protobuf runtime unavailable")
+        pbuf, rejection = self._sniff(data, pw.is_protobuf_weights(data))
+        if rejection is not None:
+            return rejection
         try:
             env = pw.decode_weights_pb(data) if pbuf else decode_weights(data)
         except Exception as exc:  # noqa: BLE001 — malformed payload

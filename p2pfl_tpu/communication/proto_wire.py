@@ -75,8 +75,12 @@ def _hash64(msg_id: str) -> int:
     values round-trip too.
     """
     digits = msg_id[1:] if msg_id.startswith("-") else msg_id
-    if digits.isdigit() and abs(int(msg_id)) < (1 << 63):
-        return int(msg_id)
+    # ascii-only: str.isdigit() accepts Unicode digits that int() rejects,
+    # and a peer-controlled id must never crash the relaying gossiper
+    if digits.isascii() and digits.isdigit():
+        v = int(msg_id)
+        if -(1 << 63) <= v < (1 << 63):  # the FULL signed-int64 range
+            return v
     return int.from_bytes(hashlib.sha256(msg_id.encode()).digest()[:8], "big") >> 1
 
 

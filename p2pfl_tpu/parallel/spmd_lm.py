@@ -180,14 +180,16 @@ def spmd_lm_eval(stacked, x_test, y_test, *, module):
 
 
 class SpmdLmFederation(SpmdFederation):
-    """Full-parameter LM federation on a ``(nodes, model)`` mesh (dp × ep).
+    """Full-parameter LM federation on a ``(nodes, model)`` mesh.
 
-    ``expert_parallel`` sets the ``model``-axis size of the default mesh;
-    MoE expert stacks shard their expert axis over it per the rules in
-    ``parallel/sharding.py`` (``mlp/w[123]``, router replicated). Dense
-    transformers run too (the ``model`` axis is just unused), but the
-    point of this class is federations whose per-node model exceeds one
-    chip's appetite along the expert axis.
+    dp × tp × ep in one program: ``expert_parallel`` sets the
+    ``model``-axis size of the default mesh; MoE expert stacks shard
+    their expert axis over it per the rules in ``parallel/sharding.py``
+    (``mlp/w[123]``, router replicated) — and the SAME rules
+    column/row-shard the dense attention and MLP projections
+    (Megatron-style tensor parallelism), so dense transformers use the
+    ``model`` axis too. The point of this class is federations whose
+    per-node model exceeds one chip's appetite along either axis.
     """
 
     def __init__(

@@ -46,6 +46,11 @@ def test_moe_federation_expert_leaves_shard_over_model_axis():
     assert specs["layer_0/mlp/w2"][:2] == (nodes, model)
     assert tuple(specs["layer_0/mlp/router"]) == (nodes,)  # router replicated
     assert tuple(specs["layer_0/attn_norm/scale"]) == (nodes,)
+    # the Megatron TP rules apply to the DENSE weights too — attention
+    # projections are column-parallel over the same model axis, so this
+    # runtime is really dp × tp × ep in one program
+    assert tuple(specs["layer_0/attn/wq/kernel"]) == (nodes, None, model)
+    assert tuple(specs["layer_0/attn/wo/kernel"]) == (nodes, model, None)
 
 
 @pytest.mark.slow

@@ -62,10 +62,15 @@ def test_relay_keeps_dedup_hash_stable():
     hop2 = pw.decode_message_pb(pw.encode_message_pb(hop1))  # the relay
     assert hop1.msg_id == hop2.msg_id
 
-    neg = pw.pb.Message(source="ref:1", ttl=5, hash=-1234, cmd="beat").SerializeToString()
-    ref_hop1 = pw.decode_message_pb(neg)
-    ref_hop2 = pw.decode_message_pb(pw.encode_message_pb(ref_hop1))
-    assert ref_hop1.msg_id == ref_hop2.msg_id == "-1234"
+    for h in (-1234, -(1 << 63), (1 << 63) - 1):  # incl. the int64 extremes
+        neg = pw.pb.Message(source="ref:1", ttl=5, hash=h, cmd="beat").SerializeToString()
+        ref_hop1 = pw.decode_message_pb(neg)
+        ref_hop2 = pw.decode_message_pb(pw.encode_message_pb(ref_hop1))
+        assert ref_hop1.msg_id == ref_hop2.msg_id == str(h)
+
+    # a peer-controlled id must never crash the relay encoder: Unicode
+    # digits pass str.isdigit() but not int() — falls back to sha, no raise
+    assert 0 <= pw._hash64("²") < (1 << 63)
 
 
 def test_sniffing_survives_large_envelope_headers():
@@ -117,6 +122,22 @@ def test_handshake_and_response_frames():
     assert pw.decode_handshake_pb(data) == "127.0.0.1:41234"
     assert pw.decode_response_ok_pb(pw.encode_response_pb(True))
     assert not pw.decode_response_ok_pb(pw.encode_response_pb(False, "nope"))
+
+
+def test_degraded_mode_rejects_protobuf_frames(monkeypatch):
+    """Without the protobuf runtime, a protobuf-looking frame must be
+    REFUSED — decoding a HandShakeRequest as a raw UTF-8 address would
+    register a garbage neighbor (b'\\n\\x0f127...' decodes fine) and
+    poison the overlay."""
+    proto = GrpcProtocol("127.0.0.1:0")
+    frame = pw.encode_handshake_pb("127.0.0.1:41234")
+    monkeypatch.setattr(pw, "HAVE_PROTOBUF", False)
+    reply = proto.rpc_handshake(frame, None)
+    assert b"protobuf runtime" in reply
+    assert len(proto.neighbors.get_all()) == 0  # nothing registered
+    # envelope frames still work in degraded mode
+    reply = proto.rpc_handshake(b"127.0.0.1:41234", None)
+    assert "127.0.0.1:41234" in proto.neighbors.get_all()
 
 
 @pytest.mark.slow
