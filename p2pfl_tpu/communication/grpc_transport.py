@@ -10,11 +10,17 @@ codec (JSON header + the framework's own zero-pickle weights format from
 ``learning/weights.py``) — byte-layout documented in ``proto/node.proto``.
 
 Interop: ``Settings.WIRE_FORMAT="protobuf"`` switches OUTGOING frames to
-the reference's protobuf schema (``proto_wire.py``; same service path and
-method names either way), and every server entry point sniffs the frame
-format — so mixed-format federations, including a reference node on the
-control plane, interoperate frame by frame. Replies match the request's
-format.
+the reference's protobuf schema (``proto_wire.py``) AND dials the
+reference's real gRPC method paths — its proto declares ``package node;``
+(``node.proto:24``), so its generated stubs serve and call
+``/node.NodeServices/{handshake,disconnect,send_message,send_weights}``
+(``node_pb2_grpc.py:44``). The server registers BOTH that path and this
+framework's native ``/p2pfl.NodeServices/`` prefix, and every entry point
+sniffs the frame format — so mixed-format federations, including a real
+reference node on the control plane, interoperate frame by frame. Replies
+match the request's format (a no-error ``ResponseMessage`` serializes to
+zero bytes, which also parses as the ``google.protobuf.Empty`` the
+reference expects from ``disconnect``).
 
 Weight payloads cross the wire as ``ModelUpdate.encoded`` bytes and are
 materialized against the receiving learner's parameter structure
@@ -41,6 +47,9 @@ from p2pfl_tpu.management.logger import logger
 from p2pfl_tpu.settings import Settings
 
 _SERVICE = "/p2pfl.NodeServices/"
+#: the reference's actual service path — its proto declares ``package node;``
+#: so generated stubs use /node.NodeServices/* (reference node_pb2_grpc.py:44)
+_SERVICE_REF = "/node.NodeServices/"
 _METHODS = ("handshake", "disconnect", "send_message", "send_weights")
 
 
@@ -109,6 +118,13 @@ def _pbuf() -> bool:
     return Settings.WIRE_FORMAT == "protobuf"
 
 
+def _svc() -> str:
+    """Dial path for outgoing RPCs: the reference's real /node.NodeServices/
+    when speaking protobuf (so a reference server routes us), the native
+    /p2pfl.NodeServices/ otherwise."""
+    return _SERVICE_REF if _pbuf() else _SERVICE
+
+
 def _enc_handshake(addr: str) -> bytes:
     return pw.encode_handshake_pb(addr) if _pbuf() else addr.encode()
 
@@ -136,7 +152,7 @@ class GrpcNeighbors(Neighbors):
         channel = grpc.insecure_channel(addr)
         if handshake:
             try:
-                caller = channel.unary_unary(_SERVICE + "handshake")
+                caller = channel.unary_unary(_svc() + "handshake")
                 resp = caller(payload, timeout=Settings.GRPC_TIMEOUT)
                 if not _resp_ok(resp):
                     raise NeighborNotConnectedError(f"handshake rejected by {addr}")
@@ -150,7 +166,7 @@ class GrpcNeighbors(Neighbors):
             return
         if notify:
             try:
-                conn.unary_unary(_SERVICE + "disconnect")(
+                conn.unary_unary(_svc() + "disconnect")(
                     _enc_handshake(self.self_addr), timeout=Settings.GRPC_TIMEOUT
                 )
             except (grpc.RpcError, RuntimeError):
@@ -217,12 +233,12 @@ class GrpcProtocol(CommunicationProtocol):
             kind = "weights" if isinstance(env, WeightsEnvelope) else "control"
             if kind == "weights":
                 payload = _enc_weights(env)
-                resp = channel.unary_unary(_SERVICE + "send_weights")(
+                resp = channel.unary_unary(_svc() + "send_weights")(
                     payload, timeout=Settings.GRPC_TIMEOUT
                 )
             else:
                 payload = _enc_message(env)
-                resp = channel.unary_unary(_SERVICE + "send_message")(
+                resp = channel.unary_unary(_svc() + "send_message")(
                     payload, timeout=Settings.GRPC_TIMEOUT
                 )
             with self._lock:
@@ -299,8 +315,13 @@ class GrpcProtocol(CommunicationProtocol):
 
 class _Handler(grpc.GenericRpcHandler):
     def __init__(self, protocol: GrpcProtocol) -> None:
+        # both prefixes route to the same sniffing handlers: the reference's
+        # stubs call /node.NodeServices/* (its proto's `package node;`),
+        # existing repo federations call /p2pfl.NodeServices/*
         self._routes = {
-            _SERVICE + m: getattr(protocol, f"rpc_{m}") for m in _METHODS
+            svc + m: getattr(protocol, f"rpc_{m}")
+            for svc in (_SERVICE, _SERVICE_REF)
+            for m in _METHODS
         }
 
     def service(self, call_details):

