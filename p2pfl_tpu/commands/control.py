@@ -132,7 +132,10 @@ class SecAggNeedCommand(Command):
     re-disclosing its pair seed for the named members — INCLUDING when its
     own coverage reached full (early finalizers would otherwise never
     disclose, leaving a peer with a smaller coverage view to burn its
-    recovery timeout for nothing). Pair seeds are per-experiment, so
+    recovery timeout for nothing) and INCLUDING when it already disclosed
+    for an earlier request (a lagging requester drops disclosures for
+    rounds it has not reached yet; re-broadcasts are idempotent because
+    receivers latch first-wins). Pair seeds are per-experiment, so
     answering for the previous round is safe; the experiment name in the
     request guards against latching a wrong-experiment seed.
 
@@ -185,10 +188,22 @@ class SecAggNeedCommand(Command):
                     "here — refusing to disclose its pair seed",
                 )
                 continue
-            key = (round, j)
-            if key in st.secagg_disclosure_sent:
+            # Latch per (round, j, REQUESTER), not per (round, j): a lagging
+            # requester may have dropped an earlier broadcast triggered by a
+            # different peer's request (SecAggRecoverCommand ignores frames
+            # whose round != st.round), so a global send-once latch would
+            # leave it burning SECAGG_RECOVERY_TIMEOUT for nothing —
+            # re-broadcasting the same seed is idempotent (receivers latch
+            # first-wins). Keying by requester keeps amplification bounded:
+            # each legitimate member sends one secagg_need per round, and a
+            # replaying attacker must be a train-set member (standing check
+            # above), so the worst case is one answer per member per round.
+            if (round, j, source) in st.secagg_disclosure_sent:
                 continue
-            st.secagg_disclosure_sent.add(key)
+            st.secagg_disclosure_sent.add((round, j, source))
+            # the 2-tuple key still lets the proactive disclosure path
+            # (learning_stages._secagg_finalize) skip its redundant send
+            st.secagg_disclosure_sent.add((round, j))
             seed = secagg.dh_pair_seed(st.secagg_priv, st.secagg_pubs[j][0], exp)
             node.protocol.broadcast(
                 node.protocol.build_msg("secagg_recover", [j, f"{seed:x}"], round=round)

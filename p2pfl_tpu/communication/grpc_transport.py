@@ -307,7 +307,17 @@ class GrpcProtocol(CommunicationProtocol):
         try:
             env = pw.decode_weights_pb(data) if pbuf else decode_weights(data)
         except Exception as exc:  # noqa: BLE001 — malformed payload
-            logger.error(self._address, f"Malformed weights payload: {exc}")
+            logger.error(
+                self._address,
+                f"Malformed weights payload: {exc}"
+                + (
+                    ""
+                    if pbuf
+                    else " (if the sender speaks protobuf, note the sniff "
+                    "requires a non-empty Weights.source — an empty source "
+                    "frame is misrouted to the envelope decoder)"
+                ),
+            )
             return self._reply_as(pbuf, False, "malformed weights payload")
         res = self.handle_weights(env)
         return self._reply_as(pbuf, res.ok, res.error or "")

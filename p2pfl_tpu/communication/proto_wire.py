@@ -97,7 +97,14 @@ def is_protobuf_message(data: bytes) -> bool:
     """True when a send_message frame is reference-schema protobuf.
 
     JSON envelope frames always start with ``{``; a protobuf ``Message``
-    always starts with the field-1 tag (source is required in practice).
+    always starts with the field-1 tag. ASSUMPTION (documented limit of
+    the sniff): ``source`` is non-empty. proto3 omits default-valued
+    fields, so a Message with ``source=""`` would serialize starting at
+    the ttl/hash tag (0x10/0x18) and be misrouted to the envelope decoder.
+    Every sender in both implementations stamps its own address as the
+    source (the gossip dedup and eviction logic require it), so an
+    empty-source frame is malformed at the protocol level anyway — the
+    envelope decoder's error message names this cause.
     """
     return bool(data) and data[0] == _TAG_FIELD1
 
@@ -112,7 +119,9 @@ def is_protobuf_weights(data: bytes) -> bool:
     matches ``data[3] == 0 and data[4] == '{'``. A protobuf ``Weights``
     opens with tag 0x0A + the length-prefixed source string, whose bytes
     land at data[2:] — an address never contains NUL, so ``data[3]`` is
-    nonzero there and the two formats cannot collide.
+    nonzero there and the two formats cannot collide. Same non-empty
+    ``source`` assumption as :func:`is_protobuf_message` (an empty source
+    would start the frame at the round/weights tag and misroute it).
     """
     if len(data) < 5:
         return False

@@ -303,6 +303,11 @@ class ModelUpdate:
     contributors: list[str] = field(default_factory=list)
     num_samples: int = 1
     encoded: Optional[bytes] = None  # populated lazily for byte transports
+    #: True when this "aggregate" is really the round-start global kept by
+    #: a failed secagg recovery (a no-op round) — receivers of a diffusion
+    #: must never mistake it for the round's authoritative aggregate, so
+    #: GossipModelStage skips outward diffusion when set. Never serialized.
+    noop_round: bool = False
     #: round-start global model for delta (topk8) wire coding — never
     #: serialized; attached by the learner, inherited through aggregation
     anchor: Optional[Pytree] = None

@@ -367,6 +367,20 @@ class GossipModelStage(Stage):
         node.protocol.broadcast(
             node.protocol.build_msg("models_ready", [], round=state.round or 0)
         )
+        if agg.noop_round:
+            # failed secagg recovery: our params are the round-start global,
+            # NOT this round's aggregate — diffusing them with the full
+            # train set as contributors would let behind neighbors adopt
+            # stale params as round-r consensus while recovered peers
+            # diffuse the real aggregate. Finish the round quietly; behind
+            # neighbors get the aggregate from a recovered peer (or no-op
+            # this round exactly as we did).
+            logger.warning(
+                node.addr,
+                "SecAgg: no-op round — skipping outward diffusion of the "
+                "round-start globals (not this round's aggregate)",
+            )
+            return RoundFinishedStage
 
         # diffusion: push the aggregated model to direct neighbors that are
         # behind on this round (reference gossip_model_stage.py:100-124)
@@ -507,7 +521,9 @@ class GossipModelStage(Stage):
             prev = getattr(node, "round_start_params", None)
             if prev is None:
                 prev = node.learner.get_parameters()
-            return ModelUpdate(prev, sorted(train), max(int(agg.num_samples), 1))
+            return ModelUpdate(
+                prev, sorted(train), max(int(agg.num_samples), 1), noop_round=True
+            )
 
         correction = secagg.dropout_correction(
             agg.params, survivors, missing, seeds, weights, round_no

@@ -394,6 +394,12 @@ def test_repo_dials_reference_server():
     server.start()
 
     Settings.WIRE_FORMAT = "protobuf"
+    # the stub server never sends beats back, so on a loaded host the repo
+    # node would heartbeat-evict it mid-test (HEARTBEAT_TIMEOUT=1.5s under
+    # test settings) and the send asserts would flake — pin the timeout
+    # high for the duration; the autouse settings fixture restores it
+    saved_hb = Settings.HEARTBEAT_TIMEOUT
+    Settings.HEARTBEAT_TIMEOUT = 60.0
     n = Node(protocol=GrpcProtocol("127.0.0.1:0"))
     n.start()
     try:
@@ -423,5 +429,6 @@ def test_repo_dials_reference_server():
         assert list(w.contributors) == [n.addr] and w.weight == 9
         assert w.weights.startswith(b"P2TW")
     finally:
+        Settings.HEARTBEAT_TIMEOUT = saved_hb
         n.stop()
         server.stop(grace=0.2)

@@ -375,6 +375,66 @@ def test_secagg_unrecoverable_round_is_noop():
     # "c"'s masks never got disclosed ("b" said nothing): round is a no-op
     np.testing.assert_array_equal(np.asarray(out.params["w"]), 7.0)
     assert set(out.contributors) == {"a", "b", "c"}
+    # and the fallback is FLAGGED so GossipModelStage never diffuses the
+    # round-start globals as the round's authoritative aggregate (ADVICE r3)
+    assert out.noop_round
+
+
+def test_noop_round_skips_outward_diffusion():
+    """ADVICE r3 (low): a failed-recovery no-op round must not advertise
+    the round-start globals to behind neighbors as the round's aggregate —
+    GossipModelStage finishes the round without calling gossip_weights."""
+    from p2pfl_tpu.stages.learning_stages import GossipModelStage, RoundFinishedStage
+    from p2pfl_tpu.node_state import NodeState
+
+    Settings.SECURE_AGGREGATION = True
+    calls = {"gossip": 0, "broadcast": []}
+    params = {"w": np.full((2, 2), 7.0, np.float32)}
+
+    class _Agg:
+        def wait_and_get_aggregation(self, timeout=None):
+            return ModelUpdate(params, ["a", "b"], 2, noop_round=True)
+
+    class _Proto:
+        def broadcast(self, msg):
+            calls["broadcast"].append(msg)
+
+        def build_msg(self, cmd, args, round=0):  # noqa: A002
+            return (cmd, list(args), round)
+
+        def gossip_weights(self, *a, **k):
+            calls["gossip"] += 1
+
+        def get_neighbors(self, only_direct=False):
+            return {}
+
+    class _Learner:
+        def set_parameters(self, p):
+            calls["set"] = p
+
+    class _FakeNode:
+        addr = "a"
+
+        def __init__(self):
+            self.state = NodeState("a")
+            self.state.set_experiment("exp", 1)
+            self.state.train_set = ["a", "b"]
+            self.protocol = _Proto()
+            self.aggregator = _Agg()
+            self.learner = _Learner()
+
+        def learning_interrupted(self):
+            return False
+
+    node = _FakeNode()
+    # monkey-free: the aggregator already returns the flagged no-op update,
+    # and a 2-member train set makes _secagg_finalize pass it through
+    # untouched (len(train) <= 1 is false but covered == train here)
+    nxt = GossipModelStage.execute(node)
+    assert nxt is RoundFinishedStage
+    assert calls["gossip"] == 0  # NO outward diffusion of stale params
+    # the round still terminates for the overlay
+    assert any(m[0] == "models_ready" for m in calls["broadcast"])
 
 
 def test_secagg_need_answered_by_full_coverage_peer():
@@ -422,18 +482,30 @@ def test_secagg_need_answered_by_full_coverage_peer():
     assert len(sent) == 1 and sent[0][0] == "secagg_recover" and sent[0][1][0] == "d"
     expected = secagg.dh_pair_seed(priv, node.state.secagg_pubs["d"][0], "exp")
     assert int(sent[0][1][1], 16) == expected
-    cmd.execute("c", 0, "exp", "d")  # second request: already disclosed, no re-send
-    assert len(sent) == 1
+    # a DIFFERENT requester is RE-answered even though already disclosed
+    # (ADVICE r3 medium): requester c may have been a round behind when the
+    # first broadcast went out and dropped it (SecAggRecoverCommand round
+    # gate) — re-broadcasting the same seed is idempotent, receivers latch
+    # first-wins, and a global send-once latch would leave c burning its
+    # whole recovery timeout for nothing
+    cmd.execute("c", 0, "exp", "d")
+    assert len(sent) == 2 and sent[1][0] == "secagg_recover" and sent[1][1][0] == "d"
+    assert int(sent[1][1][1], 16) == expected  # the SAME seed, verbatim
+    # but the SAME requester replaying (fresh gossip ids) is latched —
+    # amplification stays bounded at one answer per member per round
+    cmd.execute("c", 0, "exp", "d")
+    cmd.execute("b", 0, "exp", "d")
+    assert len(sent) == 2
     cmd.execute("b", 0, "exp", "a", "b", "zz")  # self / requester / unknown: ignored
-    assert len(sent) == 1
+    assert len(sent) == 2
     # a request naming a LIVE member is refused (the requester's claim is
     # not evidence; only heartbeat eviction is)
     cmd.execute("b", 0, "exp", "c")
-    assert len(sent) == 1
+    assert len(sent) == 2
     # non-member requesters have no standing; wrong experiment is ignored
     cmd.execute("zz", 0, "exp", "d")
     cmd.execute("b", 0, "other_exp", "d")
-    assert len(sent) == 1
+    assert len(sent) == 2
 
     # 2-member train set never discloses
     sent.clear()
