@@ -195,9 +195,11 @@ class SecAggNeedCommand(Command):
             # leave it burning SECAGG_RECOVERY_TIMEOUT for nothing —
             # re-broadcasting the same seed is idempotent (receivers latch
             # first-wins). Keying by requester keeps amplification bounded:
-            # each legitimate member sends one secagg_need per round, and a
-            # replaying attacker must be a train-set member (standing check
-            # above), so the worst case is one answer per member per round.
+            # a replaying attacker must be a train-set member (standing
+            # check above), so the worst case is one broadcast per
+            # (accepted round — st.round-1 and st.round both qualify —
+            # × missing member × requesting member), fixed per experiment
+            # round; replays beyond that are absorbed by the latch.
             if (round, j, source) in st.secagg_disclosure_sent:
                 continue
             st.secagg_disclosure_sent.add((round, j, source))
