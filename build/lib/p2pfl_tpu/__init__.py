@@ -1,0 +1,37 @@
+"""p2pfl_tpu — a TPU-native decentralized federated learning framework.
+
+A brand-new implementation of the capabilities of the reference framework
+(Angel3245/p2pfl, see /root/reference): peer-to-peer federated learning with
+train-set election by voting, local training, gossip-based FedAvg aggregation,
+heartbeat membership, and pluggable transports — redesigned JAX-first:
+
+- model weights are ``jax.Array`` pytrees, aggregation is a jitted
+  ``tree_map`` (reference: python loop over state dicts,
+  ``p2pfl/learning/aggregators/fedavg.py:43-60``),
+- each logical node's trainer is a jit-compiled train step
+  (reference: PyTorch Lightning ``Trainer`` per round,
+  ``p2pfl/learning/pytorch/lightning_learner.py:180-198``),
+- a whole federation can run as ONE SPMD program over a
+  ``jax.sharding.Mesh`` (one node per chip / per mesh slot), with model
+  exchange as masked collectives over ICI instead of gRPC.
+
+The transport seam (``CommunicationProtocol``) is preserved, so in-memory
+simulation, gRPC real-network mode, and the mesh-collective mode are
+interchangeable per node — mirroring the reference seam at
+``p2pfl/communication/communication_protocol.py:27-190``.
+"""
+
+__version__ = "0.1.0"
+
+from p2pfl_tpu.settings import Settings
+
+__all__ = ["Node", "Settings", "__version__"]
+
+
+def __getattr__(name):
+    # lazy: importing the package must not pull the full comm stack
+    if name == "Node":
+        from p2pfl_tpu.node import Node
+
+        return Node
+    raise AttributeError(name)

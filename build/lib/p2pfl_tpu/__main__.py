@@ -1,0 +1,8 @@
+"""``python -m p2pfl_tpu`` entry point (reference ``p2pfl/__main__.py``)."""
+
+import sys
+
+from p2pfl_tpu.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,74 @@
+"""Command-line interface.
+
+Reference: Typer app with ``experiment list`` / ``experiment run``
+(``p2pfl/cli.py:65-203``). argparse here (typer isn't in this image);
+same surface: examples are discovered from ``p2pfl_tpu/examples/`` and run
+in-process with their own argv.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import pkgutil
+import sys
+
+
+def _discover() -> dict[str, str]:
+    """Example name → first docstring line."""
+    import p2pfl_tpu.examples as ex
+
+    out = {}
+    for info in pkgutil.iter_modules(ex.__path__):
+        mod = importlib.import_module(f"p2pfl_tpu.examples.{info.name}")
+        doc = (mod.__doc__ or "").strip().splitlines()
+        out[info.name] = doc[0] if doc else ""
+    return out
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="p2pfl_tpu", description="TPU-native federated learning")
+    sub = parser.add_subparsers(dest="command")
+
+    exp = sub.add_parser("experiment", help="list or run bundled experiments")
+    exp_sub = exp.add_subparsers(dest="action")
+    exp_sub.add_parser("list", help="list available experiments")
+    run = exp_sub.add_parser("run", help="run an experiment by name")
+    run.add_argument("name")
+    run.add_argument("extra", nargs=argparse.REMAINDER, help="arguments passed to the experiment")
+
+    sub.add_parser("bench", help="run the headline benchmark")
+    # remote-management verbs are stubs in the reference too (cli.py:71-95)
+    for stub in ("login", "remote", "launch"):
+        sub.add_parser(stub, help="(coming soon)")
+
+    args = parser.parse_args(argv)
+    if args.command in ("login", "remote", "launch"):
+        print(f"{args.command}: coming soon (stub — reference parity, cli.py:71-95)")
+        return 0
+    if args.command == "experiment":
+        if args.action == "list":
+            for name, doc in sorted(_discover().items()):
+                print(f"{name:20s} {doc}")
+            return 0
+        if args.action == "run":
+            examples = _discover()
+            if args.name not in examples:
+                print(f"unknown experiment {args.name!r}; try: {', '.join(sorted(examples))}")
+                return 1
+            mod = importlib.import_module(f"p2pfl_tpu.examples.{args.name}")
+            mod.main(args.extra)
+            return 0
+        exp.print_help()
+        return 1
+    if args.command == "bench":
+        import runpy
+
+        runpy.run_path("bench.py", run_name="__main__")
+        return 0
+    parser.print_help()
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

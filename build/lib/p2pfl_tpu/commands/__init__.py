@@ -1,0 +1,43 @@
+"""Wire-protocol verbs (SURVEY §2.3).
+
+Each command is a named handler registered into a transport's dispatch map;
+``execute(source, round, *args)`` for control messages, or
+``execute(source, round, update=ModelUpdate)`` for weight payloads. Same ten
+verbs as the reference's ``p2pfl/commands/``.
+"""
+
+from p2pfl_tpu.commands.command import Command
+from p2pfl_tpu.commands.control import (
+    MetricsCommand,
+    ModelInitializedCommand,
+    ModelsAggregatedCommand,
+    ModelsReadyCommand,
+    SecAggPubCommand,
+    SecAggNeedCommand,
+    SecAggRecoverCommand,
+    VoteTrainSetCommand,
+)
+from p2pfl_tpu.commands.heartbeat import HeartbeatCommand
+from p2pfl_tpu.commands.learning import (
+    AddModelCommand,
+    InitModelCommand,
+    StartLearningCommand,
+    StopLearningCommand,
+)
+
+__all__ = [
+    "Command",
+    "HeartbeatCommand",
+    "StartLearningCommand",
+    "StopLearningCommand",
+    "ModelInitializedCommand",
+    "VoteTrainSetCommand",
+    "ModelsAggregatedCommand",
+    "ModelsReadyCommand",
+    "MetricsCommand",
+    "SecAggPubCommand",
+    "SecAggNeedCommand",
+    "SecAggRecoverCommand",
+    "InitModelCommand",
+    "AddModelCommand",
+]

@@ -1,0 +1,295 @@
+"""Small control-plane commands: votes, round status, metrics.
+
+Reference files: ``vote_train_set_command.py``, ``models_agregated_command.py``,
+``models_ready_command.py``, ``metrics_command.py``, ``model_initialized_command.py``.
+All mutate :class:`~p2pfl_tpu.node_state.NodeState` under its locks/events.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+from p2pfl_tpu.commands.command import Command
+from p2pfl_tpu.management.logger import logger
+
+if TYPE_CHECKING:
+    from p2pfl_tpu.node_state import NodeState
+
+
+class ModelInitializedCommand(Command):
+    """Peer announced its model is initialized → ``nei_status[source] = -1``."""
+
+    def __init__(self, state: "NodeState") -> None:
+        self._state = state
+
+    @staticmethod
+    def get_name() -> str:
+        return "model_initialized"
+
+    def execute(self, source: str, round: int, *args, **kwargs) -> None:  # noqa: A002
+        self._state.nei_status[source] = -1
+
+
+class SecAggPubCommand(Command):
+    """Peer announced its DH public key + sample count for secure aggregation.
+
+    Args: ``[pub_hex, num_samples]``; flooded over the message gossip at
+    experiment start (``learning/secagg.py`` — the sample counts set the
+    pairwise mask scales). No round check — keys are per-experiment.
+    """
+
+    def __init__(self, state: "NodeState") -> None:
+        self._state = state
+
+    @staticmethod
+    def get_name() -> str:
+        return "secagg_pub"
+
+    def execute(self, source: str, round: int, *args, **kwargs) -> None:  # noqa: A002
+        if len(args) < 2:
+            logger.error(self._state.addr, f"Malformed secagg_pub from {source}: need key + samples")
+            return
+        try:
+            pub = int(args[0], 16)
+            samples = int(args[1])
+        except ValueError:
+            logger.error(self._state.addr, f"Malformed secagg_pub from {source}: bad values")
+            return
+        from p2pfl_tpu.learning.secagg import valid_public_key
+
+        if not valid_public_key(pub):
+            # 0/1/p-1 make the pair's shared secret trivially computable —
+            # an active attacker spoofing this message could strip the
+            # victim's masks; never store a degenerate key
+            logger.error(self._state.addr, f"Degenerate DH key from {source} — rejected")
+            return
+        if samples <= 0:
+            logger.error(self._state.addr, f"Non-positive sample count from {source} — rejected")
+            return
+        held = self._state.secagg_pubs.get(source)
+        if held is not None:
+            # latch the FIRST key per (source, experiment): the gossip plane
+            # is unauthenticated, so a later re-broadcast with a spoofed
+            # source must not replace the key a victim's peers already use
+            # (an attacker-controlled key would let them derive all of the
+            # victim's pair seeds and strip its masks). Identical
+            # re-deliveries are normal gossip redundancy.
+            if held != (pub, samples):
+                logger.error(
+                    self._state.addr,
+                    f"secagg_pub from {source} tried to replace an already-"
+                    "latched key — rejected (possible spoofing)",
+                )
+            return
+        self._state.secagg_pubs[source] = (pub, samples)
+
+
+class SecAggRecoverCommand(Command):
+    """A survivor re-disclosed its pair seed for a dropped train-set member.
+
+    Args: ``[dropped_addr, seed_hex]``; the message's round field pins the
+    round being recovered. Stored under (round, dropped, source) — the
+    recovery routine in ``stages/learning_stages.py`` waits until every
+    survivor's seed for every missing member is present, then subtracts
+    the uncancelled mask sum (``learning/secagg.py:dropout_correction``).
+    """
+
+    def __init__(self, state: "NodeState") -> None:
+        self._state = state
+
+    @staticmethod
+    def get_name() -> str:
+        return "secagg_recover"
+
+    def execute(self, source: str, round: int, *args, **kwargs) -> None:  # noqa: A002
+        st = self._state
+        if len(args) < 2:
+            logger.error(st.addr, f"Malformed secagg_recover from {source}")
+            return
+        try:
+            seed = int(args[1], 16)
+        except ValueError:
+            logger.error(st.addr, f"Malformed secagg_recover seed from {source}")
+            return
+        if not 0 <= seed < (1 << 256):
+            # an out-of-range stored seed would make _leaf_mask's
+            # to_bytes(32) raise mid-recovery and kill the experiment on
+            # every survivor — one malformed message must not do that
+            logger.error(st.addr, f"Out-of-range secagg_recover seed from {source} — rejected")
+            return
+        if st.round is not None and round != st.round:
+            logger.debug(st.addr, f"secagg_recover from {source} for round {round} (at {st.round}) — ignored")
+            return
+        key = (round, args[0], source)
+        # first disclosure wins, same latch rationale as secagg_pub
+        st.secagg_disclosed.setdefault(key, seed)
+
+
+class SecAggNeedCommand(Command):
+    """A recovering peer announced which members' masks it cannot cancel.
+
+    Args: ``[experiment_name, missing...]``. A train-set member answers by
+    re-disclosing its pair seed for the named members — INCLUDING when its
+    own coverage reached full (early finalizers would otherwise never
+    disclose, leaving a peer with a smaller coverage view to burn its
+    recovery timeout for nothing) and INCLUDING when it already disclosed
+    for an earlier request (a lagging requester drops disclosures for
+    rounds it has not reached yet; re-broadcasts are idempotent because
+    receivers latch first-wins). Pair seeds are per-experiment, so
+    answering for the previous round is safe; the experiment name in the
+    request guards against latching a wrong-experiment seed.
+
+    A request is a claim, not proof — the responder demands its OWN
+    evidence before disclosing anything: it only answers for members that
+    are no longer live on the overlay (heartbeat-evicted; a genuinely
+    dropped node disappears within HEARTBEAT_TIMEOUT, long before any
+    AGGREGATION_TIMEOUT fires). A forged secagg_need naming a live member
+    is refused — the requester then no-ops its round (availability
+    sacrificed, the live member's masks kept). Requests must also come
+    from a train-set member. Under VOTE_EVERY_ROUND a re-voted train set
+    can make cross-round requests unanswerable (``j not in train``) — the
+    requester degrades to a no-op round.
+    """
+
+    def __init__(self, node) -> None:  # "Node"; untyped to avoid the import cycle
+        self._node = node
+
+    @staticmethod
+    def get_name() -> str:
+        return "secagg_need"
+
+    def execute(self, source: str, round: int, *args, **kwargs) -> None:  # noqa: A002
+        from p2pfl_tpu.learning import secagg
+
+        node = self._node
+        st = node.state
+        if st.secagg_priv is None or len(args) < 2 or st.round is None:
+            return
+        if round not in (st.round - 1, st.round):
+            return
+        exp = st.experiment_name or ""
+        if args[0] != exp:
+            logger.debug(st.addr, f"secagg_need from {source} for experiment {args[0]!r} — ignored")
+            return
+        train = set(st.train_set)
+        if node.addr not in train or source not in train or len(train) <= 2:
+            # non-members have no standing to request; in a 2-member train
+            # set the only pair seed IS the full mask of the other member's
+            # update — never disclose it
+            return
+        live = set(node.protocol.get_neighbors(only_direct=False))
+        for j in args[1:]:
+            if j == node.addr or j == source or j not in train or j not in st.secagg_pubs:
+                continue
+            if j in live:
+                logger.warning(
+                    st.addr,
+                    f"secagg_need from {source} names {j}, which is still live "
+                    "here — refusing to disclose its pair seed",
+                )
+                continue
+            # Latch per (round, j, REQUESTER), not per (round, j): a lagging
+            # requester may have dropped an earlier broadcast triggered by a
+            # different peer's request (SecAggRecoverCommand ignores frames
+            # whose round != st.round), so a global send-once latch would
+            # leave it burning SECAGG_RECOVERY_TIMEOUT for nothing —
+            # re-broadcasting the same seed is idempotent (receivers latch
+            # first-wins). Keying by requester keeps amplification bounded:
+            # a replaying attacker must be a train-set member (standing
+            # check above), so the worst case is one broadcast per
+            # (accepted round — st.round-1 and st.round both qualify —
+            # × missing member × requesting member), fixed per experiment
+            # round; replays beyond that are absorbed by the latch.
+            if (round, j, source) in st.secagg_disclosure_sent:
+                continue
+            st.secagg_disclosure_sent.add((round, j, source))
+            # the 2-tuple key still lets the proactive disclosure path
+            # (learning_stages._secagg_finalize) skip its redundant send
+            st.secagg_disclosure_sent.add((round, j))
+            seed = secagg.dh_pair_seed(st.secagg_priv, st.secagg_pubs[j][0], exp)
+            node.protocol.broadcast(
+                node.protocol.build_msg("secagg_recover", [j, f"{seed:x}"], round=round)
+            )
+
+
+class VoteTrainSetCommand(Command):
+    """Train-set vote: flat ``[name, weight, name, weight, ...]`` pairs.
+
+    Accepted for the current round or the next one (peers may be one round
+    ahead), mirroring the reference's tolerance.
+    """
+
+    def __init__(self, state: "NodeState") -> None:
+        self._state = state
+
+    @staticmethod
+    def get_name() -> str:
+        return "vote_train_set"
+
+    def execute(self, source: str, round: int, *args, **kwargs) -> None:  # noqa: A002
+        st = self._state
+        if st.round is not None and round not in (st.round, st.round + 1):
+            logger.debug(st.addr, f"Vote from {source} for stale round {round} (at {st.round}) — ignored")
+            return
+        if len(args) % 2 != 0:
+            logger.error(st.addr, f"Malformed vote from {source}: odd arg count")
+            return
+        votes = {args[i]: int(args[i + 1]) for i in range(0, len(args), 2)}
+        with st.train_set_votes_lock:
+            st.train_set_votes[source] = votes
+        st.votes_ready_event.set()
+
+
+class ModelsAggregatedCommand(Command):
+    """Peer reports which contributors it has folded in this round."""
+
+    def __init__(self, state: "NodeState") -> None:
+        self._state = state
+
+    @staticmethod
+    def get_name() -> str:
+        return "models_aggregated"
+
+    def execute(self, source: str, round: int, *args, **kwargs) -> None:  # noqa: A002
+        st = self._state
+        if st.round is not None and round == st.round:
+            st.models_aggregated[source] = list(args)
+
+
+class ModelsReadyCommand(Command):
+    """Peer finished a round: ``nei_status[source] = round`` (round-1 tolerated)."""
+
+    def __init__(self, state: "NodeState") -> None:
+        self._state = state
+
+    @staticmethod
+    def get_name() -> str:
+        return "models_ready"
+
+    def execute(self, source: str, round: int, *args, **kwargs) -> None:  # noqa: A002
+        st = self._state
+        if st.round is not None and round in (st.round - 1, st.round):
+            st.nei_status[source] = round
+        else:
+            logger.debug(st.addr, f"models_ready from {source} for round {round} (at {st.round}) — ignored")
+
+
+class MetricsCommand(Command):
+    """Peer evaluation metrics → global metric store, keyed by the peer."""
+
+    def __init__(self, state: "NodeState") -> None:
+        self._state = state
+
+    @staticmethod
+    def get_name() -> str:
+        return "metrics"
+
+    def execute(self, source: str, round: int, *args, **kwargs) -> None:  # noqa: A002
+        for i in range(0, len(args) - 1, 2):
+            logger.log_metric(
+                source,
+                args[i],
+                float(args[i + 1]),
+                round=round,
+                experiment=self._state.experiment_name,
+            )

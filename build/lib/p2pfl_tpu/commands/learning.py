@@ -1,0 +1,158 @@
+"""Learning-lifecycle commands: start/stop, init weights, model ingestion.
+
+Reference files: ``start_learning_command.py``, ``stop_learning_command.py``,
+``init_model_command.py``, ``add_model_command.py``. These are the only
+commands that touch the node facade (thread spawn / teardown) or carry
+weight payloads.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+from p2pfl_tpu.commands.command import Command
+from p2pfl_tpu.exceptions import AnchorMismatchError, DecodingParamsError, ModelNotMatchingError
+from p2pfl_tpu.learning.weights import ModelUpdate
+from p2pfl_tpu.management.logger import logger
+
+if TYPE_CHECKING:
+    from p2pfl_tpu.node import Node
+
+
+class StartLearningCommand(Command):
+    """Spawn the learning thread with (rounds, epochs) (reference :134-155)."""
+
+    def __init__(self, node: "Node") -> None:
+        self._node = node
+
+    @staticmethod
+    def get_name() -> str:
+        return "start_learning"
+
+    def execute(self, source: str, round: int, *args, **kwargs) -> None:  # noqa: A002
+        rounds = int(args[0]) if args else 1
+        epochs = int(args[1]) if len(args) > 1 else 1
+        self._node._start_learning_thread(rounds, epochs)
+
+
+class StopLearningCommand(Command):
+    """Interrupt the learner, clear aggregator + state, release latches."""
+
+    def __init__(self, node: "Node") -> None:
+        self._node = node
+
+    @staticmethod
+    def get_name() -> str:
+        return "stop_learning"
+
+    def execute(self, source: str, round: int, *args, **kwargs) -> None:  # noqa: A002
+        self._node._stop_learning()
+
+
+class InitModelCommand(Command):
+    """Initial weights payload: store → signal → re-announce.
+
+    The update is stashed on the node (``pending_init_update``) and applied by
+    the stage after its latch fires, which removes the reference's race
+    between learner construction and early weight arrival
+    (``init_model_command.py:30-117``). Malformed payloads stop the node, as
+    in the reference (:106-117).
+    """
+
+    def __init__(self, node: "Node") -> None:
+        self._node = node
+
+    @staticmethod
+    def get_name() -> str:
+        return "init_model"
+
+    def execute(self, source: str, round: int, *args, update: ModelUpdate = None, **kwargs) -> None:  # noqa: A002
+        node = self._node
+        state = node.state
+        if state.model_initialized_event.is_set():
+            logger.debug(state.addr, f"init_model from {source} ignored — already initialized")
+            return
+        try:
+            if update.params is None:
+                update = node.learner.materialize(update)
+        except (DecodingParamsError, ModelNotMatchingError) as exc:
+            logger.error(state.addr, f"init_model decode failed: {exc} — stopping node")
+            node.stop_async()
+            return
+        node.pending_init_update = update
+        state.model_initialized_event.set()
+        node.protocol.broadcast(node.protocol.build_msg(ModelInitializedName))
+
+
+class AddModelCommand(Command):
+    """Model/partial-aggregation ingestion → aggregator (reference :26-104)."""
+
+    def __init__(self, node: "Node") -> None:
+        self._node = node
+
+    @staticmethod
+    def get_name() -> str:
+        return "add_model"
+
+    def execute(self, source: str, round: int, *args, update: ModelUpdate = None, **kwargs) -> None:  # noqa: A002
+        node = self._node
+        state = node.state
+        if not state.model_initialized_event.is_set():
+            logger.debug(state.addr, f"add_model from {source} before init — ignored")
+            return
+        if state.round is not None and round < state.round:
+            # stale payload from a peer still finishing an older round —
+            # most often the previous round's aggregate diffused to a node
+            # whose models_ready hadn't reached the sender yet. Because the
+            # train set is reused across rounds (round-0 vote quirk), its
+            # contributor set matches OUR window exactly and the aggregator
+            # would accept it as this round's full aggregate, silently
+            # discarding the round's training. The reference shares this
+            # race (its add_model has no round check either); gating here
+            # is a documented divergence that closes it.
+            logger.debug(
+                state.addr,
+                f"add_model from {source} for stale round {round} (at {state.round}) — ignored",
+            )
+            return
+        if state.round is not None and round > state.round:
+            # future-round payload from a peer that finished ahead of us:
+            # accept only a FULL-coverage aggregate (the catch-up/liveness
+            # case — the behind node adopts the consensus and moves on). A
+            # future-round individual or partial contribution must not fold
+            # into THIS round's window: the train set is reused across
+            # rounds, so the aggregator would accept it as a disjoint
+            # round-r contributor and mix two rounds' models. Under
+            # VOTE_EVERY_ROUND a future aggregate from a re-voted DIFFERENT
+            # train set is rejected here too — no loss: the aggregator's
+            # own contributor checks (waiting mode requires an exact
+            # train-set match) would reject it anyway, and the behind node
+            # recovers via its normal timeout path.
+            if not state.train_set or set(update.contributors) != set(state.train_set):
+                logger.debug(
+                    state.addr,
+                    f"add_model from {source} for future round {round} (at "
+                    f"{state.round}) is not a full aggregate — ignored",
+                )
+                return
+        try:
+            if update.params is None:
+                update = node.learner.materialize(update)
+            covered = node.aggregator.add_model(update)
+        except AnchorMismatchError as exc:
+            # a delta-coded payload against an anchor we don't hold (we are
+            # a round behind/ahead of the sender): skip it and wait for one
+            # we can reconstruct — NOT fatal, unlike a corrupt payload
+            logger.info(state.addr, f"add_model from {source} skipped: {exc}")
+            return
+        except (DecodingParamsError, ModelNotMatchingError) as exc:
+            logger.error(state.addr, f"add_model decode failed: {exc} — stopping node")
+            node.stop_async()
+            return
+        if covered:
+            node.protocol.broadcast(
+                node.protocol.build_msg("models_aggregated", covered, round=state.round or 0)
+            )
+
+
+ModelInitializedName = "model_initialized"

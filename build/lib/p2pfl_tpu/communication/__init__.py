@@ -1,0 +1,13 @@
+"""Communication layer: protocol seam, membership, gossip, transports.
+
+Mirrors the layering of the reference's ``p2pfl/communication/`` (SURVEY §2.4):
+a transport-agnostic :class:`~p2pfl_tpu.communication.protocol.CommunicationProtocol`
+seam with interchangeable stacks — in-memory (simulation), TCP/gRPC (real
+network), and the TPU-native mesh-collective runtime in
+``p2pfl_tpu.parallel`` that replaces per-message transport entirely.
+"""
+
+from p2pfl_tpu.communication.message import Message, WeightsEnvelope
+from p2pfl_tpu.communication.protocol import CommunicationProtocol
+
+__all__ = ["CommunicationProtocol", "Message", "WeightsEnvelope"]

@@ -1,0 +1,140 @@
+"""Two-mode gossiper: async message plane + synchronous model-gossip loop.
+
+Reference semantics (``p2pfl/communication/gossiper.py:31-243``):
+
+(a) *Message plane* — a daemon thread drains a queue of
+    ``(message, pending_neighbors)`` pairs, at most
+    ``GOSSIP_MESSAGES_PER_PERIOD`` sends per ``GOSSIP_PERIOD``; a bounded
+    ring of seen message ids provides network-wide dedup.
+
+(b) *Model plane* — ``gossip_weights`` runs a synchronous tick loop on the
+    calling (stage) thread: each tick picks ``GOSSIP_MODELS_PER_ROUND``
+    random candidates, builds a per-candidate payload, sends it, and exits
+    when there are no candidates, the early-stop predicate fires, or the
+    observed status is unchanged for ``GOSSIP_EXIT_ON_X_EQUAL_ROUNDS`` ticks
+    (convergence detector, reference 209-226).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Callable, Optional
+
+from p2pfl_tpu.communication.message import Message
+from p2pfl_tpu.management.logger import logger
+from p2pfl_tpu.settings import Settings
+
+
+class Gossiper:
+    def __init__(self, self_addr: str, send_fn: Callable[..., bool]) -> None:
+        self.self_addr = self_addr
+        self._send = send_fn  # (nei, env, create_connection=False) -> bool
+        self._queue: deque[tuple[Message, list[str]]] = deque()
+        self._queue_cv = threading.Condition()
+        self._processed: OrderedDict[str, None] = OrderedDict()
+        self._processed_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- lifecycle ----
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name=f"gossiper-{self.self_addr}", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._queue_cv:
+            self._queue_cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    # ---- dedup ring ----
+
+    def check_and_set_processed(self, msg_id: str) -> bool:
+        """True if unseen (and marks it seen); False for duplicates."""
+        with self._processed_lock:
+            if msg_id in self._processed:
+                return False
+            self._processed[msg_id] = None
+            while len(self._processed) > Settings.AMOUNT_LAST_MESSAGES_SAVED:
+                self._processed.popitem(last=False)
+            return True
+
+    # ---- message plane ----
+
+    def add_message(self, msg: Message, pending_neis: list[str]) -> None:
+        if not pending_neis:
+            return
+        with self._queue_cv:
+            self._queue.append((msg, list(pending_neis)))
+            self._queue_cv.notify()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            with self._queue_cv:
+                if not self._queue:
+                    self._queue_cv.wait(timeout=Settings.GOSSIP_PERIOD)
+                    continue
+                batch: list[tuple[Message, str]] = []
+                budget = Settings.GOSSIP_MESSAGES_PER_PERIOD
+                while self._queue and budget > 0:
+                    msg, neis = self._queue.popleft()
+                    take, rest = neis[:budget], neis[budget:]
+                    batch.extend((msg, n) for n in take)
+                    budget -= len(take)
+                    if rest:
+                        self._queue.appendleft((msg, rest))
+                        break
+            for msg, nei in batch:
+                if self._stop.is_set():
+                    return
+                self._send(nei, msg)
+            time.sleep(Settings.GOSSIP_PERIOD)
+
+    # ---- model plane ----
+
+    def gossip_weights(
+        self,
+        early_stopping_fn: Callable[[], bool],
+        get_candidates_fn: Callable[[], list[str]],
+        status_fn: Callable[[], object],
+        model_fn: Callable[[str], Optional[object]],
+        period: Optional[float] = None,
+        create_connection: bool = False,
+    ) -> None:
+        from p2pfl_tpu.communication.protocol import random_subset
+
+        period = Settings.GOSSIP_MODELS_PERIOD if period is None else period
+        last_status: object = None
+        equal_ticks = 0
+        while True:
+            if early_stopping_fn() or self._stop.is_set():
+                return
+            candidates = get_candidates_fn()
+            if not candidates:
+                return
+            status = status_fn()
+            if status == last_status:
+                equal_ticks += 1
+                if equal_ticks >= Settings.GOSSIP_EXIT_ON_X_EQUAL_ROUNDS:
+                    logger.debug(
+                        self.self_addr,
+                        f"Gossip stalled for {equal_ticks} ticks — stopping (status={status})",
+                    )
+                    return
+            else:
+                equal_ticks = 0
+                last_status = status
+            for nei in random_subset(candidates, Settings.GOSSIP_MODELS_PER_ROUND):
+                payload = model_fn(nei)
+                if payload is None:
+                    continue
+                self._send(nei, payload, create_connection=create_connection)
+            time.sleep(period)

@@ -1,0 +1,58 @@
+"""Heartbeat membership / failure detection.
+
+Reference semantics (``p2pfl/communication/heartbeater.py:33-111``): a daemon
+thread broadcasts a ``beat`` control message every ``HEARTBEAT_PERIOD``
+seconds; every second tick it evicts neighbors whose last beat is older than
+``HEARTBEAT_TIMEOUT``. Because ``beat`` TTL-floods the overlay, every node
+discovers every other node as a *non-direct* neighbor within roughly one
+heartbeat period (reference ``grpc_neighbors.py:34-55``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import TYPE_CHECKING
+
+from p2pfl_tpu.settings import Settings
+
+if TYPE_CHECKING:
+    from p2pfl_tpu.communication.protocol import CommunicationProtocol
+
+BEAT_CMD = "beat"
+
+
+class Heartbeater:
+    def __init__(self, self_addr: str, protocol: "CommunicationProtocol") -> None:
+        self.self_addr = self_addr
+        self._protocol = protocol
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name=f"heartbeater-{self.self_addr}", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    def beat(self, source: str, t: float) -> None:
+        """Record an incoming beat (called by the ``beat`` command handler)."""
+        self._protocol.neighbors.heartbeat(source, t=None)
+
+    def _run(self) -> None:
+        tick = 0
+        while not self._stop.is_set():
+            msg = self._protocol.build_msg(BEAT_CMD, [str(time.time())])
+            self._protocol.broadcast(msg)
+            tick += 1
+            if tick % 2 == 0:
+                self._protocol.neighbors.evict_stale(Settings.HEARTBEAT_TIMEOUT)
+            if self._stop.wait(timeout=Settings.HEARTBEAT_PERIOD):
+                return

@@ -1,0 +1,81 @@
+"""Transport-agnostic message model.
+
+The reference defines these shapes in protobuf
+(``p2pfl/communication/grpc/proto/node.proto:26-42``): a small control
+``Message{source, ttl, hash, cmd, args[], round}`` that TTL-floods the
+overlay, and a ``Weights{source, round, weights, contributors[], weight,
+cmd}`` payload that moves point-to-point. Here they are plain dataclasses
+that every transport shares; the gRPC transport maps them to/from protobuf,
+the in-memory transport passes them by reference (weights stay
+device-resident as a :class:`~p2pfl_tpu.learning.weights.ModelUpdate`).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import time
+from dataclasses import dataclass, field
+from typing import Optional, Union
+
+from p2pfl_tpu.learning.weights import ModelUpdate
+
+_seq = itertools.count()
+
+
+def _message_id(source: str, cmd: str, args: tuple[str, ...]) -> str:
+    """Unique-enough id for gossip dedup.
+
+    The reference hashes cmd+args+now+random (``grpc_client.py:72``); a
+    monotonic per-process sequence number removes the (tiny) collision
+    probability while staying cheap.
+    """
+    raw = f"{source}|{cmd}|{'|'.join(args)}|{time.monotonic_ns()}|{next(_seq)}"
+    return hashlib.blake2s(raw.encode(), digest_size=16).hexdigest()
+
+
+@dataclass
+class Message:
+    """A small control-plane message (vote, beat, round status, ...)."""
+
+    source: str
+    cmd: str
+    args: tuple[str, ...] = ()
+    round: int = -1
+    ttl: int = 1
+    msg_id: str = ""
+
+    def __post_init__(self) -> None:
+        self.args = tuple(str(a) for a in self.args)
+        if not self.msg_id:
+            self.msg_id = _message_id(self.source, self.cmd, self.args)
+
+
+@dataclass
+class WeightsEnvelope:
+    """A model payload moving between nodes (data plane).
+
+    ``update`` may hold a live pytree (in-process transports — zero copy,
+    device-resident) or only ``update.encoded`` bytes (network transports).
+    """
+
+    source: str
+    round: int
+    cmd: str  # "init_model" | "add_model"
+    update: ModelUpdate
+    msg_id: str = field(default="")
+
+    def __post_init__(self) -> None:
+        if not self.msg_id:
+            self.msg_id = _message_id(self.source, self.cmd, ())
+
+
+Envelope = Union[Message, WeightsEnvelope]
+
+
+@dataclass
+class CommandResult:
+    """Outcome of dispatching a message to a command handler."""
+
+    ok: bool = True
+    error: Optional[str] = None

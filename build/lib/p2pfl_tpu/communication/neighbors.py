@@ -1,0 +1,122 @@
+"""Thread-safe neighbor registry.
+
+Semantics from the reference's ``p2pfl/communication/neighbors.py:27-170``:
+a map addr → :class:`NeighborInfo`; *direct* neighbors were connected
+explicitly (transport connection + handshake), *non-direct* neighbors are
+learned from TTL-flooded heartbeats and can only be reached by creating an
+ad-hoc connection.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from p2pfl_tpu.management.logger import logger
+
+
+@dataclass
+class NeighborInfo:
+    direct: bool
+    conn: Any = None  # transport-specific handle (channel/stub/server ref)
+    last_beat: float = field(default_factory=time.monotonic)
+
+
+class Neighbors:
+    """Base neighbors manager. Transports override the connect/disconnect hooks."""
+
+    def __init__(self, self_addr: str) -> None:
+        self.self_addr = self_addr
+        self._lock = threading.Lock()
+        self._neis: dict[str, NeighborInfo] = {}
+
+    # ---- transport hooks ----
+
+    def _connect(self, addr: str, handshake: bool) -> Optional[Any]:
+        """Open a transport connection; return the handle or raise. Base: none."""
+        return None
+
+    def _disconnect(self, addr: str, conn: Any, notify: bool) -> None:
+        """Close a transport connection (best-effort)."""
+
+    # ---- registry ----
+
+    def add(self, addr: str, non_direct: bool = False, handshake: bool = True) -> bool:
+        """Register a neighbor. Direct adds open a connection + handshake.
+
+        Re-adding an already-direct neighbor is a no-op; a heartbeat from a
+        direct neighbor must NOT demote it to non-direct
+        (reference ``neighbors.py:73-110``).
+        """
+        if addr == self.self_addr:
+            return False
+        with self._lock:
+            existing = self._neis.get(addr)
+            if existing is not None:
+                if non_direct:
+                    existing.last_beat = time.monotonic()
+                    return True
+                if existing.direct:
+                    logger.debug(self.self_addr, f"Already connected to {addr}")
+                    return False
+                # upgrade non-direct → direct below (outside dict mutation)
+        if non_direct:
+            with self._lock:
+                if addr not in self._neis:
+                    self._neis[addr] = NeighborInfo(direct=False)
+            return True
+        try:
+            conn = self._connect(addr, handshake)
+        except Exception as exc:  # noqa: BLE001 — connection errors are expected
+            logger.info(self.self_addr, f"Cannot connect to {addr}: {exc}")
+            return False
+        with self._lock:
+            self._neis[addr] = NeighborInfo(direct=True, conn=conn)
+        return True
+
+    def remove(self, addr: str, disconnect_msg: bool = False) -> None:
+        with self._lock:
+            info = self._neis.pop(addr, None)
+        if info is not None and info.direct:
+            try:
+                self._disconnect(addr, info.conn, notify=disconnect_msg)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def heartbeat(self, addr: str, t: Optional[float] = None) -> None:
+        """Record a beat; unknown senders become non-direct neighbors."""
+        with self._lock:
+            info = self._neis.get(addr)
+            if info is None:
+                if addr != self.self_addr:
+                    self._neis[addr] = NeighborInfo(direct=False)
+                return
+            info.last_beat = time.monotonic() if t is None else t
+
+    def evict_stale(self, timeout: float) -> list[str]:
+        """Drop neighbors whose last beat is older than ``timeout`` seconds."""
+        now = time.monotonic()
+        with self._lock:
+            stale = [a for a, i in self._neis.items() if now - i.last_beat > timeout]
+        for addr in stale:
+            logger.info(self.self_addr, f"Heartbeat timeout — evicting {addr}")
+            self.remove(addr)
+        return stale
+
+    def get(self, addr: str) -> Optional[NeighborInfo]:
+        with self._lock:
+            return self._neis.get(addr)
+
+    def get_all(self, only_direct: bool = False) -> dict[str, NeighborInfo]:
+        with self._lock:
+            if only_direct:
+                return {a: i for a, i in self._neis.items() if i.direct}
+            return dict(self._neis)
+
+    def clear(self, disconnect: bool = False) -> None:
+        for addr in list(self.get_all(only_direct=True)):
+            self.remove(addr, disconnect_msg=disconnect)
+        with self._lock:
+            self._neis.clear()

@@ -1,0 +1,4 @@
+"""Wire schemas: the documented envelope format (node.proto) and the
+reference-compatible protobuf interop schema (interop.proto + generated
+interop_pb2). See communication/proto_wire.py for scope and the
+no-pickle divergence."""

@@ -1,0 +1,189 @@
+"""The transport seam: ``CommunicationProtocol``.
+
+Same 12-operation surface as the reference ABC
+(``p2pfl/communication/communication_protocol.py:27-190``), so transports are
+interchangeable per node. Unlike the reference — where the gRPC and memory
+protocol classes duplicate their wiring byte-for-byte
+(``memory_communication_protocol.py:47-66``) — the shared wiring (gossiper,
+heartbeater, command registry, dispatch with TTL re-gossip and dedup) lives
+here once, and concrete transports only provide a server, a client and a
+neighbors manager.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from abc import ABC, abstractmethod
+from typing import Callable, Optional
+
+from p2pfl_tpu.communication.gossiper import Gossiper
+from p2pfl_tpu.communication.heartbeater import Heartbeater
+from p2pfl_tpu.communication.message import CommandResult, Message, WeightsEnvelope
+from p2pfl_tpu.communication.neighbors import Neighbors
+from p2pfl_tpu.learning.weights import ModelUpdate
+from p2pfl_tpu.management.logger import logger
+
+
+class CommunicationProtocol(ABC):
+    """Base for all transports. Owns gossip, heartbeat, membership, dispatch."""
+
+    def __init__(self, address: str) -> None:
+        self._address = address
+        self._commands: dict[str, "Command"] = {}  # noqa: F821 — commands registered by Node
+        self._terminated = threading.Event()
+        self.neighbors: Neighbors = self._make_neighbors()
+        self.gossiper = Gossiper(address, send_fn=self._send_to_neighbor)
+        self.heartbeater = Heartbeater(address, self)
+
+    # ---- transport-specific pieces ----
+
+    @abstractmethod
+    def _make_neighbors(self) -> Neighbors:
+        ...
+
+    @abstractmethod
+    def _server_start(self) -> None:
+        ...
+
+    @abstractmethod
+    def _server_stop(self) -> None:
+        ...
+
+    @abstractmethod
+    def _send_to_neighbor(self, nei: str, env, create_connection: bool = False) -> bool:
+        """Deliver one envelope to one peer. Returns False on failure."""
+
+    # ---- lifecycle ----
+
+    def start(self) -> None:
+        self._terminated.clear()
+        self._server_start()
+        self.heartbeater.start()
+        self.gossiper.start()
+
+    def stop(self) -> None:
+        self.heartbeater.stop()
+        self.gossiper.stop()
+        self._server_stop()
+        self.neighbors.clear(disconnect=True)
+        self._terminated.set()
+
+    def wait_for_termination(self) -> None:
+        self._terminated.wait()
+
+    # ---- command registry ----
+
+    def add_command(self, cmd) -> None:
+        self._commands[cmd.get_name()] = cmd
+
+    # ---- message construction ----
+
+    def build_msg(self, cmd: str, args: Optional[list[str]] = None, round: int = -1) -> Message:
+        from p2pfl_tpu.settings import Settings
+
+        return Message(self._address, cmd, tuple(args or ()), round, ttl=Settings.TTL)
+
+    def build_weights(
+        self, cmd: str, round: int, update: ModelUpdate
+    ) -> WeightsEnvelope:
+        return WeightsEnvelope(self._address, round, cmd, update)
+
+    # ---- sending ----
+
+    def send(self, nei: str, env, create_connection: bool = False) -> bool:
+        ok = self._send_to_neighbor(nei, env, create_connection=create_connection)
+        if not ok and not create_connection:
+            # the reference evicts a neighbor on any send failure
+            # (grpc_client.py:173-179); keeps membership honest
+            logger.debug(self._address, f"Send to {nei} failed — removing neighbor")
+            self.neighbors.remove(nei)
+        return ok
+
+    def broadcast(self, env, exclude: tuple[str, ...] = ()) -> None:
+        for nei in self.neighbors.get_all(only_direct=True):
+            if nei not in exclude:
+                self.send(nei, env)
+
+    # ---- membership ----
+
+    def connect(self, addr: str, non_direct: bool = False) -> bool:
+        return self.neighbors.add(addr, non_direct=non_direct)
+
+    def disconnect(self, addr: str, disconnect_msg: bool = True) -> None:
+        self.neighbors.remove(addr, disconnect_msg=disconnect_msg)
+
+    def get_neighbors(self, only_direct: bool = False) -> dict:
+        return self.neighbors.get_all(only_direct)
+
+    def get_address(self) -> str:
+        return self._address
+
+    # ---- model-plane gossip (synchronous loop used by stages) ----
+
+    def gossip_weights(
+        self,
+        early_stopping_fn: Callable[[], bool],
+        get_candidates_fn: Callable[[], list[str]],
+        status_fn: Callable[[], object],
+        model_fn: Callable[[str], Optional[tuple]],
+        period: Optional[float] = None,
+        create_connection: bool = False,
+    ) -> None:
+        self.gossiper.gossip_weights(
+            early_stopping_fn,
+            get_candidates_fn,
+            status_fn,
+            model_fn,
+            period=period,
+            create_connection=create_connection,
+        )
+
+    # ---- receive path (called by transport servers) ----
+
+    def handle_message(self, msg: Message) -> CommandResult:
+        """Control-plane receive: dedup → TTL re-gossip → dispatch.
+
+        Mirrors ``grpc_server.py:130-166``.
+        """
+        if not self.gossiper.check_and_set_processed(msg.msg_id):
+            return CommandResult(ok=True)  # duplicate — already handled
+        if msg.ttl > 1:
+            relay = Message(msg.source, msg.cmd, msg.args, msg.round, msg.ttl - 1, msg.msg_id)
+            pending = [n for n in self.neighbors.get_all(only_direct=True) if n != msg.source]
+            self.gossiper.add_message(relay, pending)
+        return self._dispatch(msg.cmd, msg.source, msg.round, list(msg.args), None)
+
+    def handle_weights(self, env: WeightsEnvelope) -> CommandResult:
+        """Data-plane receive: direct dispatch, no TTL/dedup (``grpc_server.py:168-197``)."""
+        return self._dispatch(env.cmd, env.source, env.round, [], env.update)
+
+    def _dispatch(
+        self, cmd: str, source: str, round: int, args: list[str], update: Optional[ModelUpdate]
+    ) -> CommandResult:
+        from p2pfl_tpu.settings import Settings
+
+        if cmd != "beat" or not Settings.EXCLUDE_BEAT_LOGS:
+            # beat floods at 1/HEARTBEAT_PERIOD per neighbor — excluded from
+            # logs by default, same knob as the reference
+            logger.debug(self._address, f"Received '{cmd}' from {source}")
+        handler = self._commands.get(cmd)
+        if handler is None:
+            logger.error(self._address, f"Unknown command '{cmd}' from {source}")
+            return CommandResult(ok=False, error=f"unknown command {cmd}")
+        try:
+            if update is not None:
+                handler.execute(source, round, update=update)
+            else:
+                handler.execute(source, round, *args)
+            return CommandResult(ok=True)
+        except Exception as exc:  # noqa: BLE001 — commands must not kill the server thread
+            logger.error(self._address, f"Error executing {cmd} from {source}: {exc!r}")
+            return CommandResult(ok=False, error=str(exc))
+
+
+def random_subset(items: list[str], k: int) -> list[str]:
+    """k random picks without replacement (gossip target selection)."""
+    if len(items) <= k:
+        return list(items)
+    return random.sample(items, k)

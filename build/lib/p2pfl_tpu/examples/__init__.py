@@ -1,0 +1,5 @@
+"""Runnable examples, discoverable by the CLI (``p2pfl_tpu experiment list``).
+
+Reference equivalent: ``p2pfl/examples/`` + the docstring-scraping CLI
+(``p2pfl/cli.py:107-144``).
+"""

@@ -1,0 +1,84 @@
+"""Federated LoRA fine-tuning of a causal LM (BASELINE config 5 shape).
+
+Nodes train and exchange ONLY low-rank adapters; ``--spmd`` runs the whole
+federation as one mesh program, otherwise gossip nodes over the in-memory
+transport. Synthetic Markov-chain text stands in for a real corpus.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--nodes", type=int, default=4)
+    parser.add_argument("--rounds", type=int, default=3)
+    parser.add_argument("--epochs", type=int, default=1)
+    parser.add_argument("--seq-len", type=int, default=128)
+    parser.add_argument("--dim", type=int, default=256)
+    parser.add_argument("--layers", type=int, default=4)
+    parser.add_argument("--rank", type=int, default=16)
+    parser.add_argument("--batch-size", type=int, default=16)
+    parser.add_argument("--lr", type=float, default=1e-2)
+    parser.add_argument("--spmd", action="store_true", help="one-program mesh mode")
+    parser.add_argument("--measure_time", action="store_true")
+    args = parser.parse_args(argv)
+
+    from p2pfl_tpu.learning.dataset import FederatedDataset
+    from p2pfl_tpu.models.transformer import TransformerConfig, tiny_transformer
+
+    cfg = TransformerConfig(
+        dim=args.dim,
+        n_layers=args.layers,
+        n_heads=max(args.dim // 64, 2),
+        n_kv_heads=max(args.dim // 128, 1),
+        ffn_hidden=args.dim * 8 // 3,
+        lora_rank=args.rank,
+        lora_mlp=True,
+    )
+    data = FederatedDataset.synthetic_lm(vocab_size=cfg.vocab_size, seq_len=args.seq_len)
+    t0 = time.monotonic()
+
+    if args.spmd:
+        from p2pfl_tpu.parallel import SpmdLoraFederation
+
+        model = tiny_transformer(seq_len=args.seq_len, cfg=cfg)
+        fed = SpmdLoraFederation.from_dataset(
+            model, data, n_nodes=args.nodes, batch_size=args.batch_size,
+            learning_rate=args.lr, vote=False,
+        )
+        for _ in range(args.rounds):
+            entry = fed.run_round(epochs=args.epochs)
+            metrics = fed.evaluate()
+            print(
+                f"round {entry['round']}: loss={float(entry['train_loss']):.4f} "
+                f"next-token acc={metrics['test_acc']:.4f}"
+            )
+    else:
+        from p2pfl_tpu.learning.lora import LoRALearner
+        from p2pfl_tpu.simulation import Simulation
+
+        sim = Simulation(
+            args.nodes,
+            lambda i, shard: LoRALearner(
+                tiny_transformer(seq_len=args.seq_len, cfg=cfg),
+                shard,
+                batch_size=args.batch_size,
+                learning_rate=args.lr,
+            ),
+            data,
+            topology="full",
+        )
+        sim.start().learn(rounds=args.rounds, epochs=args.epochs)
+        for addr, metrics in sim.evaluate().items():
+            print(f"{addr}: {metrics}")
+        sim.stop()
+
+    if args.measure_time:
+        print(f"elapsed: {time.monotonic() - t0:.2f}s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,61 @@
+"""MNIST federated learning: N nodes in one process, line topology.
+
+Parity with the reference example (``p2pfl/examples/mnist.py:22-187``):
+``--nodes``, ``--rounds``, ``--epochs``, ``--protocol {memory,grpc}``
+(reference ``--use_local_protocol``), ``--measure_time``. Runs the gossip
+Node mode — see ``spmd_mnist.py`` for the one-program SPMD mode.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--nodes", type=int, default=2)
+    parser.add_argument("--rounds", type=int, default=2)
+    parser.add_argument("--epochs", type=int, default=1)
+    parser.add_argument("--protocol", choices=["memory", "grpc"], default="memory")
+    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--samples", type=int, default=8192, help="total training samples")
+    parser.add_argument("--measure_time", action="store_true")
+    args = parser.parse_args(argv)
+
+    from p2pfl_tpu.learning.dataset import FederatedDataset
+    from p2pfl_tpu.learning.learner import JaxLearner
+    from p2pfl_tpu.models import mlp
+    from p2pfl_tpu.node import Node
+    from p2pfl_tpu.utils import connect_line, wait_convergence, wait_to_finish
+
+    t0 = time.monotonic()
+    data = FederatedDataset.mnist(n_train=args.samples, n_test=max(args.samples // 8, 256))
+
+    nodes = []
+    for i in range(args.nodes):
+        learner = JaxLearner(mlp(seed=i), data.partition(i, args.nodes), batch_size=args.batch_size)
+        if args.protocol == "grpc":
+            from p2pfl_tpu.communication.grpc_transport import GrpcProtocol
+
+            node = Node(learner=learner, protocol=GrpcProtocol("127.0.0.1:0"))
+        else:
+            node = Node(learner=learner)
+        node.start()
+        nodes.append(node)
+
+    connect_line(nodes)
+    wait_convergence(nodes, args.nodes - 1, only_direct=False, wait=30)
+
+    nodes[0].set_start_learning(rounds=args.rounds, epochs=args.epochs)
+    wait_to_finish(nodes, timeout=600)
+
+    for node in nodes:
+        print(f"{node.addr}: {node.learner.evaluate()}")
+        node.stop()
+    if args.measure_time:
+        print(f"elapsed: {time.monotonic() - t0:.2f}s")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,42 @@
+"""Two-process gRPC demo, process 1 of 2: start a node and wait.
+
+Reference counterpart: ``p2pfl/examples/node1.py`` — one OS process per
+node, meeting over real sockets. Run this first, then ``node2.py`` with the
+same port:
+
+    python -m p2pfl_tpu.examples.node1 6666
+    python -m p2pfl_tpu.examples.node2 6666     # in another terminal
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from p2pfl_tpu.communication.grpc_transport import GrpcProtocol
+from p2pfl_tpu.learning.dataset import FederatedDataset
+from p2pfl_tpu.learning.learner import JaxLearner
+from p2pfl_tpu.models import mlp
+from p2pfl_tpu.node import Node
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="gRPC MNIST node (waits for node2)")
+    parser.add_argument("port", type=int, help="port to listen on")
+    parser.add_argument("--n_train", type=int, default=2048)
+    args = parser.parse_args()
+
+    data = FederatedDataset.mnist(n_train=args.n_train, n_test=512)
+    node = Node(
+        learner=JaxLearner(mlp(), data.partition(0, 2), batch_size=64),
+        protocol=GrpcProtocol(f"127.0.0.1:{args.port}"),
+    )
+    node.start()
+    print(f"node1 listening on {node.addr} — start node2 now", flush=True)
+    try:
+        node.protocol.wait_for_termination()
+    except KeyboardInterrupt:
+        node.stop()
+
+
+if __name__ == "__main__":
+    main()

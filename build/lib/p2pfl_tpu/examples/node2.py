@@ -1,0 +1,50 @@
+"""Two-process gRPC demo, process 2 of 2: connect to node1 and learn.
+
+Reference counterpart: ``p2pfl/examples/node2.py``. Start ``node1.py``
+first; this process connects over real sockets, kicks off federated
+learning on both nodes, prints its result and stops.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from p2pfl_tpu.communication.grpc_transport import GrpcProtocol
+from p2pfl_tpu.learning.dataset import FederatedDataset
+from p2pfl_tpu.learning.learner import JaxLearner
+from p2pfl_tpu.models import mlp
+from p2pfl_tpu.node import Node
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="gRPC MNIST node (connects to node1)")
+    parser.add_argument("port", type=int, help="node1's port")
+    parser.add_argument("--rounds", type=int, default=2)
+    parser.add_argument("--epochs", type=int, default=1)
+    parser.add_argument("--n_train", type=int, default=2048)
+    args = parser.parse_args()
+
+    data = FederatedDataset.mnist(n_train=args.n_train, n_test=512)
+    node = Node(
+        learner=JaxLearner(mlp(seed=1), data.partition(1, 2), batch_size=64),
+        protocol=GrpcProtocol("127.0.0.1:0"),
+    )
+    node.start()
+    if not node.connect(f"127.0.0.1:{args.port}"):
+        print("could not connect to node1 — is it running?", file=sys.stderr)
+        node.stop()
+        sys.exit(1)
+    time.sleep(1)  # let heartbeats converge membership
+
+    node.set_start_learning(rounds=args.rounds, epochs=args.epochs)
+    while node.state.round is not None:
+        time.sleep(1)
+
+    print(f"done: {node.learner.evaluate()}", flush=True)
+    node.stop()
+
+
+if __name__ == "__main__":
+    main()

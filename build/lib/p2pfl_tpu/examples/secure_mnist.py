@@ -1,0 +1,72 @@
+"""Privacy/bandwidth demo: secure aggregation OR compressed gossip.
+
+Beyond-reference capabilities on the same 4-node MNIST federation
+(the reference gossips raw pickled float32 over insecure channels):
+
+- ``--mode secagg``: pairwise-masked contributions with DH key agreement
+  over the gossip overlay (``learning/secagg.py``) — no individual model
+  ever crosses the wire in the clear, the FedAvg aggregate is unchanged.
+- ``--mode topk8``: top-k int8 delta gossip with error feedback
+  (``learning/weights.py``) — ~16x smaller payloads; with ``--protocol
+  grpc`` the measured weight-plane egress is printed per node.
+- ``--mode int8``: dense int8 quantized gossip (4x smaller).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--mode", choices=["secagg", "topk8", "int8"], default="secagg")
+    parser.add_argument("--nodes", type=int, default=4)
+    parser.add_argument("--rounds", type=int, default=2)
+    parser.add_argument("--epochs", type=int, default=1)
+    parser.add_argument("--protocol", choices=["memory", "grpc"], default="memory")
+    parser.add_argument("--samples", type=int, default=4096)
+    args = parser.parse_args(argv)
+
+    from p2pfl_tpu.learning.dataset import FederatedDataset
+    from p2pfl_tpu.learning.learner import JaxLearner
+    from p2pfl_tpu.models import mlp
+    from p2pfl_tpu.node import Node
+    from p2pfl_tpu.settings import Settings
+    from p2pfl_tpu.utils import full_connection, wait_convergence, wait_to_finish
+
+    if args.mode == "secagg":
+        Settings.SECURE_AGGREGATION = True  # requires the lossless wire
+    else:
+        Settings.WIRE_COMPRESSION = args.mode
+
+    data = FederatedDataset.mnist(n_train=args.samples, n_test=max(args.samples // 8, 256))
+    nodes = []
+    for i in range(args.nodes):
+        learner = JaxLearner(mlp(seed=i), data.partition(i, args.nodes), batch_size=64)
+        if args.protocol == "grpc":
+            from p2pfl_tpu.communication.grpc_transport import GrpcProtocol
+
+            node = Node(learner=learner, protocol=GrpcProtocol("127.0.0.1:0"))
+        else:
+            node = Node(learner=learner)
+        node.start()
+        nodes.append(node)
+
+    for node in nodes:
+        full_connection(node, nodes)
+    wait_convergence(nodes, args.nodes - 1, only_direct=True, wait=30)
+
+    nodes[0].set_start_learning(rounds=args.rounds, epochs=args.epochs)
+    wait_to_finish(nodes, timeout=600)
+
+    for node in nodes:
+        line = f"{node.addr}: {node.learner.evaluate()}"
+        stats = getattr(node.protocol, "wire_stats", None)
+        if stats is not None:
+            line += f"  egress: {stats['weights_bytes'] / 1e6:.2f} MB weights"
+        print(line)
+        node.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+"""Framework exceptions.
+
+Reference equivalents: ``p2pfl/exceptions.py:21-36``,
+``p2pfl/learning/exceptions.py:21-31``,
+``p2pfl/communication/exceptions.py:20``.
+"""
+
+
+class NodeRunningException(Exception):
+    """Raised when an operation requires the node to be stopped (or vice versa)."""
+
+
+class LearnerNotSetException(Exception):
+    """Raised when a learning operation runs before a learner exists."""
+
+
+class ZeroRoundsException(Exception):
+    """Raised when learning is started with zero rounds."""
+
+
+class DecodingParamsError(Exception):
+    """Raised when a serialized weights payload cannot be decoded."""
+
+
+class ModelNotMatchingError(Exception):
+    """Raised when received parameters do not match the local model structure."""
+
+
+class NeighborNotConnectedError(Exception):
+    """Raised when sending to a neighbor that is not connected."""
+
+
+class AnchorMismatchError(Exception):
+    """Raised when a delta-coded (topk8) payload references a different
+    round-start anchor than the receiver holds.
+
+    NOT a fatal decode error: the receiver ignores the update and waits for
+    one it can reconstruct (a stale node catches up via a later dense or
+    matching-anchor payload), unlike :class:`DecodingParamsError` which
+    stops the node (reference ``add_model_command.py:96-104``).
+    """
+
+
+class SecAggError(Exception):
+    """Raised when a secure-aggregation contribution cannot be masked safely.
+
+    The caller must NOT fall back to sending the model unmasked: peers that
+    already derived this node's pair seeds would still add their half of the
+    pairwise masks, which then never cancel — silently turning the round's
+    aggregate into noise. Skipping the contribution instead leaves coverage
+    incomplete, which the aggregator detects and reports loudly.
+    """
+
+
+class CommunicationError(Exception):
+    """Raised on transport-level send failures."""

@@ -1,0 +1,5 @@
+"""Learning layer: weights containers, codecs, learners, aggregators."""
+
+from p2pfl_tpu.learning.weights import ModelUpdate, decode_params, encode_params
+
+__all__ = ["ModelUpdate", "decode_params", "encode_params"]

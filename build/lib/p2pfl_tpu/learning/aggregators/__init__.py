@@ -1,0 +1,24 @@
+"""Aggregation strategies over jax.Array pytrees."""
+
+from p2pfl_tpu.learning.aggregators.aggregator import Aggregator
+from p2pfl_tpu.learning.aggregators.bulyan import Bulyan
+from p2pfl_tpu.learning.aggregators.clipping import CenteredClip
+from p2pfl_tpu.learning.aggregators.fedavg import FedAvg
+from p2pfl_tpu.learning.aggregators.fedmedian import FedMedian
+from p2pfl_tpu.learning.aggregators.fedopt import FedAdagrad, FedAdam, FedOpt, FedYogi
+from p2pfl_tpu.learning.aggregators.krum import Krum
+from p2pfl_tpu.learning.aggregators.trimmed_mean import TrimmedMean
+
+__all__ = [
+    "Aggregator",
+    "Bulyan",
+    "CenteredClip",
+    "FedAdagrad",
+    "FedAdam",
+    "FedAvg",
+    "FedMedian",
+    "FedOpt",
+    "FedYogi",
+    "Krum",
+    "TrimmedMean",
+]

@@ -1,0 +1,278 @@
+"""Aggregator base: partial-aggregation bookkeeping around a pure kernel.
+
+Re-implements the semantics of the reference's state machine
+(``p2pfl/learning/aggregators/aggregator.py:37-281``) with the lock-as-event
+pattern replaced by a real :class:`threading.Event`:
+
+- ``set_nodes_to_aggregate(train_set)`` opens the round's collection window.
+- ``add_model(update)`` accepts a model or partial aggregation:
+  * a full-coverage update replaces everything collected so far
+    (reference 156-168),
+  * a contributor-disjoint update accumulates (170-185),
+  * overlapping / foreign / duplicate contributors are rejected (187-198),
+  * in *waiting* mode (non-train-set nodes) the first update IS the result
+    (139-146).
+- ``wait_and_get_aggregation()`` blocks until coverage is complete or
+  ``Settings.AGGREGATION_TIMEOUT``, then aggregates whatever arrived.
+- ``get_partial_aggregation(except_nodes)`` pre-aggregates everything a peer
+  has not seen (249-281) — the payload of train-set gossip.
+
+Subclasses implement one pure function, :meth:`aggregate`, over a list of
+:class:`ModelUpdate` — typically a single jitted op from ``ops/aggregation``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from p2pfl_tpu.learning.weights import ModelUpdate
+from p2pfl_tpu.management.logger import logger
+from p2pfl_tpu.settings import Settings
+
+
+class Aggregator:
+    """Base aggregation strategy + round collection state."""
+
+    #: False for strategies (Krum, median, ...) that need the individual
+    #: models and therefore must not be fed pre-averaged partials.
+    SUPPORTS_PARTIALS: bool = True
+    #: True for stateful strategies (FedOpt) whose :meth:`aggregate` must run
+    #: exactly once per round even when a single update covers the train set
+    #: (the single-model shortcut would skip the server step).
+    ALWAYS_AGGREGATE: bool = False
+    #: True only for strategies that are linear in the contributions, so
+    #: secure-aggregation pairwise masks cancel through them
+    #: (``learning/secagg.py``). Robust strategies inspect individual
+    #: models and would operate on masked noise.
+    MASK_COMPATIBLE: bool = False
+
+    def __init__(self, node_name: str = "unknown") -> None:
+        self.node_name = node_name
+        self._lock = threading.Lock()
+        self._complete = threading.Event()
+        self._complete.set()  # no aggregation in progress
+        self._train_set: list[str] = []
+        self._waiting: bool = False
+        self._models: dict[frozenset, ModelUpdate] = {}
+
+    # ---- round lifecycle ----
+
+    def set_nodes_to_aggregate(self, nodes: list[str]) -> None:
+        if not self._complete.is_set():
+            raise Exception(f"({self.node_name}) aggregation already in progress")
+        with self._lock:
+            self._train_set = list(nodes)
+            self._waiting = False
+            self._models = {}
+            self._complete.clear()
+
+    def set_waiting_aggregated_model(self, nodes: list[str]) -> None:
+        """Non-train-set path: accept the first incoming update as the result.
+
+        Reference: ``aggregator.py`` waiting path + ``wait_agg_models_stage.py:48``.
+        """
+        with self._lock:
+            self._train_set = list(nodes)
+            self._waiting = True
+            self._models = {}
+            self._complete.clear()
+
+    def clear(self) -> None:
+        with self._lock:
+            self._train_set = []
+            self._waiting = False
+            self._models = {}
+            self._complete.set()
+
+    def reset_experiment(self) -> None:
+        """Experiment boundary: drop cross-ROUND strategy state.
+
+        The per-round :meth:`clear` deliberately keeps state that persists
+        across rounds (FedOpt moments, CenteredClip's center); a new
+        experiment must not inherit it — round 0 would otherwise be
+        server-stepped/clipped against the PREVIOUS experiment's final
+        model. Called at experiment START (StartLearningStage — the
+        authoritative reset) and on stop-learning (``node.py``); a
+        naturally-finished experiment does NOT reset, so the final strategy
+        state stays inspectable after the run.
+        """
+
+    # ---- collection ----
+
+    def get_aggregated_models(self) -> list[str]:
+        """Names of all contributors currently folded into collected models."""
+        with self._lock:
+            return sorted({c for key in self._models for c in key})
+
+    def add_model(self, update: ModelUpdate) -> list[str]:
+        """Add a model/partial. Returns the updated contributor coverage list.
+
+        An empty return means the update was rejected (duplicate, overlapping,
+        foreign contributor, or no collection window open).
+        """
+        contributors = frozenset(update.contributors)
+        if not contributors:
+            logger.debug(self.node_name, "Rejecting model with no contributors")
+            return []
+        with self._lock:
+            if self._waiting:
+                # only a FULL-train-set aggregate is acceptable while waiting
+                # (reference aggregator.py:139-146 requires
+                # set(contributors) == set(train_set)); accepting a stray
+                # partial would make one node's single model this node's
+                # "aggregated model" — a poisoning hole
+                if contributors != frozenset(self._train_set):
+                    logger.debug(
+                        self.node_name,
+                        f"Rejecting model while waiting: coverage {sorted(contributors)} "
+                        f"!= train set {sorted(self._train_set)}",
+                    )
+                    return []
+                if self._models:  # first full update wins
+                    logger.debug(self.node_name, "Rejecting model: already received while waiting")
+                    return []
+                self._models = {contributors: update}
+                self._complete.set()
+                return list(update.contributors)
+
+            if self._complete.is_set():
+                logger.debug(self.node_name, "Rejecting model: no aggregation in progress")
+                return []
+
+            train = set(self._train_set)
+            if not contributors <= train:
+                logger.debug(
+                    self.node_name,
+                    f"Rejecting model with foreign contributors {sorted(contributors - train)}",
+                )
+                return []
+
+            if not self.SUPPORTS_PARTIALS and len(contributors) > 1 and contributors != train:
+                # a pre-averaged partial would poison a robust aggregate
+                logger.debug(
+                    self.node_name,
+                    f"Rejecting partial aggregation {sorted(contributors)}: "
+                    f"{type(self).__name__} needs individual models",
+                )
+                return []
+
+            if contributors == train:
+                # full-coverage update replaces everything (reference 156-168)
+                self._models = {contributors: update}
+                self._complete.set()
+                return sorted(train)
+
+            covered = {c for key in self._models for c in key}
+            if contributors & covered:
+                logger.debug(
+                    self.node_name,
+                    f"Rejecting overlapping model {sorted(contributors)} (covered: {sorted(covered)})",
+                )
+                return []
+
+            self._models[contributors] = update
+            covered |= contributors
+            if covered == train:
+                self._complete.set()
+            return sorted(covered)
+
+    # ---- results ----
+
+    def wait_and_get_aggregation(self, timeout: Optional[float] = None) -> ModelUpdate:
+        """Block until coverage completes (or timeout), then aggregate."""
+        timeout = Settings.AGGREGATION_TIMEOUT if timeout is None else timeout
+        finished = self._complete.wait(timeout=timeout)
+        with self._lock:
+            models = list(self._models.values())
+            train = set(self._train_set)
+            waiting = self._waiting
+            # close the collection window: late updates for this round are
+            # rejected and the next set_nodes_to_aggregate() will not raise
+            self._complete.set()
+        if not models:
+            raise Exception(f"({self.node_name}) aggregation produced no models (timeout={not finished})")
+        if not finished:
+            covered = {c for m in models for c in m.contributors}
+            logger.info(
+                self.node_name,
+                f"Aggregation timeout — proceeding with partial coverage {sorted(covered)} of {sorted(train)}",
+            )
+            if Settings.SECURE_AGGREGATION and covered != train:
+                # pairwise masks only cancel over the FULL train set; the
+                # missing members' masks still ride on this aggregate. The
+                # stage must run seed-disclosure recovery before applying it
+                # (GossipModelStage._secagg_finalize, learning/secagg.py).
+                logger.warning(
+                    self.node_name,
+                    "SecAgg: partial coverage — unresolved pairwise masks; "
+                    "attempting dropout recovery",
+                )
+        # a single model is returned as-is when (a) this node is waiting,
+        # (b) the strategy is stateless, or (c) it is a full multi-node
+        # aggregate a faster train-set peer diffused (already
+        # server-stepped — re-aggregating would double-step); on_result
+        # lets stateful strategies resync to the consensus model
+        if len(models) == 1 and (
+            waiting or not self.ALWAYS_AGGREGATE or len(models[0].contributors) > 1
+        ):
+            return self.on_result(models[0])
+        return self._inherit_anchor(self.aggregate(models), models)
+
+    @staticmethod
+    def _inherit_anchor(result: ModelUpdate, models: list[ModelUpdate]) -> ModelUpdate:
+        """Carry the delta-coding anchor through aggregation.
+
+        All of a round's updates share one anchor (the round-start global,
+        ``learning/weights.py`` topk8), so a fresh aggregate re-encodes
+        against the same anchor when it goes back on the wire.
+        """
+        if result.anchor is None and models and models[0].anchor is not None:
+            result.anchor = models[0].anchor
+            result.anchor_tag = models[0].anchor_tag
+        return result
+
+    def on_result(self, update: ModelUpdate) -> ModelUpdate:
+        """Hook: the round resolved to ``update`` WITHOUT this node running
+        :meth:`aggregate` (waiting mode, or a peer's finished aggregate
+        arrived first). Stateful strategies resync their server state here."""
+        return update
+
+    def get_partial_aggregation(self, except_nodes: list[str]) -> Optional[ModelUpdate]:
+        """Aggregate collected models not already covered by ``except_nodes``.
+
+        For strategies without partial support this returns None when more
+        than one model would need combining — use :meth:`get_models_to_send`.
+        """
+        todo = self._models_not_covered(except_nodes)
+        if not todo:
+            return None
+        if len(todo) == 1:
+            return todo[0]
+        if not self.SUPPORTS_PARTIALS:
+            return None
+        return self._inherit_anchor(self.aggregate(todo), todo)
+
+    def get_models_to_send(self, except_nodes: list[str]) -> list[ModelUpdate]:
+        """Payloads to gossip to a peer that already covers ``except_nodes``.
+
+        Partial-supporting strategies send one pre-aggregated update; robust
+        strategies send the individual models so the receiver can aggregate
+        them itself.
+        """
+        todo = self._models_not_covered(except_nodes)
+        if not todo:
+            return []
+        if self.SUPPORTS_PARTIALS and len(todo) > 1:
+            return [self._inherit_anchor(self.aggregate(todo), todo)]
+        return todo
+
+    def _models_not_covered(self, except_nodes: list[str]) -> list[ModelUpdate]:
+        skip = set(except_nodes)
+        with self._lock:
+            return [m for key, m in self._models.items() if not (key & skip)]
+
+    # ---- strategy ----
+
+    def aggregate(self, models: list[ModelUpdate]) -> ModelUpdate:
+        raise NotImplementedError

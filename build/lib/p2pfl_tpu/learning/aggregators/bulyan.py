@@ -1,0 +1,31 @@
+"""Bulyan (El Mhamdi et al. 2018): Krum selection + trimmed-mean aggregation.
+
+Stronger than either alone: Krum bounds the attacker to models near honest
+ones, the trimmed mean then removes per-coordinate outliers those survivors
+still carry ("a little is enough" attacks). Needs N ≥ 4f + 3.
+
+The reference ships FedAvg only (``p2pfl/learning/aggregators/fedavg.py``);
+this completes the Byzantine-robust family (median / trimmed-mean / Krum /
+Bulyan) for BASELINE config 4.
+"""
+
+from __future__ import annotations
+
+from p2pfl_tpu.learning.aggregators.aggregator import Aggregator
+from p2pfl_tpu.learning.weights import ModelUpdate
+from p2pfl_tpu.ops.aggregation import bulyan
+from p2pfl_tpu.ops.tree import tree_stack
+
+
+class Bulyan(Aggregator):
+    SUPPORTS_PARTIALS = False  # needs the individual models, like Krum
+
+    def __init__(self, node_name: str = "unknown", n_byzantine: int = 1) -> None:
+        super().__init__(node_name)
+        self.n_byzantine = n_byzantine
+
+    def aggregate(self, models: list[ModelUpdate]) -> ModelUpdate:
+        stacked = tree_stack([m.params for m in models])
+        params = bulyan(stacked, self.n_byzantine)
+        contributors = sorted({c for m in models for c in m.contributors})
+        return ModelUpdate(params, contributors, sum(m.num_samples for m in models))

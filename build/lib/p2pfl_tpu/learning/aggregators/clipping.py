@@ -1,0 +1,72 @@
+"""Centered clipping (Karimireddy, He & Jaggi 2021). Robust aggregator.
+
+History-aware Byzantine robustness: starting from the previous round's
+global model ``v``, iterate ``v ← v + mean_i clip_τ(x_i − v)`` — each
+node's whole-model deviation is rescaled to norm ≤ τ, so an attacker can
+displace the aggregate by at most τ per round regardless of magnitude.
+Complements the existing family: needs no Byzantine-count estimate
+(trimmed mean/Krum/Bulyan do), and uses every honest node's information
+(Krum discards all but the selected). The reference ships FedAvg only
+(``p2pfl/learning/aggregators/fedavg.py``).
+"""
+
+from __future__ import annotations
+
+from p2pfl_tpu.learning.aggregators.aggregator import Aggregator
+from p2pfl_tpu.learning.weights import ModelUpdate
+from p2pfl_tpu.ops.aggregation import centered_clip, fedmedian
+from p2pfl_tpu.ops.tree import tree_stack
+
+
+class CenteredClip(Aggregator):
+    """``SUPPORTS_PARTIALS = False``: clipping is nonlinear per node, so
+    pre-averaged gossip partials would launder an attacker's model into a
+    partial mean the clip can no longer bound; peers gossip individual
+    models instead (``get_models_to_send``), like the rest of the robust
+    family. Stateful like FedOpt: the clip center is the previous round's
+    global model, resynced via :meth:`on_result` when a peer's finished
+    aggregate arrives first."""
+
+    SUPPORTS_PARTIALS = False
+    ALWAYS_AGGREGATE = True  # center must advance exactly once per round
+
+    def __init__(
+        self, node_name: str = "unknown", tau: float = 1.0, iters: int = 3
+    ) -> None:
+        super().__init__(node_name)
+        if tau <= 0:
+            # tau <= 0 zeroes every clip factor — the aggregate would never
+            # leave the center and training silently freezes
+            raise ValueError(f"tau must be > 0 (got {tau})")
+        if iters < 1:
+            raise ValueError(f"iters must be >= 1 (got {iters})")
+        self.tau = float(tau)
+        self.iters = int(iters)
+        self._center = None  # previous round's global model
+
+    def aggregate(self, models: list[ModelUpdate]) -> ModelUpdate:
+        stacked = tree_stack([m.params for m in models])
+        contributors = sorted({c for m in models for c in m.contributors})
+        total = sum(m.num_samples for m in models)
+        center = self._center
+        if center is None:
+            # round 0: no history to clip against — bootstrap with the
+            # coordinate-wise median (a mean would hand a round-0 attacker
+            # the center; the paper's v_0 is arbitrary, so pick the robust
+            # option) and still clip around it
+            center = fedmedian(stacked)
+        params = centered_clip(stacked, center, self.tau, self.iters)
+        self._center = params
+        return ModelUpdate(params, contributors, total)
+
+    def on_result(self, update: ModelUpdate) -> ModelUpdate:
+        # consensus aggregate arrived from a peer: adopt it as the next
+        # round's clip center
+        self._center = update.params
+        return update
+
+    def reset_experiment(self) -> None:
+        # a second experiment on the same node must re-bootstrap from the
+        # median, not clip round 0 against the previous experiment's final
+        # model (which would pin early progress to tau per round)
+        self._center = None

@@ -1,0 +1,27 @@
+"""FedAvg: sample-weighted mean (McMahan et al. 2017).
+
+Reference: ``p2pfl/learning/aggregators/fedavg.py:28-60`` (a Python loop over
+state-dict layers). Here: one jitted weighted-mean over the stacked pytree.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from p2pfl_tpu.learning.aggregators.aggregator import Aggregator
+from p2pfl_tpu.learning.weights import ModelUpdate
+from p2pfl_tpu.ops.aggregation import fedavg
+from p2pfl_tpu.ops.tree import tree_stack
+from p2pfl_tpu.settings import Settings
+
+
+class FedAvg(Aggregator):
+    SUPPORTS_PARTIALS = True
+    MASK_COMPATIBLE = True  # linear: secagg pairwise masks cancel through it
+
+    def aggregate(self, models: list[ModelUpdate]) -> ModelUpdate:
+        stacked = tree_stack([m.params for m in models])
+        weights = jnp.asarray([float(m.num_samples) for m in models])
+        params = fedavg(stacked, weights, Settings.AGG_DTYPE)
+        contributors = sorted({c for m in models for c in m.contributors})
+        return ModelUpdate(params, contributors, sum(m.num_samples for m in models))

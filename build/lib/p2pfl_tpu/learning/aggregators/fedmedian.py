@@ -1,0 +1,18 @@
+"""FedMedian: coordinate-wise median (Yin et al. 2018). Robust aggregator."""
+
+from __future__ import annotations
+
+from p2pfl_tpu.learning.aggregators.aggregator import Aggregator
+from p2pfl_tpu.learning.weights import ModelUpdate
+from p2pfl_tpu.ops.aggregation import fedmedian
+from p2pfl_tpu.ops.tree import tree_stack
+
+
+class FedMedian(Aggregator):
+    # medians over pre-averaged partials are not medians over models
+    SUPPORTS_PARTIALS = False
+
+    def aggregate(self, models: list[ModelUpdate]) -> ModelUpdate:
+        params = fedmedian(tree_stack([m.params for m in models]))
+        contributors = sorted({c for m in models for c in m.contributors})
+        return ModelUpdate(params, contributors, sum(m.num_samples for m in models))

@@ -1,0 +1,143 @@
+"""FedOpt family: server-side adaptive optimizers (Reddi et al. 2021).
+
+The round's FedAvg result is not taken as the new model directly; instead
+``prev_global - fedavg`` becomes a pseudo-gradient and a server optimizer
+(Adam / Yogi / Adagrad) steps the global model — markedly faster under
+heterogeneous (non-IID) shards.
+
+Decentralized caveat: the "server" state (moments + previous global) lives
+on every aggregating node. States stay identical across nodes as long as
+the train set is stable — which is the default round semantics inherited
+from the reference (voting happens only in round 0,
+``round_finished_stage.py:69-70``). With ``Settings.VOTE_EVERY_ROUND=True``
+a node newly elected mid-experiment starts with fresh moments and will
+disagree with its peers for a few rounds (warned once at aggregate time).
+
+The reference ships no adaptive server optimizer (FedAvg only,
+``p2pfl/learning/aggregators/fedavg.py``); its docs list Scaffold as
+"coming soon" (``docs/source/library_design.md``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from p2pfl_tpu.learning.aggregators.aggregator import Aggregator
+from p2pfl_tpu.learning.weights import ModelUpdate
+from p2pfl_tpu.management.logger import logger
+from p2pfl_tpu.ops.aggregation import fedavg, fedopt_update
+from p2pfl_tpu.ops.tree import tree_stack
+from p2pfl_tpu.settings import Settings
+
+
+class FedOpt(Aggregator):
+    """FedAvg + server-side adaptive step. Subclasses pin the optimizer.
+
+    ``SUPPORTS_PARTIALS = False``: the server step is nonlinear AND
+    stateful, so ``aggregate`` must run exactly once per round on the full
+    model set — feeding it gossip partials would advance the moments
+    mid-round and emit server-stepped payloads that peers would re-average
+    as if they were plain means. Peers therefore gossip individual models
+    (``get_models_to_send``), like the robust family.
+    """
+
+    SUPPORTS_PARTIALS = False
+    ALWAYS_AGGREGATE = True  # single-update shortcut must not skip the step
+    SERVER_OPT = "adam"
+
+    def __init__(
+        self,
+        node_name: str = "unknown",
+        server_lr: float = 0.1,
+        beta1: float = 0.9,
+        beta2: float = 0.99,
+        tau: float = 1e-3,
+    ) -> None:
+        super().__init__(node_name)
+        self.server_lr = server_lr
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.tau = tau
+        self._prev = None  # previous global params (the server's x_t)
+        self._m = None
+        self._v = None
+        self._t = 0
+        self._warned = False
+
+    def aggregate(self, models: list[ModelUpdate]) -> ModelUpdate:
+        stacked = tree_stack([m.params for m in models])
+        weights = jnp.asarray([float(m.num_samples) for m in models])
+        avg = fedavg(stacked, weights, Settings.AGG_DTYPE)
+        contributors = sorted({c for m in models for c in m.contributors})
+        total = sum(m.num_samples for m in models)
+
+        if self._prev is None:
+            # round 0: adopt the average and start server state from it
+            if Settings.VOTE_EVERY_ROUND and not self._warned:
+                self._warned = True
+                logger.warning(
+                    self.node_name,
+                    "FedOpt with per-round voting: newly elected nodes start "
+                    "with fresh server moments and briefly diverge from peers",
+                )
+            self._prev = avg
+            self._m = jax.tree.map(lambda x: jnp.zeros_like(x, jnp.float32), avg)
+            self._v = jax.tree.map(lambda x: jnp.zeros_like(x, jnp.float32), avg)
+            return ModelUpdate(avg, contributors, total)
+
+        self._t += 1
+        new, self._m, self._v = fedopt_update(
+            self._prev,
+            avg,
+            self._m,
+            self._v,
+            jnp.float32(self._t),
+            opt=self.SERVER_OPT,
+            lr=self.server_lr,
+            b1=self.beta1,
+            b2=self.beta2,
+            tau=self.tau,
+        )
+        self._prev = new
+        return ModelUpdate(new, contributors, total)
+
+
+    def on_result(self, update: ModelUpdate) -> ModelUpdate:
+        # the round resolved to a peer's (already server-stepped) aggregate
+        # without this node aggregating: adopt it as the server's x_t so the
+        # next round's pseudo-gradient is computed against the consensus
+        # global, not a stale one. Moments must exist too — a node whose
+        # FIRST round resolves this way would otherwise crash in
+        # fedopt_update when it later aggregates itself.
+        self._prev = update.params
+        if self._m is None:
+            self._m = jax.tree.map(
+                lambda x: jnp.zeros_like(x, jnp.float32), update.params
+            )
+            self._v = jax.tree.map(
+                lambda x: jnp.zeros_like(x, jnp.float32), update.params
+            )
+        return update
+
+
+    def reset_experiment(self) -> None:
+        # same staleness hazard as CenteredClip's center: a new experiment
+        # must not server-step its round 0 against the previous
+        # experiment's final global, nor inherit its moments
+        self._prev = None
+        self._m = None
+        self._v = None
+        self._t = 0
+
+
+class FedAdam(FedOpt):
+    SERVER_OPT = "adam"
+
+
+class FedYogi(FedOpt):
+    SERVER_OPT = "yogi"
+
+
+class FedAdagrad(FedOpt):
+    SERVER_OPT = "adagrad"

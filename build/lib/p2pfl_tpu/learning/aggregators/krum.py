@@ -1,0 +1,28 @@
+"""(Multi-)Krum (Blanchard et al. 2017). Byzantine-robust selection.
+
+The pairwise-distance matrix is computed as a single [N, P] @ [P, N] matmul
+on the MXU (``ops/aggregation.py:krum_select``) rather than a nested python
+loop over model pairs.
+"""
+
+from __future__ import annotations
+
+from p2pfl_tpu.learning.aggregators.aggregator import Aggregator
+from p2pfl_tpu.learning.weights import ModelUpdate
+from p2pfl_tpu.ops.aggregation import krum
+from p2pfl_tpu.ops.tree import tree_stack
+
+
+class Krum(Aggregator):
+    SUPPORTS_PARTIALS = False
+
+    def __init__(self, node_name: str = "unknown", n_byzantine: int = 1, multi: int = 1) -> None:
+        super().__init__(node_name)
+        self.n_byzantine = n_byzantine
+        self.multi = multi
+
+    def aggregate(self, models: list[ModelUpdate]) -> ModelUpdate:
+        stacked = tree_stack([m.params for m in models])
+        params = krum(stacked, self.n_byzantine, min(self.multi, len(models)))
+        contributors = sorted({c for m in models for c in m.contributors})
+        return ModelUpdate(params, contributors, sum(m.num_samples for m in models))

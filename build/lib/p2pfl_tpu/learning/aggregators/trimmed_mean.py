@@ -1,0 +1,29 @@
+"""Coordinate-wise trimmed mean (Yin et al. 2018). Robust aggregator."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from p2pfl_tpu.learning.aggregators.aggregator import Aggregator
+from p2pfl_tpu.learning.weights import ModelUpdate
+from p2pfl_tpu.ops.aggregation import fedavg, trimmed_mean
+from p2pfl_tpu.ops.tree import tree_stack
+
+
+class TrimmedMean(Aggregator):
+    SUPPORTS_PARTIALS = False
+
+    def __init__(self, node_name: str = "unknown", trim: int = 1) -> None:
+        super().__init__(node_name)
+        self.trim = trim
+
+    def aggregate(self, models: list[ModelUpdate]) -> ModelUpdate:
+        n = len(models)
+        trim = min(self.trim, max((n - 1) // 2, 0))
+        stacked = tree_stack([m.params for m in models])
+        if trim > 0:
+            params = trimmed_mean(stacked, trim)
+        else:  # not enough models to trim — plain unweighted mean
+            params = fedavg(stacked, jnp.ones(n))
+        contributors = sorted({c for m in models for c in m.contributors})
+        return ModelUpdate(params, contributors, sum(m.num_samples for m in models))

@@ -1,0 +1,350 @@
+"""Learners: the per-node training engine.
+
+``NodeLearner`` mirrors the reference template
+(``p2pfl/learning/learner.py:36-150``); :class:`JaxLearner` replaces the
+PyTorch-Lightning learner (``lightning_learner.py``) with a TPU-first design:
+
+- one jitted, donated **epoch** step — the whole epoch is a ``lax.scan`` over
+  statically-shaped ``[num_batches, batch, ...]`` arrays, so there is exactly
+  one device dispatch per epoch (the reference dispatches per batch through
+  the Lightning loop);
+- compute in bfloat16 on the MXU, params + optimizer state in float32;
+- all learners of the same architecture share one compilation: the flax
+  module and the (cached) optax transform are static args with structural
+  equality, so N simulated nodes compile once, not N times.
+
+The jit cache note matters: the reference's per-node Lightning ``Trainer`` is
+rebuilt every round (``lightning_learner.py:180-198``); here compilation
+happens once per architecture per process.
+"""
+
+from __future__ import annotations
+
+import threading
+from abc import ABC, abstractmethod
+from functools import lru_cache, partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from p2pfl_tpu.learning.dataset import FederatedDataset
+from p2pfl_tpu.learning.weights import ModelUpdate, decode_params, restore_like
+from p2pfl_tpu.management.logger import logger
+from p2pfl_tpu.models.base import FlaxModel, apply_with_aux
+
+Pytree = Any
+
+
+class NodeLearner(ABC):
+    """Template for node learners (reference ``learner.py:36-150``)."""
+
+    @abstractmethod
+    def set_parameters(self, params: Pytree) -> None: ...
+
+    @abstractmethod
+    def get_parameters(self) -> Pytree: ...
+
+    @abstractmethod
+    def set_epochs(self, epochs: int) -> None: ...
+
+    @abstractmethod
+    def fit(self) -> None: ...
+
+    @abstractmethod
+    def interrupt_fit(self) -> None: ...
+
+    @abstractmethod
+    def evaluate(self) -> dict[str, float]: ...
+
+    @abstractmethod
+    def get_num_samples(self) -> int: ...
+
+    # ---- shared plumbing ----
+
+    addr: str = ""
+
+    def set_addr(self, addr: str) -> None:
+        self.addr = addr
+
+    def get_model_update(self) -> ModelUpdate:
+        update = ModelUpdate(self.get_parameters(), [self.addr], self.get_num_samples())
+        anchor = getattr(self, "_wire_anchor", None)
+        if anchor is not None:
+            update.anchor = anchor
+            update.anchor_tag = getattr(self, "_wire_anchor_tag", None)
+        return update
+
+    def set_wire_anchor(self, params, tag: str) -> None:
+        """Pin the round-start global model as the delta-coding anchor.
+
+        Called by the stages at the two points where every node holds the
+        round's shared model (after init-weights sync, and at each round
+        boundary) — see ``learning/weights.py`` topk8. ``tag`` is the round
+        identity (``"experiment_epoch:round"``) that both ends of a
+        delta-coded transfer must agree on.
+        """
+        from p2pfl_tpu.settings import Settings
+
+        if Settings.WIRE_COMPRESSION != "topk8":
+            self._wire_anchor = None
+            return
+        self._wire_anchor = params
+        self._wire_anchor_tag = tag
+
+    def ef_residual_store(self) -> dict:
+        """The node's error-feedback residual ({path: dropped delta mass}).
+
+        Attached by TrainStage to the node's OWN contribution only — it
+        must accumulate exactly one encode per round.
+        """
+        if not hasattr(self, "_ef_residual"):
+            self._ef_residual = {}
+        return self._ef_residual
+
+    def materialize(self, update: ModelUpdate) -> ModelUpdate:
+        """Decode a wire payload against this learner's parameter structure."""
+        if update.params is not None:
+            return update
+        anchor = getattr(self, "_wire_anchor", None)
+        tag = getattr(self, "_wire_anchor_tag", None)
+        flat = decode_params(update.encoded, anchor=anchor, anchor_tag=tag)
+        params = restore_like(self.get_parameters(), flat)
+        out = ModelUpdate(params, update.contributors, update.num_samples)
+        # relays re-encode fresh aggregates against the same shared anchor
+        out.anchor = anchor
+        out.anchor_tag = tag
+        return out
+
+
+# ---- pure jitted steps (module-level => shared jit cache) ----
+
+
+@lru_cache(maxsize=None)
+def adam(lr: float = 1e-3) -> optax.GradientTransformation:
+    """Cached so every learner with the same lr shares one jit cache entry."""
+    return optax.adam(lr)
+
+
+@lru_cache(maxsize=None)
+def sgd(lr: float = 1e-3) -> optax.GradientTransformation:
+    """Cached like :func:`adam`. SCAFFOLD's variate update assumes SGD."""
+    return optax.sgd(lr)
+
+
+def _loss(params, module, x, y):
+    """Training loss: CE + any sown auxiliary losses (MoE router balance)."""
+    logits, aux = apply_with_aux(module, params, x)
+    ce = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+    return ce + aux, logits
+
+
+def _prox_term(params, anchor, mu: float):
+    """FedProx penalty μ/2·‖w − anchor‖² — shared by node and SPMD modes so
+    their local-step math cannot desynchronize."""
+    sq = sum(
+        jnp.sum((a.astype(jnp.float32) - b.astype(jnp.float32)) ** 2)
+        for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(anchor))
+    )
+    return 0.5 * mu * sq
+
+
+@partial(jax.jit, static_argnames=("module", "tx", "prox_mu"), donate_argnums=(1,))
+def train_epoch(params, opt_state, xs, ys, module, tx, prox_mu: float = 0.0, anchor=None):
+    """One full epoch: scan of SGD steps over [nb, bs, ...] batches.
+
+    ``params`` is NOT donated: with the zero-copy in-memory transport other
+    nodes' aggregators may hold references to these exact buffers.
+
+    ``prox_mu > 0`` adds the FedProx proximal term μ/2·‖w − anchor‖²
+    (Li et al. 2020) pulling local steps toward the round's global model
+    (``anchor``; defaults to the params this epoch starts from).
+    """
+    if prox_mu > 0.0 and anchor is None:
+        anchor = params
+
+    def step(carry, batch):
+        p, o = carry
+        x, y = batch
+
+        def full_loss(p_):
+            loss, logits = _loss(p_, module, x, y)
+            if prox_mu > 0.0:
+                loss = loss + _prox_term(p_, anchor, prox_mu)
+            return loss, logits
+
+        (loss, _), grads = jax.value_and_grad(full_loss, has_aux=True)(p)
+        updates, o = tx.update(grads, o, p)
+        p = optax.apply_updates(p, updates)
+        return (p, o), loss
+
+    (params, opt_state), losses = jax.lax.scan(step, (params, opt_state), (xs, ys))
+    return params, opt_state, jnp.mean(losses)
+
+
+def ce_eval(params, module, x, y):
+    """Pure-CE eval loss + logits — NO sown aux regularizers, so reported
+    test_loss stays comparable across MoE/dense models and across
+    node/SPMD/LoRA modes. Every eval path funnels through this."""
+    logits = module.apply({"params": params}, x)
+    return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean(), logits
+
+
+@partial(jax.jit, static_argnames=("module",))
+def eval_step(params, x, y, module):
+    loss, logits = ce_eval(params, module, x, y)
+    acc = jnp.mean((jnp.argmax(logits, axis=-1) == y).astype(jnp.float32))
+    return loss, acc
+
+
+class JaxLearner(NodeLearner):
+    """JAX/flax learner: jitted epoch scan + jitted eval (one chip)."""
+
+    def __init__(
+        self,
+        model: FlaxModel,
+        data: FederatedDataset,
+        addr: str = "",
+        epochs: int = 1,
+        batch_size: int = 128,
+        learning_rate: float = 1e-3,
+        seed: int = 0,
+        keep_opt_state: bool = False,
+        prox_mu: float = 0.0,
+        dp_clip: float = 0.0,
+        dp_noise: float = 0.0,
+    ) -> None:
+        self.model = model
+        self.data = data
+        self.addr = addr
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.tx = adam(learning_rate)
+        self.keep_opt_state = keep_opt_state
+        # FedProx (Li et al. 2020): μ > 0 adds a proximal pull toward the
+        # round's incoming global model during local steps
+        self.prox_mu = float(prox_mu)
+        # DP-SGD (Abadi et al. 2016): per-example clipped grads + Gaussian
+        # noise; dp_clip > 0 enables, dp_noise is the noise multiplier σ.
+        # An accountant tracks (ε, δ) across fit() calls.
+        self.dp_clip = float(dp_clip)
+        self.dp_noise = float(dp_noise)
+        if self.dp_noise > 0.0 and self.dp_clip <= 0.0:
+            # noise without a clip bound has no privacy semantics — and the
+            # dp path is gated on dp_clip, so it would silently be ignored
+            raise ValueError("dp_noise > 0 requires dp_clip > 0")
+        self.accountant = None
+        if self.dp_clip > 0.0:
+            from p2pfl_tpu.learning.privacy import PrivacyAccountant
+
+            if self.dp_noise > 0.0:
+                q = min(1.0, batch_size / max(1, data.num_samples))
+                self.accountant = PrivacyAccountant(self.dp_noise, q)
+        self.params: Pytree = model.params
+        self.opt_state = self.tx.init(self.params)
+        self._rng = np.random.default_rng(seed)
+        self._interrupt = threading.Event()
+        self._steps_done = 0
+
+    # ---- params ----
+
+    def set_parameters(self, params: Pytree) -> None:
+        # structural check — architecture mismatch raises instead of hanging
+        if jax.tree.structure(params) != jax.tree.structure(self.params):
+            from p2pfl_tpu.exceptions import ModelNotMatchingError
+
+            raise ModelNotMatchingError("incoming params do not match model structure")
+        self.params = params
+        if not self.keep_opt_state:
+            # reference behavior: a fresh Trainer (and optimizer) per round
+            # (lightning_learner.py:180-198). keep_opt_state=True carries the
+            # Adam moments across rounds instead — the same documented
+            # improvement knob as SpmdFederation(keep_opt_state=True)
+            self.opt_state = self.tx.init(params)
+
+    def get_parameters(self) -> Pytree:
+        return self.params
+
+    def set_epochs(self, epochs: int) -> None:
+        self.epochs = epochs
+
+    # ---- training ----
+
+    def fit(self) -> None:
+        self._interrupt.clear()
+        if self.epochs == 0:
+            return  # test mode, like the reference's epochs=0 CI runs
+        # round's global model (FedProx anchor — used by both DP and plain paths)
+        anchor = self.params if self.prox_mu > 0.0 else None
+        for _ in range(self.epochs):
+            if self._interrupt.is_set():
+                logger.info(self.addr, "Training interrupted")
+                return
+            xs, ys = self.data.epoch_batches(self.batch_size, self._rng)
+            if self.dp_clip > 0.0:
+                from p2pfl_tpu.learning.privacy import dp_train_epoch
+
+                key = jax.random.PRNGKey(int(self._rng.integers(2**31)))
+                self.params, self.opt_state, loss = dp_train_epoch(
+                    self.params, self.opt_state, jnp.asarray(xs), jnp.asarray(ys),
+                    key, self.model.module, self.tx, self.dp_clip, self.dp_noise,
+                    prox_mu=self.prox_mu, anchor=anchor,
+                )
+                if self.accountant is not None:
+                    self.accountant.step(xs.shape[0])
+            else:
+                self.params, self.opt_state, loss = train_epoch(
+                    self.params, self.opt_state, jnp.asarray(xs), jnp.asarray(ys),
+                    self.model.module, self.tx, prox_mu=self.prox_mu, anchor=anchor,
+                )
+            self._steps_done += xs.shape[0]
+            logger.log_metric(self.addr, "train_loss", float(loss), step=self._steps_done)
+
+    def interrupt_fit(self) -> None:
+        self._interrupt.set()
+
+    def evaluate(self) -> dict[str, float]:
+        x, y = self.data.test_arrays()
+        if len(y) == 0:
+            return {}
+        loss, acc = eval_step(self.params, jnp.asarray(x), jnp.asarray(y), self.model.module)
+        return {"test_loss": float(loss), "test_acc": float(acc)}
+
+    def get_num_samples(self) -> int:
+        return self.data.num_samples
+
+
+class DummyLearner(NodeLearner):
+    """No-ML learner for FSM/communication tests: params is a tiny pytree."""
+
+    def __init__(self, model=None, data=None, value: float = 0.0) -> None:
+        self.params = {"w": jnp.full((4,), value)}
+        self.epochs = 1
+        self._num_samples = 10
+
+    def set_parameters(self, params):
+        if jax.tree.structure(params) != jax.tree.structure(self.params):
+            from p2pfl_tpu.exceptions import ModelNotMatchingError
+
+            raise ModelNotMatchingError("structure mismatch")
+        self.params = params
+
+    def get_parameters(self):
+        return self.params
+
+    def set_epochs(self, epochs):
+        self.epochs = epochs
+
+    def fit(self):
+        self.params = jax.tree.map(lambda x: x + 1.0, self.params)
+
+    def interrupt_fit(self):
+        pass
+
+    def evaluate(self):
+        return {"dummy_metric": float(np.asarray(jax.tree.leaves(self.params)[0]).mean())}
+
+    def get_num_samples(self):
+        return self._num_samples

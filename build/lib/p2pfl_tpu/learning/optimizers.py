@@ -1,0 +1,48 @@
+"""Optimizer constructors with shared identity.
+
+jit caches key on the optax transform's *object identity* (it's a NamedTuple
+of closures), so two learners calling ``optax.adam(1e-3)`` independently
+would compile every train step twice. These constructors are lru-cached —
+same config → same object → one compilation across all nodes of a
+federation. The reference exposes Adam only (hardcoded in its Lightning
+modules, ``mnist_examples/models/mlp.py``).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import optax
+
+
+@lru_cache(maxsize=None)
+def adam(lr: float = 1e-3, b1: float = 0.9, b2: float = 0.999) -> optax.GradientTransformation:
+    return optax.adam(lr, b1=b1, b2=b2)
+
+
+@lru_cache(maxsize=None)
+def adamw(lr: float = 1e-3, weight_decay: float = 1e-4) -> optax.GradientTransformation:
+    return optax.adamw(lr, weight_decay=weight_decay)
+
+
+@lru_cache(maxsize=None)
+def sgd(lr: float = 1e-2, momentum: float = 0.9, nesterov: bool = False) -> optax.GradientTransformation:
+    return optax.sgd(lr, momentum=momentum, nesterov=nesterov)
+
+
+@lru_cache(maxsize=None)
+def adam_cosine(
+    lr: float = 1e-3, decay_steps: int = 10_000, warmup_steps: int = 100
+) -> optax.GradientTransformation:
+    """Adam with linear warmup + cosine decay (the standard LM recipe)."""
+    schedule = optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=lr, warmup_steps=warmup_steps, decay_steps=decay_steps
+    )
+    return optax.adam(schedule)
+
+
+@lru_cache(maxsize=None)
+def clipped(name: str = "adam", lr: float = 1e-3, max_norm: float = 1.0) -> optax.GradientTransformation:
+    """Global-norm gradient clipping around a base optimizer."""
+    base = {"adam": adam, "adamw": adamw, "sgd": sgd}[name](lr)
+    return optax.chain(optax.clip_by_global_norm(max_norm), base)

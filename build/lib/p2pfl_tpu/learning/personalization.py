@@ -1,0 +1,153 @@
+"""Personalized federated learning: federate the body, keep the head local.
+
+FedPer (Arivazhagan et al. 2019): each node trains the FULL model locally,
+but only the shared *body* parameters enter aggregation; the *personal*
+subtrees (typically the classification head) never leave the node. Under
+heterogeneous (non-IID) shards this lets every node keep a head fitted to
+its own label distribution while still pooling feature learning.
+
+The reference has no personalization (FedAvg over whole state dicts only,
+``p2pfl/learning/aggregators/fedavg.py``). Here it rides the existing
+seams: :meth:`get_model_update` ships the body subtree,
+:meth:`set_parameters` merges an incoming body with the local personal
+leaves, and :meth:`materialize` decodes wire payloads against the body
+template — so every transport, codec (int8/topk8), aggregator, and the
+whole round FSM work unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable
+
+import jax
+
+from p2pfl_tpu.exceptions import ModelNotMatchingError
+from p2pfl_tpu.learning.learner import JaxLearner
+from p2pfl_tpu.learning.weights import (
+    ModelUpdate,
+    _SEP,
+    _flatten_named,
+    _path_part,
+    decode_params,
+    restore_like,
+)
+
+Pytree = Any
+
+
+def _split(params: Pytree, personal: tuple[str, ...]):
+    """(body, personal) leaf masks by flattened-path prefix match."""
+    leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(params)
+    body_flags = []
+    for path, _leaf in leaves_with_path:
+        key = _SEP.join(_path_part(p) for p in path)
+        body_flags.append(not any(key == p or key.startswith(p + _SEP) for p in personal))
+    return leaves_with_path, treedef, body_flags
+
+
+class PersonalizedLearner(JaxLearner):
+    """``JaxLearner`` whose ``personal`` path prefixes stay node-local.
+
+    ``personal`` entries are flattened param paths (e.g. ``"Dense_2"`` for
+    the MLP head, or ``"layer_3/ffn"``) — everything under a prefix is
+    excluded from every outgoing update and preserved through every
+    incoming one.
+
+    Every training member of a federation must agree on the federated
+    subtree (same ``personal`` prefixes), exactly as they must agree on
+    the architecture: a plain learner mixed in cannot consume body-only
+    updates and stops itself via the model-mismatch path (the reference's
+    wrong-model semantics, ``test/node_test.py:155-176``).
+    """
+
+    def __init__(self, *args, personal: Iterable[str] = (), **kwargs) -> None:
+        super().__init__(*args, **kwargs)
+        self.personal = tuple(personal)
+        if not self.personal:
+            raise ValueError("PersonalizedLearner needs at least one personal path prefix")
+        # EVERY prefix must match something: a typo'd prefix among valid
+        # ones would otherwise silently federate the layer the user marked
+        # as never-leave-the-node
+        keys = list(_flatten_named(self.params))
+        for prefix in self.personal:
+            if not any(k == prefix or k.startswith(prefix + _SEP) for k in keys):
+                raise ValueError(f"personal prefix {prefix!r} matches no parameters")
+        _lwp, _td, flags = _split(self.params, self.personal)
+        if not any(flags):
+            raise ValueError("every parameter is personal — nothing left to federate")
+
+    # ---- outgoing: body only ----
+
+    def _body_tree(self, params: Pytree) -> dict:
+        """Nested dict holding ONLY the body leaves (personal paths absent).
+
+        A plain nested dict keeps the wire payload self-describing: the
+        receiver rebuilds against its own body template by path name.
+        """
+        leaves_with_path, _td, flags = _split(params, self.personal)
+        out: dict = {}
+        for (path, leaf), is_body in zip(leaves_with_path, flags):
+            if not is_body:
+                continue
+            parts = [_path_part(p) for p in path]
+            cur = out
+            for part in parts[:-1]:
+                cur = cur.setdefault(part, {})
+            cur[parts[-1]] = leaf
+        return out
+
+    def get_model_update(self) -> ModelUpdate:
+        update = super().get_model_update()  # anchor fields attach there
+        update.params = self._body_tree(update.params)
+        return update
+
+    def set_wire_anchor(self, params, tag: str) -> None:
+        # delta-code against the BODY anchor (the only thing on the wire)
+        super().set_wire_anchor(self._body_tree(params), tag)
+
+    # ---- incoming: merge body, keep personal ----
+
+    def set_parameters(self, params: Pytree) -> None:
+        """Accept a full tree (init) or a body-only tree (aggregates)."""
+        incoming = {
+            _SEP.join(_path_part(p) for p in path): leaf
+            for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]
+        }
+        leaves_with_path, treedef, flags = _split(self.params, self.personal)
+        merged = []
+        for (path, leaf), is_body in zip(leaves_with_path, flags):
+            key = _SEP.join(_path_part(p) for p in path)
+            if is_body:
+                if key not in incoming:
+                    raise ModelNotMatchingError(f"incoming update misses body param {key}")
+                arr = incoming[key]
+                if tuple(jax.numpy.shape(arr)) != tuple(jax.numpy.shape(leaf)):
+                    raise ModelNotMatchingError(f"shape mismatch at {key}")
+                merged.append(jax.numpy.asarray(arr).astype(leaf.dtype))
+            else:
+                merged.append(leaf)  # personal: never overwritten
+        self.params = jax.tree_util.tree_unflatten(treedef, merged)
+        if not self.keep_opt_state:
+            self.opt_state = self.tx.init(self.params)
+
+    def materialize(self, update: ModelUpdate) -> ModelUpdate:
+        if update.params is not None:
+            return update
+        anchor = getattr(self, "_wire_anchor", None)
+        tag = getattr(self, "_wire_anchor_tag", None)
+        flat = decode_params(update.encoded, anchor=anchor, anchor_tag=tag)
+        body_template = self._body_tree(self.params)
+        if set(flat) == set(_flatten_named(self.params)):
+            # a FULL-model payload (e.g. the init model from a
+            # non-personalized initiator over a byte transport):
+            # reconstruct the whole tree; set_parameters still keeps the
+            # local head when applying it
+            template = self.params
+        else:
+            template = body_template
+        out = ModelUpdate(
+            restore_like(template, flat), update.contributors, update.num_samples
+        )
+        out.anchor = anchor
+        out.anchor_tag = tag
+        return out

@@ -1,0 +1,400 @@
+"""Secure aggregation: pairwise additive masking over the gossip overlay.
+
+The reference has no privacy layer — every gossiped payload is a node's raw
+model over an insecure channel (``p2pfl/communication/grpc/grpc_server.py``,
+insecure channels throughout). This module adds the classic
+pairwise-masking scheme (Bonawitz et al., CCS'17) adapted to p2p federated
+averaging:
+
+- every node derives one shared seed per train-set peer via Diffie-Hellman
+  over the existing message gossip (a single ``secagg_pub`` broadcast at
+  experiment start — RFC 3526 group-14 modular DH, no extra dependencies);
+- before contributing its model, each node adds a mask built from those
+  seeds: ``u_i = Σ_{j≠i} sign(i,j) · (s_ij / w_i) · PRG(seed_ij, round)``
+  with pair scale ``s_ij = SECAGG_MASK_STD · sqrt(w_i · w_j)`` (sample
+  counts are announced alongside the DH keys) and ``sign(i,j) = +1`` iff
+  ``addr_i < addr_j`` — antisymmetric, so in the sample-weighted FedAvg
+  sum ``Σ w_i (p_i + u_i) = Σ w_i p_i`` the masks cancel **exactly
+  pairwise** (up to float32 rounding). The sqrt law keeps the mask's
+  magnitude ``STD · sqrt(w_j / w_i)`` per pair — independent of the
+  absolute dataset size, unlike a naive ``c / w_i`` scale that would leave
+  large-dataset nodes effectively unmasked;
+- FedAvg's partial-aggregation algebra is linear in the weighted sums, so
+  masked partials combine correctly through every gossip hop; the true
+  model only materializes once the full train set is covered.
+
+What a wire snoop sees is a single masked model — Gaussian noise of scale
+``Settings.SECAGG_MASK_STD`` riding on the parameters, useless without the
+other train-set members' masks.
+
+**Threat model: passive wire snooping only.** The protected asset is the
+model payload crossing an insecure channel; the adversary reads traffic
+but does not inject or reorder control messages. Active attackers are out
+of scope — control messages (votes, heartbeats, key announcements,
+coverage) are unauthenticated plaintext, exactly like the reference's
+insecure channels. Two hardenings still apply against cheap active
+tricks: degenerate DH keys are rejected (:func:`valid_public_key`) and
+the FIRST key announced per (peer, experiment) is latched — a later
+``secagg_pub`` claiming the same source cannot replace it
+(``commands/control.py``).
+
+Dropout recovery (Bonawitz-style seed re-disclosure): when aggregation
+times out with partial train-set coverage, the leftover pairwise masks
+between survivors and the dropped nodes do not cancel. Survivors then
+re-disclose their pair seeds *for the dropped nodes only*
+(``secagg_recover`` messages), letting every aggregating node subtract
+the exact uncancelled sum (:func:`dropout_correction`) and recover the
+survivors' clean aggregate — availability degrades to a partial
+aggregate, like the reference's plain path
+(``p2pfl/learning/aggregators/aggregator.py:236-242``), instead of a
+destroyed model. Residual risk, documented: if a "dropped" node's masked
+update was captured on the wire but never reached an aggregator, the
+disclosed seeds could unmask that single update; the same applies to a
+node declared missing by SOME survivors' coverage views but not others
+(disclosures cover the union of announced missing sets, trading that
+node's single-update privacy for round availability). The full Bonawitz
+double-mask (a self-mask whose shares are never disclosed together with
+the pair seeds) closes this; under the passive-snooping threat model the
+race requires adversarial timing that is out of scope. A lone survivor
+never discloses anything — it corrects locally (its "aggregate" is its
+own model, which aggregation cannot protect anyway).
+
+Limits (documented, matching the protocol's nature):
+
+- FedAvg only: robust aggregators (Krum/median/...) need individual
+  models, which is exactly what masking forbids.
+- Wire compression must be off (``WIRE_COMPRESSION="none"``): per-node
+  quantization of the masks breaks exact cancellation. Checked at
+  experiment start.
+- A node holding the overwhelming majority of the federation's samples
+  gets a small mask (``STD·sqrt((W−w_i)/w_i)``) — but such a node's update
+  IS essentially the aggregate, so aggregation itself offers it no privacy
+  regardless of masking.
+
+The SPMD mesh runtime (``parallel/spmd.py``) deliberately does not mask:
+it is a single-process simulation where "nodes" are device slots — there
+is no wire to protect, and the all-reduce is already the trusted
+aggregator. :func:`masked_stack` exposes the same masking as a pure jitted
+op for device-side verification (see ``tests/test_secagg.py``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import secrets
+from typing import Any, Optional
+
+import numpy as np
+
+from p2pfl_tpu.learning.weights import ModelUpdate, _flatten_named
+from p2pfl_tpu.management.logger import logger
+from p2pfl_tpu.settings import Settings
+
+Pytree = Any
+
+# RFC 3526 group 14: 2048-bit MODP prime, generator 2.
+DH_PRIME = int(
+    "FFFFFFFFFFFFFFFFC90FDAA22168C234C4C6628B80DC1CD129024E088A67CC74"
+    "020BBEA63B139B22514A08798E3404DDEF9519B3CD3A431B302B0A6DF25F1437"
+    "4FE1356D6D51C245E485B576625E7EC6F44C42E9A637ED6B0BFF5CB6F406B7ED"
+    "EE386BFB5A899FA5AE9F24117C4B1FE649286651ECE45B3DC2007CB8A163BF05"
+    "98DA48361C55D39A69163FA8FD24CF5F83655D23DCA3AD961C62F356208552BB"
+    "9ED529077096966D670C354E4ABC9804F1746C08CA18217C32905E462E36CE3B"
+    "E39E772C180E86039B2783A2EC07A28FB5C55DF06F4C52C9DE2BCBF695581718"
+    "3995497CEA956AE515D2261898FA051015728E5A8AACAA68FFFFFFFFFFFFFFFF",
+    16,
+)
+DH_GENERATOR = 2
+
+
+def dh_keypair() -> tuple[int, int]:
+    """A fresh (private, public) modular Diffie-Hellman pair."""
+    priv = secrets.randbits(256)
+    return priv, pow(DH_GENERATOR, priv, DH_PRIME)
+
+
+def valid_public_key(pub: int) -> bool:
+    """Range check for a peer's DH public key.
+
+    Rejects the degenerate elements 0, 1, p-1 (and anything out of range):
+    with pub=1 every shared secret is 1, so an active sender spoofing
+    ``secagg_pub`` messages could make a victim's mask seeds computable
+    from public information and strip its masks off the wire.
+    """
+    return 2 <= pub <= DH_PRIME - 2
+
+
+def dh_pair_seed(priv: int, peer_pub: int, context: str) -> int:
+    """The shared 256-bit PRG key for one (self, peer) pair.
+
+    Symmetric: both ends compute ``g^(xy) mod p`` and hash it with the
+    experiment context, so seed(x, g^y) == seed(y, g^x).
+    """
+    if not valid_public_key(peer_pub):
+        from p2pfl_tpu.exceptions import SecAggError
+
+        raise SecAggError("degenerate DH public key (value outside [2, p-2])")
+    shared = pow(peer_pub, priv, DH_PRIME)
+    h = hashlib.sha256(shared.to_bytes(256, "big") + context.encode("utf-8"))
+    return int.from_bytes(h.digest(), "big")
+
+
+def _leaf_mask(seed: int, round_no: int, shape: tuple, li: int) -> np.ndarray:
+    """Deterministic N(0,1) mask block — same stream on both ends of a pair.
+
+    Keyed by (pair seed, round, leaf index) so masks are fresh every round
+    (a reused mask would leak the round-to-round parameter delta). The
+    stream is SHAKE-256 in XOF mode mapped through Box–Muller: a keyed
+    CSPRNG whose byte stream is defined by the hash standard on every
+    platform/library version — unlike NumPy's PCG64, whose stream is only
+    stable within a NumPy version line and is not cryptographic. The
+    Box–Muller ``log``/``cos``/``sin`` are not IEEE-correctly-rounded, so
+    heterogeneous numpy/libm builds may differ by ~1 ulp per value; the
+    resulting uncancelled residual is O(STD·2⁻²³) per pair — the same
+    class as the float32 addition rounding the protocol already tolerates
+    (vs. PCG64 version drift, which would diverge the ENTIRE stream).
+    """
+    n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+    m = 2 * ((n + 1) // 2)  # even count for Box–Muller pairing
+    material = hashlib.shake_256(
+        b"p2pfl-secagg-mask\x00"
+        + seed.to_bytes(32, "big")
+        + round_no.to_bytes(8, "big")
+        + li.to_bytes(8, "big")
+    ).digest(8 * m)
+    x = np.frombuffer(material, dtype=">u8").astype(np.float64)
+    u = (x + 1.0) * 2.0**-64  # uniform in (0, 1]; log() is safe
+    half = m // 2
+    r = np.sqrt(-2.0 * np.log(u[:half]))
+    theta = (2.0 * np.pi) * u[half:]
+    z = np.concatenate([r * np.cos(theta), r * np.sin(theta)])[:n]
+    return z.astype(np.float32).reshape(shape)
+
+
+def pairwise_mask(
+    template: Pytree,
+    my_addr: str,
+    pair_seeds: dict[str, int],
+    round_no: int,
+    pair_scales: Optional[dict[str, float]] = None,
+) -> dict[str, np.ndarray]:
+    """This node's total mask as a flat {path: array} dict.
+
+    The weighted sum over the full train set telescopes to zero because
+    each pair (i, j) contributes ``+s_ij·PRG(seed_ij)`` on one side and
+    ``-s_ij·PRG(seed_ij)`` on the other (``pair_scales[j] = s_ij``, the
+    SAME value on both ends).
+    """
+    flat = _flatten_named(template)
+    keys = sorted(flat)
+    out: dict[str, np.ndarray] = {k: np.zeros(flat[k].shape, np.float32) for k in keys}
+    for peer, seed in pair_seeds.items():
+        sign = 1.0 if my_addr < peer else -1.0
+        s = 1.0 if pair_scales is None else pair_scales[peer]
+        for li, k in enumerate(keys):
+            out[k] += (sign * s) * _leaf_mask(seed, round_no, flat[k].shape, li)
+    return out
+
+
+def pair_scale(w_i: float, w_j: float) -> float:
+    """The pair mask scale ``s_ij = STD·sqrt(w_i·w_j)`` — symmetric, from
+    the ANNOUNCED sample counts (both masking and dropout correction must
+    use the same values, which is why :func:`mask_update` latches the
+    announced count against the actual one)."""
+    return Settings.SECAGG_MASK_STD * float(np.sqrt(float(w_i) * float(w_j)))
+
+
+def mask_update(
+    update: ModelUpdate,
+    my_addr: str,
+    train_set: list[str],
+    priv: int,
+    pubs: dict[str, tuple[int, int]],
+    experiment: str,
+    round_no: int,
+    announced_samples: Optional[int] = None,
+) -> ModelUpdate:
+    """Mask a node's own contribution before it enters the aggregator.
+
+    ``pubs`` maps peer address → (DH public key, announced sample count);
+    the pair scale ``s_ij = STD·sqrt(w_i·w_j)`` needs both ends' counts.
+
+    Raises :class:`SecAggError` when masking cannot be done safely (missing
+    peer keys, zero sample weight, non-float32 parameters, lossy wire
+    compression). The caller must then SKIP contributing rather than send
+    unmasked: peers already derived this node's pair seeds and will add
+    their half of the pairwise masks regardless, so an unmasked (or
+    zero-weighted, or rounding-lossy) contribution leaves uncancelled mask
+    terms in a full-coverage aggregate — noise that nothing would detect.
+    An aborted contribution instead leaves coverage incomplete, which
+    ``wait_and_get_aggregation`` reports as a loud SecAgg error on every
+    node.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from p2pfl_tpu.exceptions import SecAggError
+
+    peers = [n for n in train_set if n != my_addr]
+    if not peers:
+        return update
+    if Settings.WIRE_COMPRESSION != "none":
+        # int8/topk8 would quantize each node's masks independently; the
+        # per-node quantization residue survives the FedAvg sum exactly
+        # like the bf16 rounding residue rejected below
+        raise SecAggError(
+            f"WIRE_COMPRESSION={Settings.WIRE_COMPRESSION!r} breaks mask "
+            "cancellation; secure aggregation needs a lossless wire"
+        )
+    missing = [n for n in peers if n not in pubs]
+    if missing:
+        raise SecAggError(f"missing DH public keys for train-set peers {missing}")
+    if update.num_samples <= 0:
+        # FedAvg would weight this row by 0, annihilating our masks while
+        # peers' matching pair terms survive — cancellation breaks
+        raise SecAggError("cannot mask a contribution with zero sample weight")
+    if announced_samples is not None and update.num_samples != announced_samples:
+        # peers scale their half of each pair mask with the count WE
+        # announced alongside our DH key; masking with a different actual
+        # weight would leave a residual that survives a FULL-coverage
+        # aggregate — noise that no coverage check can detect
+        raise SecAggError(
+            f"num_samples changed since the key announcement "
+            f"({announced_samples} announced, {update.num_samples} now); "
+            "mask cancellation would silently break"
+        )
+    if any(w <= 0 for _p, w in pubs.values()):
+        raise SecAggError("a peer announced a non-positive sample count")
+    bad_dtypes = {
+        str(jnp.asarray(leaf).dtype)
+        for leaf in jax.tree_util.tree_leaves(update.params)
+        if jnp.asarray(leaf).dtype != jnp.float32
+    }
+    if bad_dtypes:
+        # mask cancellation is exact only in float32: casting params+mask to
+        # a narrower dtype (bf16 has an 8-bit mantissa) quantizes each
+        # node's mask independently, and the rounding residue — ~0.4% of
+        # the mask's magnitude, i.e. comparable to the weights themselves —
+        # survives the FedAvg sum
+        raise SecAggError(
+            f"params contain {sorted(bad_dtypes)} leaves; secure aggregation "
+            "requires float32 parameters (use param_dtype=float32 — bf16 "
+            "compute is unaffected)"
+        )
+    w_i = float(update.num_samples)
+    seeds = {n: dh_pair_seed(priv, pubs[n][0], experiment) for n in peers}
+    # s_ij/w_i with s_ij = STD·sqrt(w_i·w_j): per-pair magnitude
+    # STD·sqrt(w_j/w_i), never vanishing with absolute dataset size
+    scales = {n: pair_scale(w_i, pubs[n][1]) / w_i for n in peers}
+    masks = pairwise_mask(update.params, my_addr, seeds, round_no, scales)
+
+    from p2pfl_tpu.learning.weights import named_leaves
+
+    treedef, keyed = named_leaves(update.params)
+    masked = jax.tree_util.tree_unflatten(
+        treedef, [jnp.asarray(leaf, jnp.float32) + masks[key] for key, leaf in keyed]
+    )
+    return ModelUpdate(masked, list(update.contributors), update.num_samples)
+
+
+def dropout_correction(
+    template: Pytree,
+    survivors: list[str],
+    missing: list[str],
+    seeds: dict[tuple[str, str], int],
+    weights: dict[str, int],
+    round_no: int,
+) -> dict[str, np.ndarray]:
+    """The uncancelled mask sum left by dropped train-set members.
+
+    In the sample-weighted sum ``Σ_{i∈survivors} w_i·y_i`` each survivor i
+    contributes, for every missing peer j, the term
+    ``sign(i,j)·s_ij·PRG(seed_ij, round)`` — j's matching opposite term
+    never arrived. This returns that double sum as a flat {path: array}
+    dict; subtracting it (divided by the survivors' total weight) from the
+    partial aggregate recovers the survivors' clean weighted mean.
+
+    ``seeds`` maps (survivor, missing) → the pair seed — each survivor
+    knows its own pair seeds and re-discloses them via ``secagg_recover``
+    gossip; ``weights`` maps every involved address to its ANNOUNCED
+    sample count (the same values the masks were scaled with — enforced by
+    :func:`mask_update`'s announced-count latch). Pairs between two
+    missing nodes need no correction (neither side contributed), and pairs
+    between two survivors cancelled normally.
+    """
+    flat = _flatten_named(template)
+    keys = sorted(flat)
+    out: dict[str, np.ndarray] = {k: np.zeros(flat[k].shape, np.float32) for k in keys}
+    for i in survivors:
+        for j in missing:
+            sign = 1.0 if i < j else -1.0
+            s = pair_scale(weights[i], weights[j])
+            seed = seeds[(i, j)]
+            for li, k in enumerate(keys):
+                out[k] += (sign * s) * _leaf_mask(seed, round_no, flat[k].shape, li)
+    return out
+
+
+def apply_dropout_correction(
+    params: Pytree,
+    correction: dict[str, np.ndarray],
+    survivor_weight: float,
+) -> Pytree:
+    """Subtract ``correction / survivor_weight`` from a params pytree.
+
+    The partial aggregate is the weighted MEAN over survivors, so the
+    weighted-sum-domain correction is divided by their total weight.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from p2pfl_tpu.learning.weights import named_leaves
+
+    treedef, keyed = named_leaves(params)
+    return jax.tree_util.tree_unflatten(
+        treedef,
+        [
+            jnp.asarray(leaf, jnp.float32) - correction[key] / np.float32(survivor_weight)
+            for key, leaf in keyed
+        ],
+    )
+
+
+def masked_stack(params_stack: Pytree, weights, key, scale: float = None) -> Pytree:
+    """Device-side pairwise masking of a node-stacked ``[N, ...]`` pytree.
+
+    Pure jitted op mirroring the host protocol's math: per-pair N(0,1)
+    blocks from ``jax.random.fold_in``, antisymmetric signs, pair scale
+    ``scale·sqrt(w_i·w_j)`` applied as ``s_ij/w_i`` on node i — so the
+    sample-weighted FedAvg of the result equals that of the input (to
+    float32 rounding) while every node's mask magnitude stays O(scale).
+    Used to verify cancellation on an 8-device mesh without any wire.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if scale is None:
+        scale = Settings.SECAGG_MASK_STD
+    n = weights.shape[0]
+
+    def node_mask(i, leaf_key, shape):
+        def pair(j):
+            lo, hi = jnp.minimum(i, j), jnp.maximum(i, j)
+            pk = jax.random.fold_in(jax.random.fold_in(leaf_key, lo), hi)
+            sign = jnp.where(i < j, 1.0, -1.0) * jnp.where(i == j, 0.0, 1.0)
+            s = scale * jnp.sqrt(weights[i] * weights[j]) / weights[i]
+            return (sign * s) * jax.random.normal(pk, shape, jnp.float32)
+
+        return sum(pair(jnp.uint32(j)) for j in range(n))
+
+    def mask_leaf(li_key, leaf):
+        per_node = jax.vmap(
+            lambda i: node_mask(i, li_key, leaf.shape[1:])
+        )(jnp.arange(n, dtype=jnp.uint32))
+        return (leaf.astype(jnp.float32) + per_node).astype(leaf.dtype)
+
+    leaves, treedef = jax.tree_util.tree_flatten(params_stack)
+    keys = jax.random.split(key, len(leaves))
+    return jax.tree_util.tree_unflatten(
+        treedef, [mask_leaf(k, leaf) for k, leaf in zip(keys, leaves)]
+    )

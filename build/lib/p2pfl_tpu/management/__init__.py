@@ -1,0 +1,1 @@
+"""Management / observability: logger facade, metric storage, node monitor."""

@@ -1,0 +1,75 @@
+"""Per-node resource telemetry.
+
+Reference: ``p2pfl/management/node_monitor.py:31-86`` — a daemon thread
+sampling CPU% / RAM% / network MB/s every ``RESOURCE_MONITOR_PERIOD``.
+Added here: per-device TPU/accelerator memory stats via
+``jax.local_devices()[i].memory_stats()`` where the backend exposes them —
+the number that actually matters on a chip.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from p2pfl_tpu.management.logger import logger
+from p2pfl_tpu.settings import Settings
+
+ReportFn = Callable[[str, str, float], None]  # (node, metric, value)
+
+
+def _default_report(node: str, metric: str, value: float) -> None:
+    logger.log_metric(node, metric, value, step=int(time.time()))
+
+
+class NodeMonitor:
+    def __init__(self, node: str, report_fn: Optional[ReportFn] = None) -> None:
+        self.node = node
+        self._report = report_fn or _default_report
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_net: Optional[tuple[float, float, float]] = None
+
+    def start(self) -> None:
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, name=f"monitor-{self.node}", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    def _run(self) -> None:
+        try:
+            import psutil
+        except ImportError:  # psutil is present in this image, but stay robust
+            logger.debug(self.node, "psutil unavailable — resource monitor disabled")
+            return
+        while not self._stop.is_set():
+            try:
+                self._report(self.node, "cpu_percent", psutil.cpu_percent(interval=None))
+                self._report(self.node, "ram_percent", psutil.virtual_memory().percent)
+                net = psutil.net_io_counters()
+                now = time.monotonic()
+                if self._last_net is not None:
+                    t0, sent0, recv0 = self._last_net
+                    dt = max(now - t0, 1e-6)
+                    self._report(self.node, "net_out_mbs", (net.bytes_sent - sent0) / dt / 1e6)
+                    self._report(self.node, "net_in_mbs", (net.bytes_recv - recv0) / dt / 1e6)
+                self._last_net = (now, net.bytes_sent, net.bytes_recv)
+                self._report_device_memory()
+            except Exception as exc:  # noqa: BLE001 — telemetry must never kill a node
+                logger.debug(self.node, f"monitor sample failed: {exc}")
+            if self._stop.wait(timeout=Settings.RESOURCE_MONITOR_PERIOD):
+                return
+
+    def _report_device_memory(self) -> None:
+        import jax
+
+        for i, dev in enumerate(jax.local_devices()):
+            stats = getattr(dev, "memory_stats", lambda: None)()
+            if stats and "bytes_in_use" in stats:
+                self._report(self.node, f"device{i}_mem_mb", stats["bytes_in_use"] / 1e6)

@@ -1,0 +1,126 @@
+"""Tracing / profiling helpers.
+
+The reference has no tracing at all (SURVEY §5: wall-clock prints only);
+``jax.profiler`` integration is the idiomatic TPU upgrade: traces capture
+XLA op timelines, collective latencies and host↔device transfers, viewable
+in TensorBoard/Perfetto.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Optional
+
+import jax
+
+from p2pfl_tpu.management.logger import logger
+
+
+@contextlib.contextmanager
+def trace(log_dir: str = "/tmp/p2pfl_tpu_trace") -> Iterator[None]:
+    """Capture a jax.profiler trace for the enclosed block."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        logger.info("profiler", f"trace written to {log_dir}")
+
+
+@contextlib.contextmanager
+def annotate(name: str, step: Optional[int] = None) -> Iterator[None]:
+    """Label the enclosed device work in the trace timeline."""
+    with jax.profiler.StepTraceAnnotation(name, step_num=step or 0):
+        yield
+
+
+# bf16 peak matmul FLOP/s per chip by device kind (public spec sheets);
+# used to turn achieved FLOP/s into model-FLOPs-utilization
+_PEAK_FLOPS = {
+    "TPU v2": 45e12,
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def peak_flops(device=None) -> Optional[float]:
+    """Peak FLOP/s for a device (None when unknown, e.g. CPU)."""
+    device = device or jax.devices()[0]
+    kind = getattr(device, "device_kind", "")
+    for name, peak in _PEAK_FLOPS.items():
+        if kind.lower().startswith(name.lower()):
+            return peak
+    return None
+
+
+def force_execution(tree) -> float:
+    """Block until ``tree``'s pending computation REALLY finished.
+
+    ``jax.block_until_ready`` is not a reliable barrier on remote-attached
+    platforms (the axon TPU tunnel acks buffer readiness before the device
+    is done — measured 9× under-reads on round timings); a device-to-host
+    fetch is. Fetches a SINGLE element (a tiny on-device slice that depends
+    on the pending computation), so the barrier itself moves O(bytes) — a
+    whole-leaf fetch would bill megabytes of tunnel transfer to whatever
+    the caller is timing. All benchmark timers use this.
+    """
+    import numpy as np
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return 0.0
+    leaf = leaves[0]
+    ndim = getattr(leaf, "ndim", None)
+    if not ndim:  # Python scalar or 0-d array: nothing to slice
+        return float(np.asarray(leaf))
+    return float(np.asarray(leaf[(0,) * ndim]))
+
+
+def compiled_flops(jitted, *args, **kwargs) -> Optional[float]:
+    """Total FLOPs of one execution, from the compiled XLA cost analysis."""
+    try:
+        cost = jitted.lower(*args, **kwargs).compile().cost_analysis()
+        if isinstance(cost, list):  # older jax returns one dict per device
+            cost = cost[0]
+        return float(cost["flops"])
+    except Exception:  # noqa: BLE001 — cost analysis is best-effort
+        return None
+
+
+def mfu(flops: Optional[float], seconds: float, n_devices: int = 1) -> Optional[float]:
+    """Model-FLOPs-utilization: achieved FLOP/s over aggregate peak FLOP/s."""
+    peak = peak_flops()
+    if flops is None or peak is None or seconds <= 0:
+        return None
+    return flops / seconds / (peak * n_devices)
+
+
+class Stopwatch:
+    """Cheap wall-clock section timing (the reference's --measure_time,
+    generalized): ``with sw.section("fit"): ...`` then ``sw.summary()``."""
+
+    def __init__(self) -> None:
+        self.totals: dict[str, float] = {}
+        self.counts: dict[str, int] = {}
+
+    @contextlib.contextmanager
+    def section(self, name: str) -> Iterator[None]:
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            self.totals[name] = self.totals.get(name, 0.0) + time.monotonic() - t0
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    def summary(self) -> dict[str, dict[str, float]]:
+        return {
+            k: {"total_s": round(v, 4), "calls": self.counts[k], "mean_s": round(v / self.counts[k], 4)}
+            for k, v in self.totals.items()
+        }

@@ -1,0 +1,120 @@
+"""Stall watchdog: thread-stack dumps when a learning round stops moving.
+
+The reference has no deadlock/stall diagnostics (SURVEY §5 — concurrency
+safety is hand-rolled locks, and a wedged round just hangs until a human
+attaches a debugger). Here every stage transition stamps
+``NodeState.last_transition``; a daemon thread watches all locally
+registered learning nodes and, when one sits in the same stage longer
+than ``Settings.STALL_WATCHDOG_S``, logs the stuck node/stage plus a
+stack trace of EVERY live thread (``sys._current_frames``) — gossip
+loops, heartbeaters, gRPC executors, the learning thread — which is
+exactly the information needed to see which wait wedged. Detection only:
+it never kills anything (the timeout/eviction machinery owns recovery).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+from typing import Optional
+
+from p2pfl_tpu.management.logger import logger
+from p2pfl_tpu.settings import Settings
+
+
+class StallWatchdog:
+    """Singleton daemon; started lazily by ``Node.start()`` when
+    ``Settings.STALL_WATCHDOG_S > 0``."""
+
+    _instance: Optional["StallWatchdog"] = None
+    _lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        #: monotonic time of the last dump per node — one report per stall,
+        #: not one per poll tick
+        self._reported: dict[str, float] = {}
+
+    # ---- lifecycle ----
+
+    @classmethod
+    def ensure_started(cls) -> Optional["StallWatchdog"]:
+        if Settings.STALL_WATCHDOG_S <= 0:
+            return None
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = cls()
+                cls._instance._start()
+            return cls._instance
+
+    @classmethod
+    def shutdown(cls) -> None:
+        with cls._lock:
+            if cls._instance is not None:
+                cls._instance._stop.set()
+                cls._instance = None
+
+    def _start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name="stall-watchdog", daemon=True
+        )
+        self._thread.start()
+
+    # ---- detection ----
+
+    def _run(self) -> None:
+        while True:
+            # re-read each tick so lowering/raising the knob takes effect;
+            # floor at 0.1s so S=0 (set after start, meaning "disable")
+            # pauses scanning instead of busy-spinning
+            period = max(min(1.0, Settings.STALL_WATCHDOG_S / 4), 0.1)
+            if self._stop.wait(period):
+                return
+            try:
+                self._scan()
+            except Exception:  # noqa: BLE001 — diagnostics must never take a node down
+                pass
+
+    def _scan(self) -> None:
+        if Settings.STALL_WATCHDOG_S <= 0:
+            return  # disabled after start
+        now = time.monotonic()
+        states = logger.learning_states()
+        # prune report latches of unregistered nodes (the daemon outlives
+        # short-lived simulation nodes; the dict must not grow unboundedly)
+        live = {a for a, _s in states}
+        for gone in [a for a in self._reported if a not in live]:
+            self._reported.pop(gone, None)
+        for addr, state in states:
+            last = getattr(state, "last_transition", None)
+            if last is None or state.status != "Learning":
+                self._reported.pop(addr, None)
+                continue
+            if now - last < Settings.STALL_WATCHDOG_S:
+                self._reported.pop(addr, None)
+                continue
+            if self._reported.get(addr) == last:
+                continue  # this stall (same stuck transition) already reported
+            self._reported[addr] = last
+            stage = getattr(state, "current_stage", "?")
+            logger.error(
+                addr,
+                f"STALL: no stage transition for {now - last:.0f}s "
+                f"(stuck in {stage}, round {state.round}). Thread stacks:\n"
+                + all_thread_stacks(),
+            )
+
+
+def all_thread_stacks() -> str:
+    """Formatted stacks of every live thread, tagged with thread names."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for ident, frame in sys._current_frames().items():
+        out.append(
+            f"--- thread {names.get(ident, ident)} ---\n"
+            + "".join(traceback.format_stack(frame))
+        )
+    return "\n".join(out)

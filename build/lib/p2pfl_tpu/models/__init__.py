@@ -1,0 +1,14 @@
+"""Model zoo: flax modules wrapped for federated use.
+
+Reference models: MLP 784-256-128-10 (``mnist_examples/models/mlp.py:53-56``)
+and a 2-conv CNN (``models/cnn.py:55-71``). Added for the BASELINE configs:
+ResNet-18/50 (CIFAR) and a LoRA transformer (federated fine-tune).
+"""
+
+from p2pfl_tpu.models.base import FlaxModel
+from p2pfl_tpu.models.vision import CNN, MLP, ResNet, ViT, cnn, mlp, resnet18, resnet50, vit
+
+__all__ = [
+    "FlaxModel", "MLP", "CNN", "ResNet", "ViT",
+    "mlp", "cnn", "resnet18", "resnet50", "vit",
+]

@@ -1,0 +1,67 @@
+"""Model wrapper: a flax module + its parameters + metadata.
+
+The reference couples model code to PyTorch Lightning modules; here a model
+is (pure apply function, params pytree). Two instances of the same
+architecture share one jit cache entry because linen modules are frozen
+dataclasses with structural equality — N simulated nodes compile each step
+exactly once.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+
+
+def apply_with_aux(module: Any, params: Pytree, x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Apply + total auxiliary loss sown into the ``"moe_losses"`` collection.
+
+    MoE layers sow their load-balance/z-loss scalars there
+    (``models/transformer.py:MoEMLP``); training losses must include the
+    sum or the router never learns to balance. For models without sown
+    losses the collection is empty and the aux term is 0 — the extra
+    ``mutable`` plumbing is free under jit.
+    """
+    out, mut = module.apply({"params": params}, x, mutable=["moe_losses"])
+    leaves = jax.tree.leaves(mut)
+    aux = sum(leaves) if leaves else jnp.zeros((), jnp.float32)
+    return out, aux
+
+
+@dataclass
+class FlaxModel:
+    """A flax module bound to a concrete parameter pytree."""
+
+    module: Any  # flax.linen.Module
+    params: Pytree
+    input_shape: tuple[int, ...]  # per-example shape, no batch dim
+    num_classes: int = 10
+    extra: dict = field(default_factory=dict)
+
+    @classmethod
+    def create(
+        cls,
+        module: Any,
+        input_shape: tuple[int, ...],
+        seed: int = 0,
+        num_classes: int = 10,
+    ) -> "FlaxModel":
+        rng = jax.random.PRNGKey(seed)
+        dummy = jnp.zeros((1, *input_shape), dtype=jnp.float32)
+        variables = module.init(rng, dummy)
+        return cls(module, variables["params"], input_shape, num_classes)
+
+    def apply(self, params: Pytree, x: jax.Array) -> jax.Array:
+        return self.module.apply({"params": params}, x)
+
+    def apply_with_aux(self, params: Pytree, x: jax.Array) -> tuple[jax.Array, jax.Array]:
+        return apply_with_aux(self.module, params, x)
+
+    @property
+    def param_count(self) -> int:
+        return sum(int(p.size) for p in jax.tree.leaves(self.params))

@@ -1,0 +1,381 @@
+"""Decoder-only transformer (TinyLlama-style) with LoRA adapters.
+
+BASELINE config 5: federated LoRA fine-tuning — nodes train and exchange
+ONLY the low-rank adapters, so a round's gossip payload drops from the full
+model to a few MB. Architecture follows the Llama recipe (RMSNorm → GQA
+attention with RoPE → SwiGLU), all matmuls in bfloat16 on the MXU, norms and
+softmax statistics in float32.
+
+Attention backends — pick with ``tiny_transformer(attn=...)``:
+
+- ``"dense"`` (default): fused XLA causal attention (``ops/attention.py``);
+- ``"flash"``: the Pallas flash kernel with its Pallas backward
+  (``ops/flash_attention.py``) — O(T·D) memory in both directions;
+- ``"ring"``: ring attention over a mesh axis (pass ``mesh=``) — the
+  sequence is sharded across chips, K/V rotate via ``ppermute``.
+
+Power users can instead pass any ``attn_fn(q, k, v) -> out`` directly.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from p2pfl_tpu.models.base import FlaxModel
+from p2pfl_tpu.ops.attention import causal_attention
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 2048
+    dim: int = 256
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 4
+    ffn_hidden: int = 688  # ~8/3 * dim rounded
+    rope_theta: float = 10000.0
+    lora_rank: int = 8
+    lora_alpha: float = 16.0
+    lora_mlp: bool = False
+    dtype: Any = jnp.bfloat16
+    # Mixture-of-experts FFN (n_experts=0 => dense SwiGLU everywhere).
+    # Experts stack on a leading [E, ...] axis that shards over the mesh's
+    # model axis for expert parallelism (parallel/sharding.py EP rules).
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity: float = 1.25  # capacity factor: C = ceil(k*S/E * factor)
+    moe_aux_coef: float = 1e-2  # Switch load-balance loss coefficient
+    moe_zloss_coef: float = 1e-3  # router z-loss coefficient
+
+
+class RMSNorm(nn.Module):
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        xf = x.astype(jnp.float32)
+        norm = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + 1e-6)
+        return (norm * scale).astype(self.dtype)
+
+
+class LoRADense(nn.Module):
+    """Dense with optional low-rank adapter: ``y = xW + (alpha/r)·xAB``.
+
+    ``A`` is normal-initialized, ``B`` zeros — adapters start as identity.
+    Param names carry the ``lora_`` prefix the federated layer filters on.
+    """
+
+    features: int
+    rank: int = 0
+    alpha: float = 16.0
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(), (x.shape[-1], self.features)
+        )
+        y = jnp.dot(x.astype(self.dtype), kernel.astype(self.dtype))
+        if self.rank > 0:
+            a = self.param(
+                "lora_a", nn.initializers.normal(0.02), (x.shape[-1], self.rank)
+            )
+            b = self.param("lora_b", nn.initializers.zeros, (self.rank, self.features))
+            y = y + jnp.dot(
+                jnp.dot(x.astype(self.dtype), a.astype(self.dtype)), b.astype(self.dtype)
+            ) * (self.alpha / self.rank)
+        return y
+
+
+def rope(x: jax.Array, theta: float) -> jax.Array:
+    """Rotary position embedding over [B, T, H, D] (D even)."""
+    b, t, h, d = x.shape
+    half = d // 2
+    freqs = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    ang = jnp.arange(t, dtype=jnp.float32)[:, None] * freqs[None, :]  # [T, half]
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    cos, sin = cos[None, :, None, :], sin[None, :, None, :]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1).astype(x.dtype)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+    attn_fn: Optional[Callable] = None  # (q, k, v) -> out; default fused causal
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        head_dim = cfg.dim // cfg.n_heads
+        dense = partial(LoRADense, rank=cfg.lora_rank, alpha=cfg.lora_alpha, dtype=cfg.dtype)
+        q = dense(cfg.n_heads * head_dim, name="wq")(x)
+        k = dense(cfg.n_kv_heads * head_dim, name="wk")(x)
+        v = dense(cfg.n_kv_heads * head_dim, name="wv")(x)
+        b, t = x.shape[:2]
+        q = rope(q.reshape(b, t, cfg.n_heads, head_dim), cfg.rope_theta)
+        k = rope(k.reshape(b, t, cfg.n_kv_heads, head_dim), cfg.rope_theta)
+        v = v.reshape(b, t, cfg.n_kv_heads, head_dim)
+        # GQA: repeat K/V heads to match Q heads
+        rep = cfg.n_heads // cfg.n_kv_heads
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+        attend = self.attn_fn or causal_attention
+        out = attend(q, k, v).reshape(b, t, cfg.dim)
+        return dense(cfg.dim, name="wo")(out)
+
+
+class MLP(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        rank = cfg.lora_rank if cfg.lora_mlp else 0
+        dense = partial(LoRADense, rank=rank, alpha=cfg.lora_alpha, dtype=cfg.dtype)
+        gate = dense(cfg.ffn_hidden, name="w1")(x)
+        up = dense(cfg.ffn_hidden, name="w3")(x)
+        return dense(cfg.dim, name="w2")(nn.silu(gate) * up)
+
+
+class MoEMLP(nn.Module):
+    """Mixture-of-experts SwiGLU FFN with capacity-based dense dispatch.
+
+    The GShard/Switch formulation: routing becomes two einsums against a
+    [S, E, C] dispatch tensor, so the whole layer is MXU matmuls with
+    static shapes — no gather/scatter, no dynamic shapes, nothing XLA
+    can't tile. Expert weights stack on a leading [E, ...] axis; sharding
+    that axis over the ``model`` mesh axis is expert parallelism (XLA
+    turns the dispatch/combine einsums into the token all-to-alls).
+
+    Tokens beyond an expert's capacity ``C = ceil(k·S/E · capacity)`` are
+    dropped (their combine weight is zero — the residual stream carries
+    them unchanged, the standard Switch behavior).
+
+    Two auxiliary scalars are sown into the ``"moe_losses"`` collection
+    (read back via :func:`p2pfl_tpu.models.base.apply_with_aux`):
+    the Switch load-balance loss ``E · Σ_e f_e · p̄_e`` and the router
+    z-loss ``mean(logsumexp(logits)²)``.
+
+    The reference has no MoE anywhere (its models are MLP/CNN,
+    SURVEY §2.7) — this extends the transformer family for the
+    expert-parallel axis of the multi-chip design.
+    """
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        e, k = cfg.n_experts, cfg.moe_top_k
+        b, t, d = x.shape
+        s = b * t
+        f = cfg.ffn_hidden
+        xs = x.reshape(s, d)
+
+        router = self.param("router", nn.initializers.normal(0.02), (d, e))
+        logits = jnp.dot(xs.astype(jnp.float32), router.astype(jnp.float32))  # [S, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+
+        capacity = max(1, int(-(-k * s // e) * cfg.moe_capacity))
+
+        # iterative top-k dispatch with a running per-expert fill count
+        combine = jnp.zeros((s, e, capacity), jnp.float32)
+        counts = jnp.zeros((e,), jnp.float32)
+        p = probs
+        top1_onehot = None
+        for _ in range(k):
+            idx = jnp.argmax(p, axis=-1)  # [S]
+            onehot = jax.nn.one_hot(idx, e, dtype=jnp.float32)  # [S, E]
+            if top1_onehot is None:
+                top1_onehot = onehot
+            gate = jnp.sum(p * onehot, axis=-1)  # [S]
+            # position of each token within its chosen expert's buffer
+            pos = jnp.cumsum(onehot, axis=0) - onehot + counts[None, :]  # [S, E]
+            pos_in_e = jnp.sum(pos * onehot, axis=-1)  # [S]
+            keep = (pos_in_e < capacity).astype(jnp.float32)
+            slot = jax.nn.one_hot(
+                jnp.minimum(pos_in_e, capacity - 1).astype(jnp.int32),
+                capacity,
+                dtype=jnp.float32,
+            )  # [S, C]
+            combine = combine + (gate * keep)[:, None, None] * onehot[:, :, None] * slot[:, None, :]
+            counts = counts + jnp.sum(onehot, axis=0)
+            p = p * (1.0 - onehot)  # mask the chosen expert for the next pass
+
+        # renormalize the selected gates so each routed token's weights sum to 1
+        total = jnp.sum(combine, axis=(1, 2), keepdims=True)
+        combine = combine / jnp.maximum(total, 1e-9)
+        dispatch = (combine > 0.0).astype(cfg.dtype)  # [S, E, C]
+
+        w1 = self.param("w1", nn.initializers.lecun_normal(), (e, d, f))
+        w3 = self.param("w3", nn.initializers.lecun_normal(), (e, d, f))
+        w2 = self.param("w2", nn.initializers.lecun_normal(), (e, f, d))
+
+        xe = jnp.einsum("sec,sd->ecd", dispatch, xs.astype(cfg.dtype))  # [E, C, D]
+        gate_h = jnp.einsum("ecd,edf->ecf", xe, w1.astype(cfg.dtype))
+        up_h = jnp.einsum("ecd,edf->ecf", xe, w3.astype(cfg.dtype))
+        ye = jnp.einsum("ecf,efd->ecd", nn.silu(gate_h) * up_h, w2.astype(cfg.dtype))
+        out = jnp.einsum("sec,ecd->sd", combine.astype(cfg.dtype), ye)  # [S, D]
+
+        # Switch load-balance loss: E · Σ_e (top-1 token fraction · mean prob)
+        frac = jnp.mean(top1_onehot, axis=0)  # [E]
+        mean_p = jnp.mean(probs, axis=0)  # [E]
+        balance = e * jnp.sum(frac * mean_p)
+        zloss = jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2)
+        self.sow(
+            "moe_losses",
+            "aux",
+            cfg.moe_aux_coef * balance + cfg.moe_zloss_coef * zloss,
+        )
+        return out.reshape(b, t, d)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+    attn_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x):
+        x = x + Attention(self.cfg, self.attn_fn, name="attn")(
+            RMSNorm(self.cfg.dtype, name="attn_norm")(x)
+        )
+        ffn = MoEMLP if self.cfg.n_experts > 0 else MLP
+        x = x + ffn(self.cfg, name="mlp")(RMSNorm(self.cfg.dtype, name="mlp_norm")(x))
+        return x
+
+
+class CausalLM(nn.Module):
+    cfg: TransformerConfig
+    attn_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, tokens):  # [B, T] int32 -> [B, T, vocab] f32 logits
+        cfg = self.cfg
+        emb = self.param(
+            "embed", nn.initializers.normal(0.02), (cfg.vocab_size, cfg.dim)
+        )
+        x = emb[tokens].astype(cfg.dtype)
+        for i in range(cfg.n_layers):
+            x = Block(cfg, self.attn_fn, name=f"layer_{i}")(x)
+        x = RMSNorm(cfg.dtype, name="final_norm")(x)
+        logits = jnp.dot(x, emb.T.astype(cfg.dtype))  # tied embeddings
+        return logits.astype(jnp.float32)
+
+
+def pick_attention(seq_len: int, backend: Optional[str] = None) -> str:
+    """The ``attn="auto"`` policy: dense vs flash by sequence length.
+
+    Uses the crossover measured on real hardware by bench config 7
+    (``Settings.FLASH_MIN_SEQ_LEN``): fused dense XLA attention wins at
+    short lengths (the O(T²) logits still fit in VMEM-friendly fusions and
+    the Pallas kernel's block bookkeeping costs more than it saves), flash
+    wins once the logits matrix stops fitting. TPU-only: on any other
+    backend the Pallas kernel runs in interpret mode (orders of magnitude
+    slower — a correctness path, not a performance one), so "auto" always
+    answers dense there. Single-chip policy — the ring variants shard the
+    sequence over a mesh and are chosen explicitly.
+    """
+    from p2pfl_tpu.settings import Settings
+
+    backend = jax.default_backend() if backend is None else backend
+    if backend != "tpu":
+        return "dense"
+    return "flash" if seq_len >= Settings.FLASH_MIN_SEQ_LEN else "dense"
+
+
+def resolve_attention(
+    attn: str,
+    mesh: Any = None,
+    axis_name: str = "model",
+    block: int = 128,
+    seq_len: Optional[int] = None,
+) -> Optional[Callable]:
+    """Map an attention backend name to an ``(q, k, v) -> out`` callable."""
+    if attn == "auto":
+        if seq_len is None:
+            raise ValueError("attn='auto' needs seq_len to pick a backend")
+        attn = pick_attention(seq_len)
+    if attn == "dense":
+        return None  # Attention falls back to the fused causal path
+    if attn == "flash":
+        from p2pfl_tpu.ops.flash_attention import flash_attention
+
+        # Pallas runs natively on TPU; anywhere else use interpret mode
+        interpret = jax.default_backend() != "tpu"
+        return partial(
+            flash_attention, causal=True, block_q=block, block_k=block, interpret=interpret
+        )
+    if attn in ("ring", "ring_flash"):
+        if mesh is None:
+            raise ValueError(f"attn={attn!r} needs a mesh (sequence is sharded over it)")
+        from p2pfl_tpu.ops.attention import ring_attention
+
+        impl = "flash" if attn == "ring_flash" else "dense"
+        return partial(ring_attention, mesh=mesh, axis_name=axis_name, impl=impl, block=block)
+    raise ValueError(f"unknown attention backend {attn!r} (dense|flash|ring|ring_flash)")
+
+
+def tiny_transformer(
+    seq_len: int = 128,
+    seed: int = 0,
+    cfg: Optional[TransformerConfig] = None,
+    attn_fn: Optional[Callable] = None,
+    attn: str = "dense",
+    mesh: Any = None,
+) -> FlaxModel:
+    """A small LoRA-ready causal LM bound to concrete params.
+
+    ``attn`` selects the attention backend
+    (``"auto" | "dense" | "flash" | "ring" | "ring_flash"``); ``"auto"``
+    picks dense vs flash from the sequence length using the measured
+    crossover (:func:`pick_attention`). ``attn_fn`` overrides it with an
+    explicit callable.
+    """
+    cfg = cfg or TransformerConfig()
+    if attn == "auto":
+        attn = pick_attention(seq_len)
+    if attn_fn is None:
+        # flash blocks must divide the attended length: the GLOBAL sequence
+        # for attn="flash", but the PER-DEVICE shard for "ring_flash" (each
+        # hop's kernel sees T_local)
+        basis = seq_len
+        if attn == "ring_flash":
+            if mesh is None:
+                raise ValueError("attn='ring_flash' needs a mesh")
+            from p2pfl_tpu.settings import Settings
+
+            basis = seq_len // mesh.shape[Settings.MESH_MODEL_AXIS]
+        if basis <= 512:
+            block = basis  # block == T always satisfies the TPU tiling rule
+        else:
+            # blocks must divide the basis and (on TPU Mosaic) be a multiple
+            # of 8. Prefer the LARGEST block <= 512: bench config 7's sweep
+            # shows bigger blocks amortize the Pallas grid bookkeeping —
+            # block 512 beat 128 at every measured length (e.g. 194 -> 86 ms
+            # at T=4096)
+            block = next(
+                (b for b in range(512, 7, -1) if basis % b == 0 and b % 8 == 0), None
+            )
+            if block is None and attn in ("flash", "ring_flash"):
+                # the sweep goes down to 8, so this only fires when the
+                # attended length itself is not a multiple of 8
+                raise ValueError(
+                    f"attn={attn!r} needs the attended length to be a "
+                    f"multiple of 8 (Mosaic tiling); got {basis} (seq_len "
+                    "per shard)"
+                )
+        attn_fn = resolve_attention(attn, mesh=mesh, block=block)
+    module = CausalLM(cfg, attn_fn)
+    rng = jax.random.PRNGKey(seed)
+    dummy = jnp.zeros((1, seq_len), dtype=jnp.int32)
+    variables = module.init(rng, dummy)
+    model = FlaxModel(module, variables["params"], (seq_len,), cfg.vocab_size)
+    model.extra["config"] = cfg
+    return model

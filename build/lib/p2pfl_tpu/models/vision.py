@@ -1,0 +1,237 @@
+"""Vision models: MLP, CNN (reference parity) and ResNet-18/50 (BASELINE).
+
+Compute runs in bfloat16 (MXU-friendly), parameters and logits stay float32
+— the standard TPU mixed-precision recipe. Reference shapes:
+MLP 784-256-128-10 (``mlp.py:53-56``), 2-conv CNN (``cnn.py:55-71``).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from p2pfl_tpu.models.base import FlaxModel
+
+
+class MLP(nn.Module):
+    """784-256-128-10 MLP, the reference's default MNIST model."""
+
+    hidden: Sequence[int] = (256, 128)
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        for h in self.hidden:
+            x = nn.Dense(h, dtype=self.dtype)(x)
+            x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+class CNN(nn.Module):
+    """Two-conv CNN over 28x28x1, matching the reference CNN's capability."""
+
+    channels: Sequence[int] = (32, 64)
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        for ch in self.channels:
+            x = nn.Conv(ch, (3, 3), padding="SAME", dtype=self.dtype)(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+class ResBlock(nn.Module):
+    filters: int
+    strides: tuple[int, int] = (1, 1)
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(self.filters, (3, 3), self.strides, padding="SAME", use_bias=False, dtype=self.dtype)(x)
+        y = nn.GroupNorm(num_groups=8, dtype=self.dtype)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), padding="SAME", use_bias=False, dtype=self.dtype)(y)
+        y = nn.GroupNorm(num_groups=8, dtype=self.dtype)(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(
+                self.filters, (1, 1), self.strides, use_bias=False, dtype=self.dtype
+            )(residual)
+            residual = nn.GroupNorm(num_groups=8, dtype=self.dtype)(residual)
+        return nn.relu(y + residual)
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: tuple[int, int] = (1, 1)
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype)(x)
+        y = nn.GroupNorm(num_groups=8, dtype=self.dtype)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), self.strides, padding="SAME", use_bias=False, dtype=self.dtype)(y)
+        y = nn.GroupNorm(num_groups=8, dtype=self.dtype)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters * 4, (1, 1), use_bias=False, dtype=self.dtype)(y)
+        y = nn.GroupNorm(num_groups=8, dtype=self.dtype)(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(
+                self.filters * 4, (1, 1), self.strides, use_bias=False, dtype=self.dtype
+            )(residual)
+            residual = nn.GroupNorm(num_groups=8, dtype=self.dtype)(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """ResNet for CIFAR-scale inputs.
+
+    GroupNorm instead of BatchNorm: federated averaging of BatchNorm running
+    statistics is ill-defined across non-IID shards (a known FL failure
+    mode); GroupNorm keeps every parameter a plain weight that FedAvg can
+    average soundly — and avoids mutable state in the train step.
+    """
+
+    stage_sizes: Sequence[int] = (2, 2, 2, 2)
+    bottleneck: bool = False
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        x = nn.Conv(64, (3, 3), padding="SAME", use_bias=False, dtype=self.dtype)(x)
+        x = nn.GroupNorm(num_groups=8, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        block = BottleneckBlock if self.bottleneck else ResBlock
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = block(64 * 2**i, strides, dtype=self.dtype)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+# ---- constructors (bound to concrete params) ----
+
+
+def mlp(seed: int = 0, num_classes: int = 10, input_shape=(28, 28, 1)) -> FlaxModel:
+    return FlaxModel.create(MLP(num_classes=num_classes), input_shape, seed, num_classes)
+
+
+def cnn(seed: int = 0, num_classes: int = 10, input_shape=(28, 28, 1)) -> FlaxModel:
+    return FlaxModel.create(CNN(num_classes=num_classes), input_shape, seed, num_classes)
+
+
+def resnet18(seed: int = 0, num_classes: int = 10, input_shape=(32, 32, 3)) -> FlaxModel:
+    return FlaxModel.create(
+        ResNet(stage_sizes=(2, 2, 2, 2), num_classes=num_classes), input_shape, seed, num_classes
+    )
+
+
+def resnet50(seed: int = 0, num_classes: int = 100, input_shape=(32, 32, 3)) -> FlaxModel:
+    return FlaxModel.create(
+        ResNet(stage_sizes=(3, 4, 6, 3), bottleneck=True, num_classes=num_classes),
+        input_shape,
+        seed,
+        num_classes,
+    )
+
+
+class ViTBlock(nn.Module):
+    """Pre-norm encoder block: bidirectional MHA + GELU MLP (ViT recipe).
+    Width is derived from the input's last dim."""
+
+    heads: int
+    mlp_ratio: int = 4
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):  # [B, T, D]
+        b, t, d = x.shape
+        h = self.heads
+        hd = d // h
+        y = nn.LayerNorm(dtype=jnp.float32)(x).astype(self.dtype)
+        qkv = nn.Dense(3 * d, dtype=self.dtype, name="qkv")(y)
+        q, k, v = jnp.split(qkv.reshape(b, t, 3, h, hd), 3, axis=2)
+        q, k, v = (a.squeeze(2) for a in (q, k, v))  # [B, T, H, hd]
+        # bidirectional attention, fp32 softmax statistics
+        s = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32), k.astype(jnp.float32))
+        a = jax.nn.softmax(s * hd**-0.5, axis=-1).astype(self.dtype)
+        o = jnp.einsum("bhts,bshd->bthd", a, v).reshape(b, t, d)
+        x = x + nn.Dense(d, dtype=self.dtype, name="proj")(o)
+        y = nn.LayerNorm(dtype=jnp.float32)(x).astype(self.dtype)
+        y = nn.Dense(self.mlp_ratio * d, dtype=self.dtype, name="fc1")(y)
+        y = nn.Dense(d, dtype=self.dtype, name="fc2")(nn.gelu(y))
+        return x + y
+
+
+class ViT(nn.Module):
+    """Small vision transformer (Dosovitskiy et al. 2020): conv patch embed,
+    learned position embeddings, mean-pooled head. Fills the attention-based
+    vision slot of the model zoo (the reference has only MLP/CNN,
+    ``mnist_examples/models/``)."""
+
+    num_classes: int = 10
+    patch: int = 4
+    dim: int = 64
+    depth: int = 4
+    heads: int = 4
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):  # [B, H, W, C]
+        x = nn.Conv(
+            self.dim, (self.patch, self.patch), strides=(self.patch, self.patch),
+            dtype=self.dtype, name="patch_embed",
+        )(x.astype(self.dtype))
+        b, hh, ww, d = x.shape
+        x = x.reshape(b, hh * ww, d)
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02), (1, hh * ww, d)
+        )
+        x = x + pos.astype(self.dtype)
+        for i in range(self.depth):
+            x = ViTBlock(self.heads, dtype=self.dtype, name=f"block_{i}")(x)
+        x = nn.LayerNorm(dtype=jnp.float32)(x.mean(axis=1))
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+
+
+def vit(
+    seed: int = 0,
+    num_classes: int = 10,
+    input_shape=(32, 32, 3),
+    patch: int = 4,
+    dim: int = 64,
+    depth: int = 4,
+    heads: int = 4,
+    dtype: jnp.dtype = jnp.bfloat16,
+) -> FlaxModel:
+    """``dtype=jnp.float32`` for CPU runs — bf16 is software-emulated there
+    (the default bf16 is the TPU/MXU recipe)."""
+    return FlaxModel.create(
+        ViT(
+            num_classes=num_classes, patch=patch, dim=dim, depth=depth,
+            heads=heads, dtype=dtype,
+        ),
+        input_shape,
+        seed=seed,
+        num_classes=num_classes,
+    )

@@ -1,0 +1,129 @@
+"""Native codec bindings (ctypes) with transparent numpy fallback.
+
+Loads ``libp2tw.so`` (built from ``codec.cpp`` by ``build.sh``); if the
+library is missing and a compiler is available it is built once on first
+import. Every entry point has a numpy fallback so the framework never
+*requires* the native layer — it's the fast path, not a dependency.
+
+API:
+- :func:`quantize`   — fp32 array → (int8 array, scale)
+- :func:`dequantize` — (int8 array, scale) → fp32 array
+- :func:`crc32c`     — Castagnoli CRC of a bytes-like
+- :data:`NATIVE`     — True when the C++ library is in use
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libp2tw.so")
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _try_build() -> None:
+    src = os.path.join(_DIR, "codec.cpp")
+    if not os.path.exists(src):
+        return
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-o", _SO, src],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+    except (OSError, subprocess.SubprocessError):
+        pass
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    if not os.path.exists(_SO):
+        _try_build()
+    if not os.path.exists(_SO):
+        return None
+    try:
+        lib = ctypes.CDLL(_SO)
+    except OSError:
+        return None
+    lib.p2tw_quantize_f32_i8.restype = ctypes.c_float
+    lib.p2tw_quantize_f32_i8.argtypes = [
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int8),
+    ]
+    lib.p2tw_dequantize_i8_f32.restype = None
+    lib.p2tw_dequantize_i8_f32.argtypes = [
+        ctypes.POINTER(ctypes.c_int8),
+        ctypes.c_int64,
+        ctypes.c_float,
+        ctypes.POINTER(ctypes.c_float),
+    ]
+    lib.p2tw_crc32c.restype = ctypes.c_uint32
+    lib.p2tw_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_int64, ctypes.c_uint32]
+    return lib
+
+
+_lib = _load()
+NATIVE = _lib is not None
+
+
+def quantize(arr: np.ndarray) -> tuple[np.ndarray, float]:
+    """Symmetric per-tensor int8 quantization. Returns (int8 array, scale)."""
+    flat = np.ascontiguousarray(arr, dtype=np.float32).reshape(-1)
+    out = np.empty(flat.shape, dtype=np.int8)
+    if _lib is not None:
+        scale = _lib.p2tw_quantize_f32_i8(
+            flat.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            flat.size,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+        )
+        return out.reshape(arr.shape), float(scale)
+    absmax = float(np.abs(flat).max()) if flat.size else 0.0
+    scale = absmax / 127.0 if absmax > 0 else 1.0
+    q = np.clip(np.rint(flat / scale), -127, 127)
+    return q.astype(np.int8).reshape(arr.shape), scale
+
+
+def dequantize(arr: np.ndarray, scale: float) -> np.ndarray:
+    flat = np.ascontiguousarray(arr, dtype=np.int8).reshape(-1)
+    if _lib is not None:
+        out = np.empty(flat.shape, dtype=np.float32)
+        _lib.p2tw_dequantize_i8_f32(
+            flat.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+            flat.size,
+            ctypes.c_float(scale),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        )
+        return out.reshape(arr.shape)
+    return (flat.astype(np.float32) * scale).reshape(arr.shape)
+
+
+def crc32c(data: bytes, seed: int = 0) -> int:
+    if _lib is not None:
+        return int(_lib.p2tw_crc32c(data, len(data), seed))
+    return _crc32c_py(data, seed)
+
+
+_PY_TABLE: Optional[list[int]] = None
+
+
+def _crc32c_py(data: bytes, seed: int = 0) -> int:
+    global _PY_TABLE
+    if _PY_TABLE is None:
+        table = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (0x82F63B78 ^ (c >> 1)) if c & 1 else (c >> 1)
+            table.append(c)
+        _PY_TABLE = table
+    c = seed ^ 0xFFFFFFFF
+    for b in data:
+        c = _PY_TABLE[(c ^ b) & 0xFF] ^ (c >> 8)
+    return c ^ 0xFFFFFFFF

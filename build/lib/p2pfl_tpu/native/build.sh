@@ -1,0 +1,6 @@
+#!/bin/sh
+# Build the native codec shared library next to this script.
+set -e
+cd "$(dirname "$0")"
+g++ -O3 -shared -fPIC -o libp2tw.so codec.cpp
+echo "built $(pwd)/libp2tw.so"

@@ -1,0 +1,215 @@
+"""The user-facing Node (reference ``p2pfl/node.py:47-341``).
+
+Wires a transport, an aggregator, a learner and the command registry; owns
+the learning thread that drives the round FSM. ``Node(None, None)`` is valid
+for pure-communication use, matching the reference's communication tests.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Any, Optional, Type, Union
+
+from p2pfl_tpu.commands import (
+    AddModelCommand,
+    HeartbeatCommand,
+    InitModelCommand,
+    MetricsCommand,
+    ModelInitializedCommand,
+    ModelsAggregatedCommand,
+    ModelsReadyCommand,
+    SecAggPubCommand,
+    SecAggNeedCommand,
+    SecAggRecoverCommand,
+    StartLearningCommand,
+    StopLearningCommand,
+    VoteTrainSetCommand,
+)
+from p2pfl_tpu.communication.memory import InMemoryProtocol
+from p2pfl_tpu.communication.protocol import CommunicationProtocol
+from p2pfl_tpu.exceptions import NodeRunningException, ZeroRoundsException
+from p2pfl_tpu.learning.aggregators.fedavg import FedAvg
+from p2pfl_tpu.learning.weights import ModelUpdate
+from p2pfl_tpu.management.logger import logger
+from p2pfl_tpu.node_state import NodeState
+
+
+#: weak registry of every constructed Node — lets harnesses find and stop
+#: leaked nodes (a failed test that skips ``stop()`` would otherwise leave
+#: live heartbeater/gossiper threads interfering with everything after it)
+ALL_NODES: "weakref.WeakSet[Node]" = weakref.WeakSet()
+
+
+def stop_leaked_nodes() -> list[str]:
+    """Stop every still-running Node in the process; returns their addrs."""
+    leaked = []
+    for node in list(ALL_NODES):
+        if getattr(node, "_running", False):
+            leaked.append(node.addr)
+            try:
+                node.stop()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+    return leaked
+
+
+class Node:
+    def __init__(
+        self,
+        model: Any = None,
+        data: Any = None,
+        address: Optional[str] = None,
+        learner: Any = None,
+        aggregator: Any = None,
+        protocol: Union[CommunicationProtocol, Type[CommunicationProtocol]] = InMemoryProtocol,
+        simulation: bool = False,
+    ) -> None:
+        # transport (class or ready instance — reference picks by ctor arg, node.py:86)
+        self.protocol: CommunicationProtocol = (
+            protocol(address) if isinstance(protocol, type) else protocol
+        )
+        self.addr = self.protocol.get_address()
+
+        self.state = NodeState(self.addr, simulation=simulation)
+        self.aggregator = aggregator if aggregator is not None else FedAvg(self.addr)
+        self.aggregator.node_name = self.addr
+
+        # learner: instance, or class to instantiate with (model, data)
+        if learner is None and model is not None:
+            from p2pfl_tpu.learning.learner import JaxLearner
+
+            learner = JaxLearner(model, data)
+        elif isinstance(learner, type):
+            learner = learner(model, data)
+        self.learner = learner
+        self.state.learner = learner
+
+        # learning-thread plumbing
+        self.experiment_name = "experiment"
+        self.total_rounds = 0
+        self.epochs = 1
+        self.pending_init_update: Optional[ModelUpdate] = None
+        # round-start global stash for secagg dropout fallback
+        # (stages/learning_stages.py TrainStage / GossipModelStage)
+        self.round_start_params: Optional[Any] = None
+        self._interrupt = threading.Event()
+        self._learning_thread: Optional[threading.Thread] = None
+        self._running = False
+        ALL_NODES.add(self)
+
+        # command registry (reference node.py:110-131)
+        for cmd in (
+            HeartbeatCommand(self.protocol.heartbeater),
+            StartLearningCommand(self),
+            StopLearningCommand(self),
+            ModelInitializedCommand(self.state),
+            VoteTrainSetCommand(self.state),
+            ModelsAggregatedCommand(self.state),
+            ModelsReadyCommand(self.state),
+            MetricsCommand(self.state),
+            SecAggPubCommand(self.state),
+            SecAggRecoverCommand(self.state),
+            SecAggNeedCommand(self),
+            InitModelCommand(self),
+            AddModelCommand(self),
+        ):
+            self.protocol.add_command(cmd)
+
+    # ---- lifecycle (reference node.py:204-241) ----
+
+    def start(self, wait: bool = False) -> None:
+        if self._running:
+            raise NodeRunningException(f"Node {self.addr} already running")
+        logger.register_node(self.addr, self.state, self.state.simulation)
+        from p2pfl_tpu.management.watchdog import StallWatchdog
+
+        StallWatchdog.ensure_started()  # no-op unless Settings.STALL_WATCHDOG_S > 0
+        self.protocol.start()
+        self._running = True
+        if wait:
+            self.protocol.wait_for_termination()
+
+    def stop(self) -> None:
+        if not self._running:
+            return
+        self._running = False
+        self._stop_learning()
+        self.protocol.stop()
+        logger.unregister_node(self.addr)
+
+    def stop_async(self) -> None:
+        """Stop from a server/command thread without deadlocking it."""
+        threading.Thread(target=self.stop, name=f"stop-{self.addr}", daemon=True).start()
+
+    # ---- neighborhood (reference node.py:137-203) ----
+
+    def connect(self, addr: str) -> bool:
+        if self.state.round is not None:
+            logger.info(self.addr, "Joining a network mid-learning is unsupported")
+            return False
+        return self.protocol.connect(addr)
+
+    def disconnect(self, addr: str) -> None:
+        self.protocol.disconnect(addr)
+
+    def get_neighbors(self, only_direct: bool = False) -> dict:
+        return self.protocol.get_neighbors(only_direct)
+
+    def is_running(self) -> bool:
+        return self._running
+
+    # ---- learning control (reference node.py:288-341) ----
+
+    def set_start_learning(self, rounds: int = 1, epochs: int = 1) -> None:
+        if rounds < 1:
+            raise ZeroRoundsException("rounds must be >= 1")
+        if self.state.round is not None:
+            logger.info(self.addr, "Learning already in progress")
+            return
+        self.protocol.broadcast(
+            self.protocol.build_msg("start_learning", [str(rounds), str(epochs)])
+        )
+        # this node is THE initializer: its current weights seed the network
+        self.state.model_initialized_event.set()
+        self.protocol.broadcast(self.protocol.build_msg("model_initialized"))
+        self._start_learning_thread(rounds, epochs)
+
+    def set_stop_learning(self) -> None:
+        if self.state.round is None:
+            logger.info(self.addr, "Learning is not running")
+            return
+        self.protocol.broadcast(self.protocol.build_msg("stop_learning"))
+        self._stop_learning()
+
+    def learning_interrupted(self) -> bool:
+        return self._interrupt.is_set()
+
+    # ---- internals (called by commands too) ----
+
+    def _start_learning_thread(self, rounds: int, epochs: int) -> None:
+        with self.state.start_thread_lock:
+            if self._learning_thread is not None and self._learning_thread.is_alive():
+                logger.debug(self.addr, "Learning thread already running")
+                return
+            self.total_rounds = rounds
+            self.epochs = epochs
+            self._interrupt.clear()
+            self._learning_thread = threading.Thread(
+                target=self._run_learning, name=f"learning-{self.addr}", daemon=True
+            )
+            self._learning_thread.start()
+
+    def _run_learning(self) -> None:
+        from p2pfl_tpu.stages.workflow import LearningWorkflow
+
+        LearningWorkflow().run(self)
+
+    def _stop_learning(self) -> None:
+        self._interrupt.set()
+        if self.learner is not None:
+            self.learner.interrupt_fit()
+        self.aggregator.clear()
+        self.aggregator.reset_experiment()
+        self.state.clear()
+        self.state.votes_ready_event.set()

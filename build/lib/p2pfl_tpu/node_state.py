@@ -1,0 +1,97 @@
+"""Per-node mutable learning state.
+
+Reference: ``p2pfl/node_state.py:26-115``. The reference synchronizes with
+four ``threading.Lock`` objects used as latches (created acquired, released
+to signal); here those are real :class:`threading.Event` objects per
+SURVEY §5's recommendation — same semantics, no lock-as-event hazards.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional
+
+
+class NodeState:
+    def __init__(self, addr: str, simulation: bool = False) -> None:
+        self.addr = addr
+        self.simulation = simulation
+        self.status = "Idle"
+        self.experiment_name: Optional[str] = None
+        self.round: Optional[int] = None
+        self.total_rounds: Optional[int] = None
+        self.simulation = simulation
+
+        self.learner: Optional[Any] = None
+
+        # addr -> list of contributors that addr has already aggregated
+        self.models_aggregated: Dict[str, List[str]] = {}
+        # addr -> last round that addr reported finishing (-1 = model init'd)
+        self.nei_status: Dict[str, int] = {}
+
+        self.train_set: List[str] = []
+        self.train_set_votes: Dict[str, Dict[str, int]] = {}
+
+        # secure aggregation (learning/secagg.py): this node's DH private key
+        # for the current experiment + peers' announced (public key, sample
+        # count) pairs. Keys are latched: the FIRST announcement per peer
+        # per experiment wins (commands/control.py SecAggPubCommand).
+        self.secagg_priv: Optional[int] = None
+        self.secagg_pubs: Dict[str, tuple] = {}
+        # the sample count THIS node announced with its key — masking must
+        # use exactly this weight or pair masks stop cancelling
+        self.secagg_samples: Optional[int] = None
+        # dropout recovery: (round, dropped_addr, survivor_addr) -> pair
+        # seed the survivor re-disclosed via secagg_recover
+        self.secagg_disclosed: Dict[tuple, int] = {}
+        # (round, dropped_addr) pairs THIS node already disclosed its seed
+        # for (proactively or answering secagg_need) — disclose once
+        self.secagg_disclosure_sent: set = set()
+
+        # monotonically counts experiments entered; lets harnesses distinguish
+        # "never started" from "finished" (both have round None)
+        self.experiment_epoch = 0
+
+        # stall-watchdog instrumentation (management/watchdog.py): stamped
+        # by the workflow loop on every stage transition
+        self.last_transition: Optional[float] = None
+        self.current_stage: str = ""
+
+        # synchronization (reference: four lock-latches, node_state.py:77-81)
+        self.train_set_votes_lock = threading.Lock()
+        self.start_thread_lock = threading.Lock()
+        self.votes_ready_event = threading.Event()
+        self.model_initialized_event = threading.Event()
+
+    def set_experiment(self, exp_name: str, total_rounds: int) -> None:
+        """Enter learning mode (reference ``node_state.py:83``)."""
+        self.status = "Learning"
+        self.experiment_name = exp_name
+        self.total_rounds = total_rounds
+        self.round = 0
+        self.experiment_epoch += 1
+
+    def increase_round(self) -> None:
+        """Advance the round; clears per-round caches (``node_state.py:97``)."""
+        if self.round is None:
+            raise ValueError("round not initialized")
+        self.round += 1
+        self.models_aggregated = {}
+
+    def clear(self) -> None:
+        """Back to idle (``node_state.py:110``)."""
+        self.status = "Idle"
+        self.experiment_name = None
+        self.round = None
+        self.total_rounds = None
+        self.models_aggregated = {}
+        self.nei_status = {}
+        self.train_set = []
+        self.train_set_votes = {}
+        self.secagg_priv = None
+        self.secagg_pubs = {}
+        self.secagg_samples = None
+        self.secagg_disclosed = {}
+        self.secagg_disclosure_sent = set()
+        self.votes_ready_event.clear()
+        self.model_initialized_event.clear()

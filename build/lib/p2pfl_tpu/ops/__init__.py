@@ -1,0 +1,21 @@
+"""Pure JAX ops: pytree math, aggregation kernels, codecs."""
+
+from p2pfl_tpu.ops.tree import (
+    tree_add,
+    tree_scale,
+    tree_stack,
+    tree_sub,
+    tree_unstack,
+    tree_weighted_mean,
+    tree_zeros_like,
+)
+
+__all__ = [
+    "tree_add",
+    "tree_scale",
+    "tree_stack",
+    "tree_sub",
+    "tree_unstack",
+    "tree_weighted_mean",
+    "tree_zeros_like",
+]

@@ -1,0 +1,213 @@
+"""Pure aggregation kernels over stacked pytrees.
+
+Each function takes a pytree whose leaves have a leading node axis
+``[N, ...]`` plus per-node scalars, and returns the aggregated pytree.
+All are jit-compatible pure functions — the strategy classes in
+``learning/aggregators`` wrap them with the partial-aggregation bookkeeping.
+
+The reference ships only FedAvg (``p2pfl/learning/aggregators/fedavg.py``);
+the robust family (median / trimmed mean / Krum) covers BASELINE config 4.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+Pytree = Any
+
+
+@partial(jax.jit, static_argnames=("agg_dtype",))
+def fedavg(stacked: Pytree, weights: jax.Array, agg_dtype: str = "float32") -> Pytree:
+    """Sample-weighted mean. weights: [N] (unnormalized sample counts)."""
+    w = weights.astype(agg_dtype)
+    w = w / jnp.sum(w)
+
+    def avg(x):
+        return jnp.tensordot(w, x.astype(agg_dtype), axes=(0, 0)).astype(x.dtype)
+
+    return jax.tree.map(avg, stacked)
+
+
+@jax.jit
+def fedmedian(stacked: Pytree) -> Pytree:
+    """Coordinate-wise median across the node axis."""
+
+    def med(x):
+        return jnp.median(x.astype("float32"), axis=0).astype(x.dtype)
+
+    return jax.tree.map(med, stacked)
+
+
+@partial(jax.jit, static_argnames=("trim",))
+def trimmed_mean(stacked: Pytree, trim: int) -> Pytree:
+    """Coordinate-wise trimmed mean: drop ``trim`` lowest and highest per coord.
+
+    ``trim`` must satisfy ``2 * trim < N``. Robust to ``trim`` Byzantine nodes.
+    """
+
+    def tm(x):
+        n = x.shape[0]
+        xs = jnp.sort(x.astype("float32"), axis=0)
+        kept = jax.lax.slice_in_dim(xs, trim, n - trim, axis=0)
+        return jnp.mean(kept, axis=0).astype(x.dtype)
+
+    return jax.tree.map(tm, stacked)
+
+
+def _flatten_nodes(stacked: Pytree) -> jax.Array:
+    """[N, ...] pytree -> [N, P] matrix of all params per node (fp32)."""
+    leaves = [x.astype("float32").reshape(x.shape[0], -1) for x in jax.tree.leaves(stacked)]
+    return jnp.concatenate(leaves, axis=1)
+
+
+@partial(jax.jit, static_argnames=("n_byzantine", "multi"))
+def krum_select(stacked: Pytree, n_byzantine: int, multi: int = 1) -> jax.Array:
+    """Krum / Multi-Krum selection scores.
+
+    Returns the indices of the ``multi`` nodes with the lowest Krum score
+    (sum of squared distances to their ``N - f - 2`` nearest neighbors).
+    The [N, P] distance matrix is one MXU matmul: ``|a-b|^2 = |a|^2 + |b|^2 - 2ab``.
+    """
+    flat = _flatten_nodes(stacked)  # [N, P]
+    n = flat.shape[0]
+    sq = jnp.sum(flat * flat, axis=1)  # [N]
+    d2 = sq[:, None] + sq[None, :] - 2.0 * (flat @ flat.T)  # [N, N]
+    d2 = jnp.maximum(d2, 0.0)
+    d2 = d2.at[jnp.arange(n), jnp.arange(n)].set(jnp.inf)
+    k = max(n - n_byzantine - 2, 1)
+    nearest = jax.lax.top_k(-d2, k)[0]  # [N, k] negated distances
+    scores = -jnp.sum(nearest, axis=1)  # [N]
+    return jax.lax.top_k(-scores, multi)[1]  # indices of lowest scores
+
+
+def krum(stacked: Pytree, n_byzantine: int, multi: int = 1) -> Pytree:
+    """(Multi-)Krum aggregate: mean of the ``multi`` selected node models."""
+    idx = krum_select(stacked, n_byzantine, multi)
+
+    def pick(x):
+        sel = jnp.take(x, idx, axis=0).astype("float32")
+        return jnp.mean(sel, axis=0).astype(x.dtype)
+
+    return jax.tree.map(pick, stacked)
+
+
+@partial(jax.jit, static_argnames=("iters",))
+def centered_clip(stacked: Pytree, center: Pytree, tau: float, iters: int = 3) -> Pytree:
+    """Centered clipping (Karimireddy, He, Jaggi 2021). Robust aggregator.
+
+    ``v ← v + mean_i clip_tau(x_i − v)`` iterated from ``v = center`` (the
+    previous round's global model), where ``clip_tau`` rescales each node's
+    whole-model deviation to norm ≤ τ. History-aware: a Byzantine node can
+    pull the aggregate at most τ per round regardless of its magnitude —
+    unlike coordinate-wise rules it needs no ``f`` estimate, and unlike
+    Krum it uses information from every honest node. The per-node
+    deviation norms are one ``[N, P]`` reduction; everything stays fp32 on
+    device.
+    """
+    flat_leaves = [x.astype("float32") for x in jax.tree.leaves(stacked)]
+    treedef = jax.tree.structure(stacked)
+    c_leaves = [x.astype("float32") for x in jax.tree.leaves(center)]
+
+    def norms(v_leaves):
+        # [N] L2 norm of each node's deviation from the current center
+        sq = sum(
+            jnp.sum((x - v[None]) ** 2, axis=tuple(range(1, x.ndim)))
+            for x, v in zip(flat_leaves, v_leaves)
+        )
+        return jnp.sqrt(jnp.maximum(sq, 1e-24))
+
+    def body(_, v_leaves):
+        s = jnp.minimum(1.0, tau / norms(v_leaves))  # [N] clip factors
+        return [
+            v + jnp.mean(s.reshape((-1,) + (1,) * (x.ndim - 1)) * (x - v[None]), axis=0)
+            for x, v in zip(flat_leaves, v_leaves)
+        ]
+
+    v_leaves = jax.lax.fori_loop(0, iters, body, c_leaves)
+    out = jax.tree.unflatten(treedef, v_leaves)
+    return jax.tree.map(lambda o, x: o.astype(x.dtype), out, stacked)
+
+
+@partial(jax.jit, static_argnames=("opt", "lr", "b1", "b2", "tau"))
+def fedopt_update(
+    prev: Pytree,
+    avg: Pytree,
+    m: Pytree,
+    v: Pytree,
+    t: jax.Array,
+    opt: str = "adam",
+    lr: float = 0.1,
+    b1: float = 0.9,
+    b2: float = 0.99,
+    tau: float = 1e-3,
+) -> tuple[Pytree, Pytree, Pytree]:
+    """FedOpt server step (Reddi et al. 2021): treat ``prev - avg`` as a
+    pseudo-gradient and apply a server-side adaptive optimizer to it.
+
+    ``opt``: ``"adam"`` (FedAdam), ``"yogi"`` (FedYogi) or ``"adagrad"``
+    (FedAdagrad). ``m``/``v`` are the server's first/second-moment pytrees;
+    ``t`` is the 1-based server step for Adam bias correction. Returns
+    ``(new_params, new_m, new_v)`` — one fused elementwise XLA program.
+    """
+
+    def one(p, a, mi, vi):
+        g = p.astype("float32") - a.astype("float32")  # pseudo-grad
+        mn = b1 * mi + (1.0 - b1) * g
+        g2 = g * g
+        if opt == "adam":
+            vn = b2 * vi + (1.0 - b2) * g2
+        elif opt == "yogi":
+            vn = vi - (1.0 - b2) * g2 * jnp.sign(vi - g2)
+        elif opt == "adagrad":
+            vn = vi + g2
+        else:
+            raise ValueError(f"unknown server opt {opt!r}")
+        if opt == "adam":
+            mhat = mn / (1.0 - b1 ** t)
+            vhat = vn / (1.0 - b2 ** t)
+        else:
+            mhat, vhat = mn, vn
+        new = p.astype("float32") - lr * mhat / (jnp.sqrt(vhat) + tau)
+        return new.astype(p.dtype), mn, vn
+
+    flat_p, tdef = jax.tree.flatten(prev)
+    flat_a = jax.tree.leaves(avg)
+    flat_m = jax.tree.leaves(m)
+    flat_v = jax.tree.leaves(v)
+    out = [one(p, a, mi, vi) for p, a, mi, vi in zip(flat_p, flat_a, flat_m, flat_v)]
+    news, ms, vs = zip(*out)
+    return tdef.unflatten(news), tdef.unflatten(ms), tdef.unflatten(vs)
+
+
+def bulyan(stacked: Pytree, n_byzantine: int) -> Pytree:
+    """Bulyan (El Mhamdi et al. 2018): iterated Krum selection then
+    coordinate-wise trimmed mean — tolerates f Byzantine among N ≥ 4f + 3.
+
+    θ = N − 2f models are selected one at a time (each round re-runs Krum on
+    the remaining stack, the true iterative variant), then aggregated with a
+    β = f trimmed mean per coordinate. Each iteration is a jitted
+    shape-keyed call, so repeated rounds at the same N reuse executables.
+    """
+    import numpy as np
+
+    n = jax.tree.leaves(stacked)[0].shape[0]
+    f = n_byzantine
+    if n < 4 * f + 3:
+        raise ValueError(f"Bulyan needs N >= 4f + 3 (N={n}, f={f})")
+    theta = n - 2 * f
+
+    remaining = list(range(n))
+    chosen: list[int] = []
+    cur = stacked
+    for _ in range(theta):
+        idx = int(np.asarray(krum_select(cur, n_byzantine=f, multi=1))[0])
+        chosen.append(remaining.pop(idx))
+        keep = jnp.asarray([i for i in range(len(remaining) + 1) if i != idx], dtype=jnp.int32)
+        cur = jax.tree.map(lambda x: jnp.take(x, keep, axis=0), cur)
+
+    sel = jax.tree.map(lambda x: jnp.take(x, jnp.asarray(chosen, dtype=jnp.int32), axis=0), stacked)
+    return trimmed_mean(sel, trim=f)

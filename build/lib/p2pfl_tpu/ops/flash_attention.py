@@ -1,0 +1,520 @@
+"""Pallas flash attention (single chip), forward AND backward.
+
+Blockwise causal attention with online softmax: O(T·D) VMEM per program
+instead of the O(T²) logits matrix. Grid is (batch, heads, q-blocks); each
+program streams K/V blocks up to its causal frontier, keeping running
+(max, denom, accumulator) statistics in fp32 while the matmuls feed the MXU
+in the input dtype.
+
+Training: the custom VJP is backed by two more Pallas kernels (the standard
+flash-attention backward split):
+
+- ``_dq_kernel``  — grid (B, H, q-blocks): recomputes P from the saved
+  log-sum-exp and accumulates ``dQ_i += (P ∘ (dO V^T − Δ)) K · scale``;
+- ``_dkv_kernel`` — grid (B, H, k-blocks): streams the q blocks at or past
+  the causal frontier and accumulates ``dV_j += P^T dO`` and
+  ``dK_j += (P ∘ (dO V^T − Δ))^T Q · scale``.
+
+Residuals are just ``(q, k, v, o, lse)`` — the attention matrix is never
+materialized in either direction, so training long sequences stays O(T·D)
+memory end-to-end (the r1 version rematerialized the backward through dense
+XLA attention, which was O(T²)). The log-sum-exp is saved in a block-aligned
+``[B, H, nq, block_q]`` layout so every kernel ref stays 2D (this
+environment's Mosaic compiler rejects 1D/`.at[]` ref views). Δ = rowsum(dO∘O)
+is a cheap elementwise XLA op computed outside the kernels.
+
+The reference has no attention anywhere (SURVEY §2.9) — this exists for the
+BASELINE config-5 model family and the long-context path.
+
+Playbook: /opt/skills/guides/pallas_guide.md (grid/BlockSpec, online
+softmax accumulation, broadcasted_iota masking, @pl.when).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k, causal, scale):
+    qi = pl.program_id(2)
+    t = k_ref.shape[0]
+    q = q_ref[:].astype(jnp.float32) * scale  # [BQ, D]
+
+    acc = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+    m = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q, 1), jnp.float32)
+
+    n_blocks = t // block_k
+    if causal:
+        # only stream K/V blocks that intersect the causal frontier
+        n_blocks = lax.div((qi + 1) * block_q + block_k - 1, block_k)
+
+    def body(j, carry):
+        acc, m, l = carry
+        k = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [BQ, BK]
+        if causal:
+            rows = qi * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(m_new <= NEG_INF / 2, 0.0, p)
+        alpha = jnp.where(m <= NEG_INF / 2, 0.0, jnp.exp(m - m_new))
+        acc = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        return acc, m_new, l
+
+    acc, m, l = lax.fori_loop(0, n_blocks, body, (acc, m, l))
+    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    # log-sum-exp per row; fully-masked rows keep NEG_INF (exp underflows to 0).
+    # lse_ref holds ALL q-blocks' rows (full-array block — Mosaic's tiling
+    # rule rejects a (1, block_q) block when nq > 1); program qi owns row qi.
+    lse = jnp.where(m <= NEG_INF / 2, NEG_INF, m + jnp.log(jnp.maximum(l, 1e-30)))
+    lse_ref[pl.ds(qi, 1), :] = lse.reshape(1, block_q)
+
+
+def _dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, block_q, block_k, causal, scale
+):
+    qi = pl.program_id(2)
+    t = k_ref.shape[0]
+    q = q_ref[:].astype(jnp.float32)  # [BQ, D]
+    do = do_ref[:].astype(jnp.float32)  # [BQ, D]
+    lse = lse_ref[pl.ds(qi, 1), :].reshape(block_q, 1)  # [BQ, 1]
+    delta = delta_ref[pl.ds(qi, 1), :].reshape(block_q, 1)  # [BQ, 1]
+
+    dq = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+    n_blocks = t // block_k
+    if causal:
+        n_blocks = lax.div((qi + 1) * block_q + block_k - 1, block_k)
+
+    def body(j, dq):
+        k = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [BQ, BK]
+        if causal:
+            rows = qi * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)  # masked entries underflow to 0
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [BQ, BK]
+        ds = p * (dp - delta)
+        return dq + scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    dq = lax.fori_loop(0, n_blocks, body, dq)
+    dq_ref[:] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    *, block_q, block_k, causal, scale,
+):
+    kj = pl.program_id(2)
+    t = q_ref.shape[0]
+    k = k_ref[:].astype(jnp.float32)  # [BK, D]
+    v = v_ref[:].astype(jnp.float32)  # [BK, D]
+
+    dk = jnp.zeros((block_k, k.shape[-1]), jnp.float32)
+    dv = jnp.zeros((block_k, k.shape[-1]), jnp.float32)
+    n_blocks = t // block_q
+    start = 0
+    if causal:
+        # q blocks strictly before the frontier never see this K block
+        start = lax.div(kj * block_k, block_q)
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[pl.ds(i, 1), :].reshape(block_q, 1)
+        delta = delta_ref[pl.ds(i, 1), :].reshape(block_q, 1)
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [BQ, BK]
+        if causal:
+            rows = i * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            cols = kj * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)  # [BQ, BK]
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [BQ, BK]
+        ds = p * (dp - delta)
+        dk = dk + scale * jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return dk, dv
+
+    dk, dv = lax.fori_loop(start, n_blocks, body, (dk, dv))
+    dk_ref[:] = dk.astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
+def _specs(block_q, block_k, t, d):
+    qspec = pl.BlockSpec((None, None, block_q, d), lambda bi, hi, i: (bi, hi, i, 0))
+    kvfull = pl.BlockSpec((None, None, t, d), lambda bi, hi, i: (bi, hi, 0, 0))
+    # lse/delta live in a block-aligned [B, H, nq, BQ] layout; always mapped
+    # as the FULL (nq, BQ) block — block == array dims satisfies Mosaic's
+    # tiling rule for any block_q, and programs index their own row
+    lse_full = pl.BlockSpec(
+        (None, None, t // block_q, block_q), lambda bi, hi, i: (bi, hi, 0, 0)
+    )
+    return qspec, kvfull, lse_full
+
+
+def _flash_fwd_bthd(q, k, v, *, block_q, block_k, causal, interpret):
+    """q,k,v: [B, H, T, D] → (out [B, H, T, D], lse [B, H, nq, BQ] f32)."""
+    b, h, t, d = q.shape
+    scale = d ** -0.5
+    grid = (b, h, t // block_q)
+    qspec, kvfull, lse_full = _specs(block_q, block_k, t, d)
+    kernel = partial(
+        _flash_kernel, block_q=block_q, block_k=block_k, causal=causal, scale=scale
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[qspec, kvfull, kvfull],
+        out_specs=[qspec, lse_full],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((b, h, t // block_q, block_q), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _flash_bwd_bthd(q, k, v, do, lse, delta, *, block_q, block_k, causal, interpret):
+    b, h, t, d = q.shape
+    scale = d ** -0.5
+    qspec, kvfull, lse_full = _specs(block_q, block_k, t, d)
+    qfull = pl.BlockSpec((None, None, t, d), lambda bi, hi, i: (bi, hi, 0, 0))
+    kvspec = pl.BlockSpec((None, None, block_k, d), lambda bi, hi, j: (bi, hi, j, 0))
+
+    dq = pl.pallas_call(
+        partial(_dq_kernel, block_q=block_q, block_k=block_k, causal=causal, scale=scale),
+        grid=(b, h, t // block_q),
+        in_specs=[qspec, kvfull, kvfull, qspec, lse_full, lse_full],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        partial(_dkv_kernel, block_q=block_q, block_k=block_k, causal=causal, scale=scale),
+        grid=(b, h, t // block_k),
+        in_specs=[qfull, kvspec, kvspec, qfull, lse_full, lse_full],
+        out_specs=[kvspec, kvspec],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(
+    q, k, v, causal: bool = True, block_q: int = 128, block_k: int = 128, interpret: bool = False
+):
+    """Flash attention. q,k,v: [B, T, H, D] (GQA heads pre-repeated)."""
+    out, _ = _fwd(q, k, v, causal, block_q, block_k, interpret)
+    return out
+
+
+def _clamp_blocks(t, block_q, block_k):
+    block_q, block_k = min(block_q, t), min(block_k, t)
+    assert t % block_q == 0 and t % block_k == 0, "T must divide the block sizes"
+    return block_q, block_k
+
+
+def _fwd(q, k, v, causal, block_q, block_k, interpret):
+    t = q.shape[1]
+    block_q, block_k = _clamp_blocks(t, block_q, block_k)
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    out, lse = _flash_fwd_bthd(
+        qt, kt, vt, block_q=block_q, block_k=block_k, causal=causal, interpret=interpret
+    )
+    return out.transpose(0, 2, 1, 3), (q, k, v, out, lse)
+
+
+def _bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v, out_bhtd, lse = res
+    t = q.shape[1]
+    block_q, block_k = _clamp_blocks(t, block_q, block_k)
+    b, h = out_bhtd.shape[:2]
+    do = g.transpose(0, 2, 1, 3)  # [B, H, T, D]
+    # Δ_i = Σ_d dO_id · O_id, in the same block-aligned layout as lse
+    delta = (
+        jnp.sum(do.astype(jnp.float32) * out_bhtd.astype(jnp.float32), axis=-1)
+        .reshape(b, h, t // block_q, block_q)
+    )
+    dq, dk, dv = _flash_bwd_bthd(
+        q.transpose(0, 2, 1, 3),
+        k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3),
+        do,
+        lse,
+        delta,
+        block_q=block_q,
+        block_k=block_k,
+        causal=causal,
+        interpret=interpret,
+    )
+    return tuple(x.transpose(0, 2, 1, 3) for x in (dq, dk, dv))
+
+
+flash_attention.defvjp(_fwd, _bwd)
+
+
+# ---- offset-aware variants: flash blocks inside ring attention ----
+#
+# Ring attention hands each device K/V blocks from OTHER sequence shards;
+# causal masking then depends on the blocks' global offsets, which are
+# traced values (lax.axis_index) under shard_map. The offsets ride into the
+# kernels as int32 scalars in SMEM — the causal frontier becomes a traced
+# fori_loop bound and the mask compares global row/col indices.
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+
+    _SMEM_SPEC = pl.BlockSpec(memory_space=pltpu.SMEM)
+except ImportError:  # non-TPU pallas build
+    _SMEM_SPEC = pl.BlockSpec(memory_space=None)
+
+
+def _flash_kernel_offs(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k, scale):
+    qi = pl.program_id(2)
+    t = k_ref.shape[0]
+    q_off, k_off = offs_ref[0], offs_ref[1]
+    q = q_ref[:].astype(jnp.float32) * scale
+
+    acc = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+    m = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q, 1), jnp.float32)
+
+    # causal frontier in global coordinates: stream k blocks whose first
+    # column is <= this q block's last row
+    last_row = q_off + (qi + 1) * block_q - 1
+    n_blocks = jnp.clip(lax.div(last_row - k_off, block_k) + 1, 0, t // block_k)
+
+    def body(j, carry):
+        acc, m, l = carry
+        k = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        rows = q_off + qi * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        cols = k_off + j * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(rows >= cols, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        p = jnp.where(m_new <= NEG_INF / 2, 0.0, p)
+        alpha = jnp.where(m <= NEG_INF / 2, 0.0, jnp.exp(m - m_new))
+        acc = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        return acc, m_new, l
+
+    acc, m, l = lax.fori_loop(0, n_blocks, body, (acc, m, l))
+    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    lse = jnp.where(m <= NEG_INF / 2, NEG_INF, m + jnp.log(jnp.maximum(l, 1e-30)))
+    lse_ref[pl.ds(qi, 1), :] = lse.reshape(1, block_q)
+
+
+def _dq_kernel_offs(
+    offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, glse_ref, dq_ref,
+    *, block_q, block_k, scale,
+):
+    qi = pl.program_id(2)
+    t = k_ref.shape[0]
+    q_off, k_off = offs_ref[0], offs_ref[1]
+    q = q_ref[:].astype(jnp.float32)
+    do = do_ref[:].astype(jnp.float32)
+    lse = lse_ref[pl.ds(qi, 1), :].reshape(block_q, 1)
+    delta = delta_ref[pl.ds(qi, 1), :].reshape(block_q, 1)
+    # d lse / d s = softmax row, so the lse cotangent adds into ds
+    glse = glse_ref[pl.ds(qi, 1), :].reshape(block_q, 1)
+
+    dq = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+    last_row = q_off + (qi + 1) * block_q - 1
+    n_blocks = jnp.clip(lax.div(last_row - k_off, block_k) + 1, 0, t // block_k)
+
+    def body(j, dq):
+        k = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        rows = q_off + qi * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        cols = k_off + j * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(rows >= cols, s, NEG_INF)
+        # rows invisible in this hop have lse = -inf: p must be 0, not nan
+        p = jnp.where(lse <= NEG_INF / 2, 0.0, jnp.exp(s - lse))
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta + glse)
+        return dq + scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    dq = lax.fori_loop(0, n_blocks, body, dq)
+    dq_ref[:] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel_offs(
+    offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, glse_ref, dk_ref, dv_ref,
+    *, block_q, block_k, scale,
+):
+    kj = pl.program_id(2)
+    t = q_ref.shape[0]
+    q_off, k_off = offs_ref[0], offs_ref[1]
+    k = k_ref[:].astype(jnp.float32)
+    v = v_ref[:].astype(jnp.float32)
+
+    dk = jnp.zeros((block_k, k.shape[-1]), jnp.float32)
+    dv = jnp.zeros((block_k, k.shape[-1]), jnp.float32)
+    nq = t // block_q
+    # first q block whose last global row reaches this k block's first col
+    first_col = k_off + kj * block_k
+    start = jnp.clip(lax.div(first_col - q_off, block_q), 0, nq)
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[pl.ds(i, 1), :].reshape(block_q, 1)
+        delta = delta_ref[pl.ds(i, 1), :].reshape(block_q, 1)
+        glse = glse_ref[pl.ds(i, 1), :].reshape(block_q, 1)
+        s = scale * jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        rows = q_off + i * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        cols = k_off + kj * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.where(lse <= NEG_INF / 2, 0.0, jnp.exp(s - lse))
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta + glse)
+        dk = dk + scale * jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return dk, dv
+
+    dk, dv = lax.fori_loop(start, nq, body, (dk, dv))
+    dk_ref[:] = dk.astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def flash_attention_block(q, k, v, q_off, k_off, block_q=128, block_k=128, interpret=False):
+    """One causal-by-global-offset attention block: q attends k/v where
+    ``q_off + i >= k_off + j``. q,k,v: [B, T, H, D] (T = local shard).
+    ``q_off``/``k_off`` are traced int32 scalars (e.g. ``axis_index * T``
+    under ``shard_map``). Returns ``(out, lse)`` — the log-sum-exp makes
+    results mergeable across blocks (ring attention hops)."""
+    out, lse, _ = _fab_fwd_impl(q, k, v, q_off, k_off, block_q, block_k, interpret)
+    return out, lse
+
+
+def _fab_fwd_impl(q, k, v, q_off, k_off, block_q, block_k, interpret):
+    b, t, h, d = q.shape
+    block_q, block_k = _clamp_blocks(t, block_q, block_k)
+    scale = d ** -0.5
+    offs = jnp.stack([q_off, k_off]).astype(jnp.int32)
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    qspec, kvfull, lse_full = _specs(block_q, block_k, t, d)
+    out, lse = pl.pallas_call(
+        partial(_flash_kernel_offs, block_q=block_q, block_k=block_k, scale=scale),
+        grid=(b, h, t // block_q),
+        in_specs=[_SMEM_SPEC, qspec, kvfull, kvfull],
+        out_specs=[qspec, lse_full],
+        out_shape=[
+            jax.ShapeDtypeStruct(qt.shape, q.dtype),
+            jax.ShapeDtypeStruct((b, h, t // block_q, block_q), jnp.float32),
+        ],
+        interpret=interpret,
+    )(offs, qt, kt, vt)
+    return out.transpose(0, 2, 1, 3), lse, out
+
+
+def _fab_fwd(q, k, v, q_off, k_off, block_q, block_k, interpret):
+    out, lse, out_bhtd = _fab_fwd_impl(q, k, v, q_off, k_off, block_q, block_k, interpret)
+    return (out, lse), (q, k, v, q_off, k_off, out_bhtd, lse)
+
+
+def _fab_bwd(block_q, block_k, interpret, res, cts):
+    g, g_lse = cts  # the ring merge differentiates through lse too
+    q, k, v, q_off, k_off, out_bhtd, lse = res
+    b, t, h, d = q.shape
+    block_q, block_k = _clamp_blocks(t, block_q, block_k)
+    scale = d ** -0.5
+    offs = jnp.stack([q_off, k_off]).astype(jnp.int32)
+    do = g.transpose(0, 2, 1, 3)
+    delta = (
+        jnp.sum(do.astype(jnp.float32) * out_bhtd.astype(jnp.float32), axis=-1)
+        .reshape(b, h, t // block_q, block_q)
+    )
+    qspec, kvfull, lse_full = _specs(block_q, block_k, t, d)
+    qfull = pl.BlockSpec((None, None, t, d), lambda bi, hi, i: (bi, hi, 0, 0))
+    kvspec = pl.BlockSpec((None, None, block_k, d), lambda bi, hi, j: (bi, hi, j, 0))
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+
+    # rows invisible in this hop (lse at the -1e30 sentinel) carry no lse
+    # gradient; NEG_INF is finite, so compare, don't isfinite
+    g_lse = jnp.where(lse <= NEG_INF / 2, 0.0, g_lse.astype(jnp.float32))
+    dq = pl.pallas_call(
+        partial(_dq_kernel_offs, block_q=block_q, block_k=block_k, scale=scale),
+        grid=(b, h, t // block_q),
+        in_specs=[_SMEM_SPEC, qspec, kvfull, kvfull, qspec, lse_full, lse_full, lse_full],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        interpret=interpret,
+    )(offs, qt, kt, vt, do, lse, delta, g_lse)
+    dk, dv = pl.pallas_call(
+        partial(_dkv_kernel_offs, block_q=block_q, block_k=block_k, scale=scale),
+        grid=(b, h, t // block_k),
+        in_specs=[_SMEM_SPEC, qfull, kvspec, kvspec, qfull, lse_full, lse_full, lse_full],
+        out_specs=[kvspec, kvspec],
+        out_shape=[
+            jax.ShapeDtypeStruct(kt.shape, k.dtype),
+            jax.ShapeDtypeStruct(vt.shape, v.dtype),
+        ],
+        interpret=interpret,
+    )(offs, qt, kt, vt, do, lse, delta, g_lse)
+    dq, dk, dv = (x.transpose(0, 2, 1, 3) for x in (dq, dk, dv))
+    zero = jnp.zeros((), jnp.float32)  # int offsets carry no gradient
+    return dq, dk, dv, zero, zero
+
+
+flash_attention_block.defvjp(_fab_fwd, _fab_bwd)

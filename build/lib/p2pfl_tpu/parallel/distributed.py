@@ -1,0 +1,72 @@
+"""Multi-host bootstrap: the DCN side of the communication backend.
+
+One process per host, ``jax.distributed.initialize`` to form the global
+runtime; after that every mesh built from ``jax.devices()`` spans the whole
+slice/pod and the SPMD federations in this package scale transparently —
+collectives ride ICI within a slice and DCN across slices, with XLA picking
+the routing. This is the rebuild's counterpart to the reference's "start a
+gRPC server per node" bring-up (``grpc_server.py:74-88``): here the hosts
+form one SPMD world instead of a socket overlay.
+
+Single-host (or already-initialized) calls are no-ops, so the same script
+runs on a laptop and on a pod.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+from p2pfl_tpu.management.logger import logger
+
+_initialized = False
+
+
+def init_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> dict:
+    """Join (or skip joining) the multi-host JAX runtime.
+
+    With no arguments, reads the standard env vars
+    (``JAX_COORDINATOR_ADDRESS``, ``JAX_NUM_PROCESSES``, ``JAX_PROCESS_ID``
+    — also set automatically on TPU pods) and no-ops when absent.
+    Returns a summary dict for logging/tests.
+    """
+    global _initialized
+    coordinator_address = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    num_processes = num_processes or _env_int("JAX_NUM_PROCESSES")
+    process_id = process_id if process_id is not None else _env_int("JAX_PROCESS_ID")
+
+    if not _initialized and (coordinator_address or _on_tpu_pod()):
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        _initialized = True
+
+    info = {
+        "initialized": _initialized,
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }
+    logger.info("distributed", f"runtime: {info}")
+    return info
+
+
+def _env_int(name: str) -> Optional[int]:
+    val = os.environ.get(name)
+    return int(val) if val else None
+
+
+def _on_tpu_pod() -> bool:
+    """True when TPU pod metadata is present (initialize() self-configures)."""
+    return bool(os.environ.get("TPU_WORKER_HOSTNAMES")) and bool(
+        os.environ.get("TPU_WORKER_ID")
+    )

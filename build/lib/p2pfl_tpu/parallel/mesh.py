@@ -1,0 +1,44 @@
+"""Mesh construction helpers.
+
+Axis convention (Settings.MESH_NODES_AXIS / MESH_MODEL_AXIS):
+- ``nodes``: one federated node per slot — data-parallel across the
+  federation; collectives over this axis ride ICI within a slice.
+- ``model``: intra-node model sharding (tensor/sequence parallel) for
+  models too big for one chip (BASELINE config 5). Size 1 by default.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from p2pfl_tpu.settings import Settings
+
+
+def federation_mesh(
+    n_nodes: Optional[int] = None,
+    model_parallel: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a ``(nodes, model)`` mesh from the available devices.
+
+    ``n_nodes`` is the number of mesh slots along the nodes axis — logical
+    federated nodes are folded onto slots (multiple nodes per slot when the
+    federation is larger than the device count). Defaults to
+    ``len(devices) // model_parallel``.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if model_parallel < 1 or len(devices) % model_parallel != 0:
+        raise ValueError(f"model_parallel={model_parallel} does not divide {len(devices)} devices")
+    slots = len(devices) // model_parallel
+    if n_nodes is not None:
+        slots = min(slots, n_nodes)
+        # keep the mesh rectangular: use the largest slot count that divides evenly
+        while len(devices) % (slots * model_parallel) != 0:
+            slots -= 1
+    use = devices[: slots * model_parallel]
+    arr = np.array(use).reshape(slots, model_parallel)
+    return Mesh(arr, (Settings.MESH_NODES_AXIS, Settings.MESH_MODEL_AXIS))

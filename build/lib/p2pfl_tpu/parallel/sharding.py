@@ -1,0 +1,87 @@
+"""Parameter sharding rules: tensor parallelism over the ``model`` mesh axis.
+
+For models too big (or too slow) for one chip, transformer weights shard
+over ``Settings.MESH_MODEL_AXIS`` following the Megatron pattern:
+
+- attention q/k/v projections: column-parallel (shard the head/output dim),
+- attention output projection: row-parallel (shard the input dim),
+- MLP gate/up (w1/w3): column-parallel; down (w2): row-parallel,
+- embeddings: shard the vocab dim; norms and LoRA adapters replicate
+  (adapters are tiny and are the federated payload — keeping them
+  replicated makes the FedAvg collective mesh-local).
+
+XLA inserts the matching all-reduces at the row-parallel boundaries; with
+sequence sharded on the same axis (ring attention) activations stay
+distributed end to end.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from p2pfl_tpu.settings import Settings
+
+Pytree = Any
+
+# (path regex, spec builder) — first match wins; paths look like
+# "layer_0/attn/wq/kernel". LoRA params replicate (they're the federated unit).
+_RULES: list[tuple[str, tuple]] = [
+    (r"lora_", ()),  # replicated
+    (r"attn/(wq|wk|wv)/kernel", (None, "model")),  # column-parallel
+    (r"attn/wo/kernel", ("model", None)),  # row-parallel
+    (r"mlp/(w1|w3)/kernel", (None, "model")),  # column-parallel
+    (r"mlp/w2/kernel", ("model", None)),  # row-parallel
+    # expert parallelism: MoE expert stacks [E, ...] shard the expert axis;
+    # XLA turns the dispatch/combine einsums into token all-to-alls.
+    # Router replicates (every chip routes its own tokens).
+    (r"mlp/router$", ()),
+    (r"mlp/w[123]$", ("model", None, None)),
+    (r"embed", ("model", None)),  # vocab-sharded embeddings
+]
+
+
+def partition_spec_for(path: str) -> P:
+    for pattern, axes in _RULES:
+        if re.search(pattern, path):
+            named = tuple(
+                Settings.MESH_MODEL_AXIS if a == "model" else a for a in axes
+            )
+            return P(*named)
+    return P()  # replicate (norm scales, biases)
+
+
+def _path_str(key_path) -> str:
+    parts = []
+    for p in key_path:
+        parts.append(str(getattr(p, "key", getattr(p, "idx", getattr(p, "name", p)))))
+    return "/".join(parts)
+
+
+def transformer_shardings(mesh: Mesh, params: Pytree) -> Pytree:
+    """NamedSharding pytree for a transformer param tree on ``mesh``."""
+
+    def one(key_path, leaf):
+        spec = partition_spec_for(_path_str(key_path))
+        # drop axis specs that don't divide the dim (tiny configs on big meshes)
+        fixed = []
+        for i, axis in enumerate(spec):
+            if axis is None:
+                fixed.append(None)
+                continue
+            size = mesh.shape[axis]
+            if i < leaf.ndim and leaf.shape[i] % size == 0:
+                fixed.append(axis)
+            else:
+                fixed.append(None)
+        return NamedSharding(mesh, P(*fixed))
+
+    return jax.tree_util.tree_map_with_path(one, params)
+
+
+def shard_transformer(mesh: Mesh, params: Pytree) -> Pytree:
+    """Place a transformer param tree onto the mesh per the TP rules."""
+    return jax.device_put(params, transformer_shardings(mesh, params))

@@ -1,0 +1,167 @@
+"""Global configuration knobs.
+
+Mirrors the reference's single mutable ``Settings`` class
+(``p2pfl/settings.py:26-115``): class attributes mutated in place, read by
+every layer. Same knob names where the concept is the same, so users of the
+reference find what they expect; TPU-specific knobs are added at the bottom.
+"""
+
+from __future__ import annotations
+
+
+class Settings:
+    """Mutable global settings (class attributes, no instances needed)."""
+
+    # --- general ---
+    GRPC_TIMEOUT: float = 10.0  # seconds; also used by the memory transport
+    LOG_LEVEL: str = "INFO"
+    LOG_DIR: str = "logs"
+    EXCLUDE_BEAT_LOGS: bool = True
+
+    # --- heartbeat (membership / failure detection) ---
+    HEARTBEAT_PERIOD: float = 2.0
+    HEARTBEAT_TIMEOUT: float = 5.0
+
+    # --- gossip (message plane) ---
+    GOSSIP_PERIOD: float = 0.1
+    TTL: int = 10
+    GOSSIP_MESSAGES_PER_PERIOD: int = 100
+    AMOUNT_LAST_MESSAGES_SAVED: int = 100
+
+    # --- gossip (model plane) ---
+    GOSSIP_MODELS_PERIOD: float = 1.0
+    GOSSIP_MODELS_PER_ROUND: int = 2
+    GOSSIP_EXIT_ON_X_EQUAL_ROUNDS: int = 10
+
+    # --- learning round ---
+    TRAIN_SET_SIZE: int = 4
+    VOTE_TIMEOUT: float = 60.0
+    AGGREGATION_TIMEOUT: float = 300.0
+    WAIT_HEARTBEATS_CONVERGENCE: float = 1.0
+    # The reference votes only in round 0 and reuses that train set forever
+    # (``round_finished_stage.py:69-70``). False replicates that; True
+    # re-elects every round (recommended when nodes churn).
+    VOTE_EVERY_ROUND: bool = False
+
+    # --- monitoring ---
+    RESOURCE_MONITOR_PERIOD: float = 1.0
+    # Stall watchdog (management/watchdog.py): when > 0, a daemon thread
+    # dumps every thread's stack if a learning node makes no stage
+    # transition for this many seconds. Detection only; 0 disables.
+    STALL_WATCHDOG_S: float = 0.0
+
+    # --- TPU-native additions ---
+    # Default dtype for on-wire / aggregation math. bfloat16 keeps matmuls on
+    # the MXU; aggregation accumulates in float32 for exactness.
+    COMPUTE_DTYPE: str = "bfloat16"
+    AGG_DTYPE: str = "float32"
+    # Donate weight buffers into jitted aggregation / train steps.
+    DONATE_BUFFERS: bool = True
+    # Mesh axis names used by the parallel runtime.
+    MESH_NODES_AXIS: str = "nodes"
+    MESH_MODEL_AXIS: str = "model"
+    # Outgoing gRPC frame format: "envelope" (compact JSON-header frames,
+    # the default) | "protobuf" (the reference's node.proto schema —
+    # communication/proto_wire.py; control plane fully interoperable with
+    # a reference node, weight payloads stay the safe P2TW codec).
+    # Receivers sniff per frame, so mixed-format federations interoperate
+    # regardless of this knob.
+    WIRE_FORMAT: str = "envelope"
+    # Wire compression for network transports: "none" | "int8" | "topk8"
+    # (int8 = symmetric per-tensor quantization, 4x smaller gossip payloads,
+    # native C++ hot loop when p2pfl_tpu/native is built; topk8 = top-k
+    # sparsified int8 DELTAS against the round-start global model — 0.25
+    # bytes/param at the default fraction, 16x under dense float32 — with
+    # error feedback).
+    WIRE_COMPRESSION: str = "none"
+    # Fraction of delta coordinates kept per tensor by topk8.
+    TOPK_FRACTION: float = 0.05
+    # Error feedback for topk8: dropped coordinates accumulate locally and
+    # re-enter the next round's delta (Seide et al. 2014).
+    TOPK_ERROR_FEEDBACK: bool = True
+    # Secure aggregation (pairwise masking, learning/secagg.py): when True,
+    # train-set nodes Diffie-Hellman a seed per peer at experiment start and
+    # mask their model contribution; masks cancel in the FedAvg sum, so no
+    # individual model ever crosses the wire in the clear. FedAvg only.
+    SECURE_AGGREGATION: bool = False
+    # Per-pair Gaussian mask scale: pair (i,j) is masked at
+    # STD*sqrt(w_j/w_i) on node i (sample counts announced with the DH
+    # keys), so the mask drowns the parameters regardless of how large the
+    # local datasets are. Requires WIRE_COMPRESSION="none".
+    SECAGG_MASK_STD: float = 100.0
+    # Sequence length at/above which attn="auto" picks the Pallas flash
+    # kernel over fused dense XLA attention (TPU backends only — anywhere
+    # else the kernel runs in interpret mode and "auto" stays dense).
+    # Crossover measured on the real chip by bench config 7 (BASELINE.md
+    # row 7, BENCH_SUITE.json). Round-3 block tuning (the kernel's
+    # block_q/block_k swept per length) moved it from 4096 down to 1024:
+    # at block 512 flash beats dense 1.40x at T=1024, 1.67x at 2048,
+    # 3.84x at 4096. Below 1024 dense remains the default (unmeasured
+    # territory + the fused-logits path is already VMEM-resident there).
+    # Re-tune with `python bench_suite.py 7` if the model shape changes.
+    FLASH_MIN_SEQ_LEN: int = 1024
+    # How long a train-set node waits for peers' secagg_recover seed
+    # disclosures after an aggregation timeout with dropouts, before giving
+    # the round up (keeping the previous global instead of applying noise).
+    SECAGG_RECOVERY_TIMEOUT: float = 30.0
+
+
+def set_low_latency_settings() -> None:
+    """Documented low-latency profile for reliable local networks.
+
+    The defaults above mirror the reference's knobs, which are tuned for
+    lossy wide-area overlays (1 s model-gossip ticks, 2 s heartbeats,
+    60 s vote windows). On a reliable local network — one host, a rack,
+    or a TPU-pod's DCN — those quantize every round to multiples of
+    whole seconds for no benefit. This profile keeps EVERY semantic
+    (same verbs, same stall/timeout exits, same vote formula; only the
+    clocks shrink) while cutting protocol overhead per round to
+    sub-second (fan-out and capacity knobs like GOSSIP_MODELS_PER_ROUND
+    are deliberately untouched):
+
+    - model-gossip tick 1 s → 0.05 s: the tick loop re-checks peer
+      status 20×/s instead of 1×/s, so the diffusion/partial loops exit
+      ~0.5 s after the decisive message instead of up to 1 s + stall
+      window (stall exit stays at GOSSIP_EXIT_ON_X_EQUAL_ROUNDS ticks —
+      the same number of unchanged observations).
+    - heartbeats 2/5 s → 0.3/1.5 s: membership converges in ~0.3 s; the
+      WAIT_HEARTBEATS_CONVERGENCE pause shrinks to match.
+    - vote/aggregation ceilings 60/300 s → 15/60 s: failure detection
+      latency, not steady-state cost — rounds that complete never see
+      them.
+
+    Measured effect (BASELINE config 1, 2-node MNIST MLP, CPU): protocol
+    overhead drops under the per-round compute (fit + eval dominate).
+    """
+    Settings.GRPC_TIMEOUT = 2.0
+    Settings.HEARTBEAT_PERIOD = 0.3
+    Settings.HEARTBEAT_TIMEOUT = 1.5
+    Settings.GOSSIP_PERIOD = 0.02
+    Settings.GOSSIP_MODELS_PERIOD = 0.05
+    Settings.VOTE_TIMEOUT = 15.0
+    Settings.AGGREGATION_TIMEOUT = 60.0
+    Settings.SECAGG_RECOVERY_TIMEOUT = 10.0
+    Settings.WAIT_HEARTBEATS_CONVERGENCE = 0.4
+
+
+def set_test_settings() -> None:
+    """Shrink every timeout for fast tests.
+
+    Reference equivalent: ``p2pfl/utils.py:37-53``.
+    """
+    Settings.GRPC_TIMEOUT = 0.5
+    Settings.HEARTBEAT_PERIOD = 0.3
+    Settings.HEARTBEAT_TIMEOUT = 1.5
+    Settings.GOSSIP_PERIOD = 0.05
+    Settings.TTL = 10
+    Settings.GOSSIP_MESSAGES_PER_PERIOD = 100
+    Settings.AMOUNT_LAST_MESSAGES_SAVED = 100
+    Settings.GOSSIP_MODELS_PERIOD = 0.1
+    Settings.GOSSIP_MODELS_PER_ROUND = 4
+    Settings.GOSSIP_EXIT_ON_X_EQUAL_ROUNDS = 4
+    Settings.TRAIN_SET_SIZE = 4
+    Settings.VOTE_TIMEOUT = 10.0
+    Settings.AGGREGATION_TIMEOUT = 10.0
+    Settings.SECAGG_RECOVERY_TIMEOUT = 6.0
+    Settings.WAIT_HEARTBEATS_CONVERGENCE = 0.4
+    Settings.LOG_LEVEL = "DEBUG"

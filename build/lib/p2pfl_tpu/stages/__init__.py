@@ -1,0 +1,13 @@
+"""Round-workflow FSM (SURVEY §2.2).
+
+A learning experiment is a finite-state machine driven on the node's
+learning thread: each stage does host-side coordination (votes, gossip,
+waiting on events) and invokes device work (train/eval/aggregate) as pure
+jitted functions between states — all blocking stays on host, per the
+build-plan note on blocking control flow vs JAX (SURVEY §7).
+"""
+
+from p2pfl_tpu.stages.stage import Stage
+from p2pfl_tpu.stages.workflow import LearningWorkflow
+
+__all__ = ["Stage", "LearningWorkflow"]

@@ -1,0 +1,586 @@
+"""The six stages of a federated round.
+
+Reference: ``p2pfl/stages/base_node/*.py`` (SURVEY §2.2, call stack §3.3).
+Semantics replicated 1:1 including the documented quirks (voting happens only
+in round 0; the elected train set is reused for all rounds —
+``round_finished_stage.py:69-70``). Device work (fit / evaluate / aggregate)
+happens inside the learner & aggregator as jitted pure functions; every
+``wait`` here is a host-side event.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+import time
+from typing import TYPE_CHECKING, Optional, Type
+
+from p2pfl_tpu.management.logger import logger
+from p2pfl_tpu.settings import Settings
+from p2pfl_tpu.stages.stage import Stage
+
+if TYPE_CHECKING:
+    from p2pfl_tpu.node import Node
+
+
+class StartLearningStage(Stage):
+    """Set up the experiment, synchronize initial weights across the overlay."""
+
+    name = "StartLearningStage"
+
+    @staticmethod
+    def execute(node: "Node") -> Optional[Type[Stage]]:
+        state = node.state
+        state.set_experiment(node.experiment_name, node.total_rounds)
+        logger.experiment_started(node.addr)
+        # fresh experiment: cross-round strategy state (FedOpt moments,
+        # CenteredClip center) from any previous experiment must not leak in
+        node.aggregator.reset_experiment()
+        node.learner.set_epochs(node.epochs)
+        node.learner.set_addr(node.addr)
+
+        if Settings.SECURE_AGGREGATION:
+            from p2pfl_tpu.learning import secagg
+
+            # fail the misconfigurations loudly BEFORE any training: masks
+            # only cancel through a lossless, linear aggregation path
+            if Settings.WIRE_COMPRESSION != "none":
+                logger.error(
+                    node.addr,
+                    f"SECURE_AGGREGATION is incompatible with WIRE_COMPRESSION="
+                    f"{Settings.WIRE_COMPRESSION!r}: per-node quantization of the "
+                    "masks breaks exact cancellation — aborting the experiment",
+                )
+                state.clear()
+                return None
+            if not getattr(node.aggregator, "MASK_COMPATIBLE", False):
+                logger.error(
+                    node.addr,
+                    f"SECURE_AGGREGATION requires a linear aggregator (FedAvg "
+                    f"family); {type(node.aggregator).__name__} would operate on "
+                    "masked noise — aborting the experiment",
+                )
+                state.clear()
+                return None
+            # announce this experiment's DH public key (+ sample count, which
+            # peers need for the pair mask scales) so any later train set can
+            # derive pairwise mask seeds (learning/secagg.py)
+            state.secagg_priv, pub = secagg.dh_keypair()
+            # latch the announced count: masking later checks the actual
+            # num_samples against it — peers scale their half of each pair
+            # mask with THIS value, so a silent divergence would break
+            # cancellation undetectably
+            state.secagg_samples = node.learner.get_num_samples()
+            node.protocol.broadcast(
+                node.protocol.build_msg(
+                    "secagg_pub",
+                    [f"{pub:x}", str(state.secagg_samples)],
+                    round=0,
+                )
+            )
+
+        # wait for initial weights: the initiator's event was set by
+        # set_start_learning(); everyone else blocks until init_model arrives
+        # (reference blocks on model_initialized_lock, start_learning_stage.py:78)
+        if not state.model_initialized_event.wait(timeout=Settings.AGGREGATION_TIMEOUT):
+            raise TimeoutError("initial model never arrived")
+        if node.pending_init_update is not None:
+            try:
+                node.learner.set_parameters(node.pending_init_update.params)
+            except Exception as exc:  # noqa: BLE001 — mismatched init stops the node (reference :106-117)
+                logger.error(node.addr, f"Initial model does not match architecture: {exc} — stopping")
+                node.stop_async()
+                return None
+            node.pending_init_update = None
+
+        # push init weights to peers that haven't announced initialization
+        # (reference start_learning_stage.py:80,94-136)
+        def candidates() -> list[str]:
+            neis = node.protocol.get_neighbors(only_direct=True)
+            return [n for n in neis if state.nei_status.get(n, 0) != -1]
+
+        def model_fn(nei: str):
+            update = node.learner.get_model_update()
+            return node.protocol.build_weights("init_model", 0, update)
+
+        node.protocol.gossip_weights(
+            early_stopping_fn=node.learning_interrupted,
+            get_candidates_fn=candidates,
+            status_fn=lambda: sorted(candidates()),
+            model_fn=model_fn,
+        )
+        if node.learning_interrupted():
+            return None
+
+        # every node now holds the round's shared init weights: pin them as
+        # the delta-coding anchor for this round's wire payloads (topk8)
+        node.learner.set_wire_anchor(
+            node.learner.get_parameters(),
+            tag=f"{state.experiment_epoch}:{state.round or 0}",
+        )
+
+        # let heartbeats flood so the full membership is known before voting
+        time.sleep(Settings.WAIT_HEARTBEATS_CONVERGENCE)
+        return VoteTrainSetStage
+
+
+class VoteTrainSetStage(Stage):
+    """Elect the train set by weighted random voting (§2.2 VoteTrainSetStage)."""
+
+    name = "VoteTrainSetStage"
+
+    @staticmethod
+    def execute(node: "Node") -> Optional[Type[Stage]]:
+        state = node.state
+        candidates = list(node.protocol.get_neighbors(only_direct=False)) + [node.addr]
+
+        # cast: up to TRAIN_SET_SIZE random picks, weight ~ floor(U(0,1000)/(i+1))
+        # (reference vote_train_set_stage.py:78-81 — random weights by design)
+        samples = min(Settings.TRAIN_SET_SIZE, len(candidates))
+        picks = random.sample(candidates, samples)
+        my_votes = {n: math.floor(random.randint(0, 1000) / (i + 1)) for i, n in enumerate(picks)}
+        with state.train_set_votes_lock:
+            state.train_set_votes[node.addr] = dict(my_votes)
+        flat: list[str] = []
+        for n, w in my_votes.items():
+            flat += [n, str(w)]
+        node.protocol.broadcast(
+            node.protocol.build_msg("vote_train_set", flat, round=state.round or 0)
+        )
+
+        # collect until every candidate voted or VOTE_TIMEOUT
+        # (reference poll loop :107-165)
+        deadline = time.monotonic() + Settings.VOTE_TIMEOUT
+        while not node.learning_interrupted():
+            with state.train_set_votes_lock:
+                voted = set(state.train_set_votes)
+            if set(candidates) <= voted:
+                break
+            if time.monotonic() >= deadline:
+                logger.info(
+                    node.addr,
+                    f"Vote timeout — proceeding with {len(voted)}/{len(candidates)} votes",
+                )
+                break
+            state.votes_ready_event.wait(timeout=2)
+            state.votes_ready_event.clear()
+        if node.learning_interrupted():
+            return None
+
+        # tally with deterministic tie-break (votes desc, then name desc —
+        # reference :152-155) so every node elects the same set; consume the
+        # votes atomically (reference resets to {} at :160) so a later
+        # election never tallies this round's stale entries
+        with state.train_set_votes_lock:
+            all_votes = {v: dict(w) for v, w in state.train_set_votes.items()}
+            state.train_set_votes.clear()
+        results: dict[str, int] = {}
+        for votes in all_votes.values():
+            for n, w in votes.items():
+                results[n] = results.get(n, 0) + int(w)
+        ranked = sorted(results.items(), key=lambda kv: (kv[1], kv[0]), reverse=True)
+        train_set = [n for n, _ in ranked[: Settings.TRAIN_SET_SIZE]]
+
+        # drop elected nodes that died since (reference :167-178)
+        live = set(node.protocol.get_neighbors(only_direct=False)) | {node.addr}
+        state.train_set = [n for n in train_set if n in live]
+        logger.info(node.addr, f"Train set: {state.train_set}")
+
+        return TrainStage if node.addr in state.train_set else WaitAggregatedModelsStage
+
+
+class TrainStage(Stage):
+    """Local training + partial-aggregation gossip within the train set."""
+
+    name = "TrainStage"
+
+    @staticmethod
+    def execute(node: "Node") -> Optional[Type[Stage]]:
+        state = node.state
+        node.aggregator.set_nodes_to_aggregate(state.train_set)
+        if Settings.SECURE_AGGREGATION:
+            # stash the round-start global: if a dropout makes the round's
+            # masked aggregate unrecoverable, the round is discarded back to
+            # this model instead of applying noise (GossipModelStage)
+            node.round_start_params = node.learner.get_parameters()
+
+        # evaluate current model, share metrics (reference train_stage.py:59-60,95-112)
+        TrainStage._evaluate(node)
+        if node.learning_interrupted():
+            return None
+
+        # local training — the hot loop; one jitted train step per batch
+        node.learner.fit()
+        if node.learning_interrupted():
+            return None
+
+        # contribute own model (masked when secure aggregation is on)
+        own = node.learner.get_model_update()
+        if (
+            Settings.WIRE_COMPRESSION == "topk8"
+            and Settings.TOPK_ERROR_FEEDBACK
+            and not Settings.SECURE_AGGREGATION
+        ):
+            # error feedback rides ONLY on the own train-stage contribution
+            # — exactly one encode per round updates the residual store
+            own.ef_residual = node.learner.ef_residual_store()
+        if Settings.SECURE_AGGREGATION and len(state.train_set) > 1:
+            own = TrainStage._secagg_mask(node, own)
+        if own is not None:
+            covered = node.aggregator.add_model(own)
+            node.protocol.broadcast(
+                node.protocol.build_msg("models_aggregated", covered, round=state.round or 0)
+            )
+
+        TrainStage._gossip_partial_aggregations(node)
+        if node.learning_interrupted():
+            return None
+        return GossipModelStage
+
+    @staticmethod
+    def _secagg_mask(node: "Node", own):
+        """Pairwise-mask the node's contribution (``learning/secagg.py``).
+
+        Peers' DH keys were flooded at experiment start; a short poll covers
+        gossip propagation lag. If masking still cannot be done safely,
+        returns None — the contribution is SKIPPED, never sent unmasked
+        (peers' halves of the pairwise masks would go uncancelled and turn a
+        full-coverage aggregate into undetected noise; incomplete coverage
+        is detected and reported by ``wait_and_get_aggregation`` instead).
+        """
+        from p2pfl_tpu.exceptions import SecAggError
+        from p2pfl_tpu.learning import secagg
+
+        state = node.state
+        peers = [n for n in state.train_set if n != node.addr]
+        deadline = time.monotonic() + Settings.VOTE_TIMEOUT
+        while (
+            any(n not in state.secagg_pubs for n in peers)
+            and time.monotonic() < deadline
+            and not node.learning_interrupted()
+        ):
+            time.sleep(0.1)
+        try:
+            return secagg.mask_update(
+                own,
+                node.addr,
+                state.train_set,
+                state.secagg_priv,
+                dict(state.secagg_pubs),
+                state.experiment_name or "",
+                state.round or 0,
+                announced_samples=state.secagg_samples,
+            )
+        except SecAggError as exc:
+            logger.error(node.addr, f"SecAgg: {exc} — skipping this round's contribution")
+            return None
+
+    @staticmethod
+    def _evaluate(node: "Node") -> None:
+        metrics = node.learner.evaluate()
+        if metrics:
+            flat: list[str] = []
+            for k, v in metrics.items():
+                flat += [k, str(float(v))]
+            node.protocol.broadcast(
+                node.protocol.build_msg("metrics", flat, round=node.state.round or 0)
+            )
+
+    @staticmethod
+    def _gossip_partial_aggregations(node: "Node") -> None:
+        """Push partials to train-set peers until everyone has full coverage.
+
+        Reference ``train_stage.py:83,114-177``: candidates are train-set
+        peers whose announced coverage is incomplete; each gets exactly the
+        contributions it misses; ad-hoc connections are allowed because
+        train-set members may not be direct neighbors.
+        """
+        state = node.state
+        train = set(state.train_set)
+
+        def early_stop() -> bool:
+            return node.learning_interrupted()
+
+        def candidates() -> list[str]:
+            out = []
+            for n in train - {node.addr}:
+                if set(state.models_aggregated.get(n, [])) != train:
+                    out.append(n)
+            return out
+
+        def status():
+            return {n: tuple(sorted(state.models_aggregated.get(n, []))) for n in sorted(train)}
+
+        def model_fn(nei: str):
+            peer_has = state.models_aggregated.get(nei, [])
+            partial = node.aggregator.get_partial_aggregation(peer_has)
+            if partial is None:
+                # robust strategies (SUPPORTS_PARTIALS=False) ship individual
+                # models instead of a pre-average; one per tick, the peer's
+                # coverage broadcasts advance the queue
+                todo = node.aggregator.get_models_to_send(peer_has)
+                if not todo:
+                    return None
+                partial = todo[0]
+            return node.protocol.build_weights("add_model", state.round or 0, partial)
+
+        node.protocol.gossip_weights(
+            early_stopping_fn=early_stop,
+            get_candidates_fn=candidates,
+            status_fn=status,
+            model_fn=model_fn,
+            create_connection=True,
+        )
+
+
+class WaitAggregatedModelsStage(Stage):
+    """Non-train-set path: wait for the aggregated model to be pushed to us."""
+
+    name = "WaitAggregatedModelsStage"
+
+    @staticmethod
+    def execute(node: "Node") -> Optional[Type[Stage]]:
+        node.aggregator.set_waiting_aggregated_model(node.state.train_set)
+        return GossipModelStage
+
+
+class GossipModelStage(Stage):
+    """Close the round's aggregation and diffuse the result outward."""
+
+    name = "GossipModelStage"
+
+    @staticmethod
+    def execute(node: "Node") -> Optional[Type[Stage]]:
+        state = node.state
+        timeout = None
+        if Settings.SECURE_AGGREGATION and node.addr not in state.train_set:
+            # non-train-set nodes only accept a full-coverage diffusion;
+            # leave headroom for the train set's seed-recovery round to
+            # finish before giving up on that diffusion arriving
+            timeout = Settings.AGGREGATION_TIMEOUT + Settings.SECAGG_RECOVERY_TIMEOUT
+        agg = node.aggregator.wait_and_get_aggregation(timeout=timeout)
+        if Settings.SECURE_AGGREGATION:
+            agg = GossipModelStage._secagg_finalize(node, agg)
+        node.learner.set_parameters(agg.params)
+        if node.learning_interrupted():
+            return None
+        node.protocol.broadcast(
+            node.protocol.build_msg("models_ready", [], round=state.round or 0)
+        )
+        if agg.noop_round:
+            # failed secagg recovery: our params are the round-start global,
+            # NOT this round's aggregate — diffusing them with the full
+            # train set as contributors would let behind neighbors adopt
+            # stale params as round-r consensus while recovered peers
+            # diffuse the real aggregate. Finish the round quietly; behind
+            # neighbors get the aggregate from a recovered peer (or no-op
+            # this round exactly as we did).
+            logger.warning(
+                node.addr,
+                "SecAgg: no-op round — skipping outward diffusion of the "
+                "round-start globals (not this round's aggregate)",
+            )
+            return RoundFinishedStage
+
+        # diffusion: push the aggregated model to direct neighbors that are
+        # behind on this round (reference gossip_model_stage.py:100-124)
+        def candidates() -> list[str]:
+            neis = node.protocol.get_neighbors(only_direct=True)
+            return [n for n in neis if state.nei_status.get(n, -1) < (state.round or 0)]
+
+        def model_fn(nei: str):
+            update = node.learner.get_model_update()
+            update.contributors = list(state.train_set)
+            return node.protocol.build_weights("add_model", state.round or 0, update)
+
+        node.protocol.gossip_weights(
+            early_stopping_fn=node.learning_interrupted,
+            get_candidates_fn=candidates,
+            status_fn=lambda: sorted(candidates()),
+            model_fn=model_fn,
+        )
+        if node.learning_interrupted():
+            return None
+        return RoundFinishedStage
+
+    @staticmethod
+    def _secagg_finalize(node: "Node", agg):
+        """Dropout recovery: strip uncancelled masks from a partial aggregate.
+
+        Full coverage → masks cancelled, pass through. Partial coverage
+        (some train-set member died before contributing) → the Bonawitz-style
+        seed-recovery round (``learning/secagg.py`` module docs): every
+        survivor re-discloses its pair seeds *for the missing members only*
+        (``secagg_recover`` broadcast), then everyone subtracts the exact
+        uncancelled mask sum and continues with the survivors' clean partial
+        aggregate — the same graceful degradation the reference's plain path
+        has (``p2pfl/learning/aggregators/aggregator.py:236-242``). If the
+        disclosures do not complete in ``Settings.SECAGG_RECOVERY_TIMEOUT``,
+        the noised aggregate is DISCARDED and the round resolves to the
+        round-start global (a no-op round) rather than destroying the model.
+        """
+        from p2pfl_tpu.learning import secagg
+        from p2pfl_tpu.learning.weights import ModelUpdate
+
+        state = node.state
+        train = set(state.train_set)
+        covered = set(agg.contributors)
+        if covered == train or len(train) <= 1:
+            return agg
+        round_no = state.round or 0
+        missing = sorted(train - covered)
+        survivors = sorted(covered)
+        logger.warning(
+            node.addr,
+            f"SecAgg: round {round_no} aggregate covers {survivors} — "
+            f"recovering from dropout of {missing}",
+        )
+
+        weights: dict[str, int] = {n: pk[1] for n, pk in state.secagg_pubs.items()}
+        if state.secagg_samples is not None:
+            weights[node.addr] = state.secagg_samples
+        recoverable = all(n in weights for n in set(survivors) | set(missing))
+
+        # Recovery is request/response: broadcast WHICH members' masks we
+        # cannot cancel (secagg_need) — every train-set member answers with
+        # its pair seed for exactly those members (SecAggNeedCommand),
+        # INCLUDING peers whose own coverage reached full and finalized
+        # early (coverage views can differ at timeout: a partial that
+        # reached us may have been lost to a peer). Proactively disclose our
+        # own seeds for our own missing set too — peers recovering the same
+        # view get them without a round trip. A LONE survivor never
+        # discloses (its "aggregate" is its own model; the seeds would let
+        # a wire snoop unmask it, and no peer holds anything that needs
+        # them). Divergence note: if a needed disclosure is still lost,
+        # some nodes recover while others no-op the round — they briefly
+        # hold different models, exactly like the reference's plain
+        # partial-timeout path, and the next round's aggregation
+        # re-converges them.
+        # pairs involving this node are locally computable by DH symmetry —
+        # only the strictly-foreign pairs need the gossip plane, and only
+        # when some exist is a secagg_need broadcast justified (a lone
+        # survivor asking would solicit disclosures nobody uses)
+        needed = {
+            (i, j) for i in survivors for j in missing if node.addr not in (i, j)
+        }
+        exp = state.experiment_name or ""
+        if recoverable and needed:
+            node.protocol.broadcast(
+                node.protocol.build_msg(
+                    "secagg_need",
+                    [exp] + sorted({j for _i, j in needed}),
+                    round=round_no,
+                )
+            )
+        if recoverable and node.addr in covered and len(survivors) > 1:
+            for j in missing:
+                if j not in state.secagg_pubs or (round_no, j) in state.secagg_disclosure_sent:
+                    continue
+                state.secagg_disclosure_sent.add((round_no, j))
+                seed = secagg.dh_pair_seed(state.secagg_priv, state.secagg_pubs[j][0], exp)
+                node.protocol.broadcast(
+                    node.protocol.build_msg("secagg_recover", [j, f"{seed:x}"], round=round_no)
+                )
+        deadline = time.monotonic() + Settings.SECAGG_RECOVERY_TIMEOUT
+        while (
+            recoverable
+            and not all((round_no, j, i) in state.secagg_disclosed for i, j in needed)
+            and time.monotonic() < deadline
+            and not node.learning_interrupted()
+        ):
+            time.sleep(0.1)
+
+        seeds: dict[tuple[str, str], int] = {}
+        if recoverable:
+            for i, j in needed:
+                v = state.secagg_disclosed.get((round_no, j, i))
+                if v is None:
+                    recoverable = False
+                    break
+                seeds[(i, j)] = v
+        if recoverable:
+            for i in survivors:
+                for j in missing:
+                    if node.addr == i:
+                        seeds[(i, j)] = secagg.dh_pair_seed(
+                            state.secagg_priv, state.secagg_pubs[j][0], exp
+                        )
+                    elif node.addr == j:
+                        seeds[(i, j)] = secagg.dh_pair_seed(
+                            state.secagg_priv, state.secagg_pubs[i][0], exp
+                        )
+
+        if not recoverable:
+            # ADVICE r2: never apply or diffuse a known-noised model — give
+            # the round up instead, keeping the round-start global
+            logger.error(
+                node.addr,
+                "SecAgg: seed recovery incomplete — discarding the noised "
+                "aggregate; this round is a no-op (round-start global kept)",
+            )
+            prev = getattr(node, "round_start_params", None)
+            if prev is None:
+                prev = node.learner.get_parameters()
+            return ModelUpdate(
+                prev, sorted(train), max(int(agg.num_samples), 1), noop_round=True
+            )
+
+        correction = secagg.dropout_correction(
+            agg.params, survivors, missing, seeds, weights, round_no
+        )
+        params = secagg.apply_dropout_correction(
+            agg.params, correction, float(agg.num_samples)
+        )
+        logger.info(
+            node.addr,
+            f"SecAgg: recovered the survivors' clean aggregate ({len(survivors)} "
+            f"of {len(train)} members, {len(missing)} seed set(s) disclosed)",
+        )
+        return ModelUpdate(params, list(agg.contributors), agg.num_samples)
+
+
+class RoundFinishedStage(Stage):
+    """Advance or finish.
+
+    NOTE: next round skips voting — the round-0 train set is reused for all
+    rounds, replicating the reference (``round_finished_stage.py:69-70``).
+    Documented divergence: the reference sends *every* node (train-set or
+    not) to TrainStage on rounds ≥ 1, so non-elected nodes burn a full local
+    fit whose contribution the aggregator then rejects as foreign; here
+    non-elected nodes return to WaitAggregatedModelsStage, preserving the
+    round-0 split and round outcomes while skipping the dead work.
+    """
+
+    name = "RoundFinishedStage"
+
+    @staticmethod
+    def execute(node: "Node") -> Optional[Type[Stage]]:
+        state = node.state
+        if node.learning_interrupted():
+            logger.info(node.addr, "Early stopping.")
+            return None
+        node.aggregator.clear()
+        state.increase_round()
+        # round boundary: the just-diffused aggregate is the next round's
+        # shared model — re-pin the delta-coding anchor here, NOT inside
+        # set_parameters (this round's remaining diffusion sends must still
+        # delta-code against the anchor the behind nodes hold)
+        node.learner.set_wire_anchor(
+            node.learner.get_parameters(),
+            tag=f"{state.experiment_epoch}:{state.round}",
+        )
+        logger.round_finished(node.addr)
+        if state.round is not None and state.total_rounds is not None and state.round < state.total_rounds:
+            if Settings.VOTE_EVERY_ROUND:
+                return VoteTrainSetStage
+            return TrainStage if node.addr in state.train_set else WaitAggregatedModelsStage
+        # experiment over: final evaluation, clear state
+        metrics = node.learner.evaluate()
+        for k, v in (metrics or {}).items():
+            logger.log_metric(node.addr, k, float(v), round=state.round, experiment=state.experiment_name)
+        logger.experiment_finished(node.addr)
+        # NOTE: cross-round strategy state (FedOpt moments, clip centers) is
+        # NOT wiped here — it stays inspectable after the run; the next
+        # experiment's StartLearningStage resets it before anything happens
+        state.clear()
+        return None

@@ -1,0 +1,34 @@
+"""Workflow loop (reference ``p2pfl/stages/workflows.py:28-47``)."""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+from p2pfl_tpu.management.logger import logger
+
+if TYPE_CHECKING:
+    from p2pfl_tpu.node import Node
+
+
+class LearningWorkflow:
+    """Runs stages until one returns ``None``. Exceptions end the experiment."""
+
+    def run(self, node: "Node") -> None:
+        import time
+
+        from p2pfl_tpu.stages.learning_stages import StartLearningStage
+
+        stage = StartLearningStage
+        while stage is not None:
+            logger.debug(node.addr, f"── stage: {stage.name}")
+            # stall-watchdog instrumentation (management/watchdog.py)
+            node.state.current_stage = stage.name
+            node.state.last_transition = time.monotonic()
+            try:
+                stage = stage.execute(node)
+            except Exception as exc:  # noqa: BLE001 — stage failure ends learning, not the node
+                if node.learning_interrupted():
+                    logger.info(node.addr, f"Learning interrupted during {stage.name}")
+                else:
+                    logger.error(node.addr, f"Stage {stage.name} failed: {exc!r}")
+                return

@@ -1,0 +1,85 @@
+"""Simulation / test harness helpers (reference ``p2pfl/utils.py:37-138``).
+
+Shipped in the package (not test-only), matching the reference: these are the
+supported way for users to script multi-node experiments.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Iterable
+
+import jax
+import numpy as np
+
+from p2pfl_tpu.node import Node
+from p2pfl_tpu.settings import set_test_settings  # noqa: F401 — re-export (reference parity)
+
+
+def wait_convergence(
+    nodes: Iterable[Node], n_neis: int, only_direct: bool = False, wait: float = 5.0
+) -> None:
+    """Block until every node sees ``n_neis`` neighbors (or raise)."""
+    deadline = time.monotonic() + wait
+    nodes = list(nodes)
+    while time.monotonic() < deadline:
+        if all(len(n.get_neighbors(only_direct=only_direct)) == n_neis for n in nodes):
+            return
+        time.sleep(0.05)
+    counts = {n.addr: len(n.get_neighbors(only_direct=only_direct)) for n in nodes}
+    raise AssertionError(f"Convergence not reached: {counts} (wanted {n_neis})")
+
+
+def full_connection(node: Node, nodes: Iterable[Node]) -> None:
+    """Directly connect ``node`` to every node in ``nodes``."""
+    for other in nodes:
+        if other.addr != node.addr:
+            node.connect(other.addr)
+
+
+def connect_line(nodes: list[Node]) -> None:
+    """Line topology: node[i] → node[i+1] (the reference example's shape)."""
+    for a, b in zip(nodes, nodes[1:]):
+        a.connect(b.addr)
+
+
+def wait_to_finish(nodes: Iterable[Node], timeout: float = 120.0, min_experiments: int = 1) -> None:
+    """Poll until every node has run ``min_experiments`` and is idle again.
+
+    Reference ``wait_4_results`` polls ``round is None`` only — which is
+    also true *before* learning threads start, a race this version closes
+    via ``NodeState.experiment_epoch``.
+    """
+    deadline = time.monotonic() + timeout
+    nodes = list(nodes)
+    while time.monotonic() < deadline:
+        if all(
+            n.state.experiment_epoch >= min_experiments and n.state.round is None for n in nodes
+        ):
+            return
+        time.sleep(0.1)
+    status = {n.addr: (n.state.experiment_epoch, n.state.round) for n in nodes}
+    raise AssertionError(f"Nodes did not finish in {timeout}s: (epoch, round)={status}")
+
+
+# reference-parity alias
+wait_4_results = wait_to_finish
+
+
+def check_equal_models(nodes: Iterable[Node], atol: float = 1e-1) -> None:
+    """Assert all nodes hold (approximately) the same parameters.
+
+    Reference: np.allclose with atol=1e-1 (``utils.py:112-138``) — loose
+    because nodes keep training between aggregation and comparison.
+    """
+    params = [n.learner.get_parameters() for n in nodes]
+    first_leaves = jax.tree.leaves(params[0])
+    for other in params[1:]:
+        other_leaves = jax.tree.leaves(other)
+        assert len(first_leaves) == len(other_leaves), "different model structures"
+        for a, b in zip(first_leaves, other_leaves):
+            np.testing.assert_allclose(
+                np.asarray(a, dtype=np.float32),
+                np.asarray(b, dtype=np.float32),
+                atol=atol,
+            )

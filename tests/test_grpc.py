@@ -3,6 +3,7 @@ the reference's own multi-node test mechanism (SURVEY §4)."""
 
 import time
 
+import numpy as np
 import pytest
 
 from p2pfl_tpu.communication.grpc_transport import (
@@ -131,9 +132,28 @@ def test_grpc_soak_eight_nodes_five_rounds(repeat):
     federation-level learning, not per-node perfection).
 
     Runs twice back-to-back (parametrized) — round-3 verdict weak #5: a
-    soak that only passes on an idle machine proves nothing, so the second
-    iteration exercises a host already warmed/loaded by the first."""
+    soak that only passes on an idle machine proves nothing. The second
+    iteration runs with deliberate background CPU load (numpy matmul
+    threads, which release the GIL and genuinely compete on the 1-core
+    host) so the no-eviction claim is tested under contention, not just
+    in-process warmth."""
+    import threading
+
     from p2pfl_tpu.settings import Settings
+
+    stop_load = threading.Event()
+    hogs = []
+    if repeat == 2:
+        def _hog():
+            a = np.random.default_rng(0).standard_normal((384, 384)).astype(np.float32)
+            while not stop_load.is_set():
+                # GIL-free CPU pressure; renormalize so values never overflow
+                a = a @ a
+                a /= max(np.abs(a).max(), np.float32(1.0))
+
+        hogs = [threading.Thread(target=_hog, daemon=True) for _ in range(2)]
+        for h in hogs:
+            h.start()
 
     full = FederatedDataset.synthetic_mnist(n_train=8 * 512, n_test=1024)
     nodes = []
@@ -184,6 +204,9 @@ def test_grpc_soak_eight_nodes_five_rounds(repeat):
         )
         assert after > max(0.85, before + 0.2), (before, after)
     finally:
+        stop_load.set()
+        for h in hogs:
+            h.join(timeout=5)
         (
             Settings.AGGREGATION_TIMEOUT, Settings.VOTE_TIMEOUT,
             Settings.GRPC_TIMEOUT, Settings.HEARTBEAT_PERIOD,
