@@ -15,6 +15,8 @@ from p2pfl_tpu.commands.control import (
     SecAggPubCommand,
     SecAggNeedCommand,
     SecAggRecoverCommand,
+    SecAggRevealCommand,
+    SecAggShareCommand,
     VoteTrainSetCommand,
 )
 from p2pfl_tpu.commands.heartbeat import HeartbeatCommand
@@ -38,6 +40,8 @@ __all__ = [
     "SecAggPubCommand",
     "SecAggNeedCommand",
     "SecAggRecoverCommand",
+    "SecAggRevealCommand",
+    "SecAggShareCommand",
     "InitModelCommand",
     "AddModelCommand",
 ]

@@ -123,6 +123,9 @@ class SecAggRecoverCommand(Command):
         key = (round, args[0], source)
         # first disclosure wins, same latch rationale as secagg_pub
         st.secagg_disclosed.setdefault(key, seed)
+        # Bonawitz invariant: once ANY pair-seed disclosure about a member
+        # is observed this round, never help reconstruct its self seed
+        st.secagg_round_dropped.add((round, args[0]))
 
 
 class SecAggNeedCommand(Command):
@@ -179,6 +182,12 @@ class SecAggNeedCommand(Command):
             return
         live = set(node.protocol.get_neighbors(only_direct=False))
         for j in args[1:]:
+            if j in train:
+                # a need CLAIM alone poisons j's self-seed reconstruction
+                # for this round (Bonawitz invariant: some peer may answer
+                # it even if we refuse) — conservative, costs availability
+                # only in the forged/split-brain case
+                st.secagg_round_dropped.add((round, j))
             if j == node.addr or j == source or j not in train or j not in st.secagg_pubs:
                 continue
             if j in live:
@@ -186,6 +195,19 @@ class SecAggNeedCommand(Command):
                     st.addr,
                     f"secagg_need from {source} names {j}, which is still live "
                     "here — refusing to disclose its pair seed",
+                )
+                continue
+            if (round, j, j) in st.secagg_share_reveals:
+                # the invariant's OTHER direction: j already revealed its
+                # SELF seed this round (it contributed somewhere, then
+                # died) — disclosing its pair seeds too would publish both
+                # seed types and unmask its captured update. Our aggregate
+                # stays stuck instead (no-op round): privacy > availability.
+                logger.warning(
+                    st.addr,
+                    f"secagg_need from {source} names {j}, whose self seed "
+                    "is already revealed this round — refusing to disclose "
+                    "its pair seeds (it contributed before dying)",
                 )
                 continue
             # Latch per (round, j, REQUESTER), not per (round, j): a lagging
@@ -210,6 +232,135 @@ class SecAggNeedCommand(Command):
             node.protocol.broadcast(
                 node.protocol.build_msg("secagg_recover", [j, f"{seed:x}"], round=round)
             )
+
+
+class SecAggShareCommand(Command):
+    """A contributor distributed Shamir shares of its per-round self-mask
+    seed (Bonawitz double masking, ``learning/secagg.py``).
+
+    Args: ``[experiment, holder1, x1, ct1_hex, holder2, x2, ct2_hex, ...]``
+    — one encrypted share per train-set peer, all in one broadcast; each
+    holder decrypts only its own entry (stream-keyed by the DH pair seed
+    and the round). Stored under (round, owner); first delivery wins.
+    """
+
+    def __init__(self, state: "NodeState") -> None:
+        self._state = state
+
+    @staticmethod
+    def get_name() -> str:
+        return "secagg_share"
+
+    def execute(self, source: str, round: int, *args, **kwargs) -> None:  # noqa: A002
+        from p2pfl_tpu.exceptions import SecAggError
+        from p2pfl_tpu.learning import secagg
+
+        st = self._state
+        if st.secagg_priv is None or len(args) < 4 or (len(args) - 1) % 3 != 0:
+            return
+        if st.round is None or round not in (st.round - 1, st.round, st.round + 1):
+            # same window discipline as secagg_reveal/_recover, plus one
+            # round AHEAD (shares are distributed during TrainStage, where
+            # a fast peer can be a round past us); without a window a noisy
+            # peer could grow secagg_shares_held unboundedly with
+            # fabricated round numbers
+            return
+        if (round, source) in st.secagg_shares_held:
+            return  # gossip redundancy / replay: first delivery latched
+        exp = st.experiment_name or ""
+        if args[0] != exp:
+            return
+        if source not in st.secagg_pubs:
+            logger.debug(st.addr, f"secagg_share from {source} before its key — ignored")
+            return
+        for i in range(1, len(args), 3):
+            holder, x_str, ct_hex = args[i], args[i + 1], args[i + 2]
+            if holder != st.addr:
+                continue
+            try:
+                x = int(x_str)
+                ct = bytes.fromhex(ct_hex)
+                key = secagg.dh_share_key(st.secagg_priv, st.secagg_pubs[source][0], exp)
+                y = secagg.decrypt_share(ct, key, round, source, st.addr)
+            except (ValueError, SecAggError):
+                logger.error(st.addr, f"Malformed secagg_share from {source}")
+                return
+            if not 1 <= x <= 1024 or not 0 <= y < secagg.SHAMIR_PRIME:
+                logger.error(st.addr, f"Out-of-range secagg_share from {source} — rejected")
+                return
+            st.secagg_shares_held[(round, source)] = (x, y)
+            return
+
+
+class SecAggRevealCommand(Command):
+    """A share-reveal for a contributor's per-round self-mask seed.
+
+    Args: ``[experiment, owner, x, y_hex]``. ``x == 0`` is the owner's
+    DIRECT disclosure (y = b^r itself, only accepted from the owner);
+    ``x >= 1`` is a holder revealing its Shamir share. Stored under
+    (round, owner, revealer), first value wins — the finalize routine
+    reconstructs once ``share_threshold`` distinct x's are present.
+    """
+
+    def __init__(self, state: "NodeState") -> None:
+        self._state = state
+
+    @staticmethod
+    def get_name() -> str:
+        return "secagg_reveal"
+
+    def execute(self, source: str, round: int, *args, **kwargs) -> None:  # noqa: A002
+        from p2pfl_tpu.learning import secagg
+
+        st = self._state
+        if len(args) < 4:
+            logger.error(st.addr, f"Malformed secagg_reveal from {source}")
+            return
+        exp = st.experiment_name or ""
+        if args[0] != exp:
+            return
+        owner = args[1]
+        try:
+            x = int(args[2])
+            y = int(args[3], 16)
+        except ValueError:
+            logger.error(st.addr, f"Malformed secagg_reveal values from {source}")
+            return
+        if not 0 <= x <= 1024 or not 0 <= y < secagg.SHAMIR_PRIME:
+            logger.error(st.addr, f"Out-of-range secagg_reveal from {source} — rejected")
+            return
+        if x == 0 and (source != owner or y >= (1 << 256)):
+            # direct seed disclosures only from the owner, and only
+            # seed-sized (an oversized value would blow up _leaf_mask's
+            # to_bytes(32) mid-finalize on every node)
+            logger.error(st.addr, f"Invalid direct secagg_reveal from {source} — rejected")
+            return
+        if x >= 1:
+            # Shamir-share reveals: only train-set members have standing,
+            # and each holder's share index is DETERMINED by the sorted
+            # holder list (TrainStage zips sorted(peers) with x = 1..n) —
+            # enforcing it means a forger cannot inject a bogus point at an
+            # unused x and poison every honest node's Lagrange
+            # reconstruction into a permanent no-op round
+            train = set(st.train_set)
+            if source not in train or owner not in train or source == owner:
+                logger.debug(st.addr, f"secagg_reveal share from {source} without standing — ignored")
+                return
+            holders = sorted(m for m in st.train_set if m != owner)
+            if source not in holders or x != holders.index(source) + 1:
+                logger.error(
+                    st.addr,
+                    f"secagg_reveal share from {source} with index {x} != its "
+                    "assigned share index — rejected (forgery or stale train set)",
+                )
+                return
+        if st.round is not None and round not in (st.round - 1, st.round, st.round + 1):
+            # one round AHEAD is legitimate: reveals are latched send-once,
+            # and a fast peer already finalizing round r+1 broadcasts its
+            # direct reveal while we are still resolving round r — dropping
+            # it would permanently starve OUR r+1 finalize
+            return
+        st.secagg_share_reveals.setdefault((round, owner, source), (x, y))
 
 
 class VoteTrainSetCommand(Command):
@@ -241,19 +392,39 @@ class VoteTrainSetCommand(Command):
 
 
 class ModelsAggregatedCommand(Command):
-    """Peer reports which contributors it has folded in this round."""
+    """Peer reports which contributors it has folded in this round.
 
-    def __init__(self, state: "NodeState") -> None:
-        self._state = state
+    Under Bonawitz double masking this is also the earliest SAFE moment to
+    reveal our own per-round self-mask seed: a peer's coverage naming us
+    means our masked update is irreversibly folded into the round's
+    aggregation, and waiting until our OWN finalize would make the slowest
+    node's aggregation timeout starve every peer's seed resolution. The
+    reveal stays gated on the at-most-one-of-{pair,self} invariant
+    (``secagg_round_dropped``); while we are alive, peers refuse to
+    disclose our pair seeds anyway (SecAggNeedCommand's liveness check).
+    """
+
+    def __init__(self, node) -> None:  # "Node"; untyped to avoid the import cycle
+        self._node = node
 
     @staticmethod
     def get_name() -> str:
         return "models_aggregated"
 
     def execute(self, source: str, round: int, *args, **kwargs) -> None:  # noqa: A002
-        st = self._state
-        if st.round is not None and round == st.round:
-            st.models_aggregated[source] = list(args)
+        node = self._node
+        st = node.state
+        if st.round is None or round != st.round:
+            return
+        st.models_aggregated[source] = list(args)
+        from p2pfl_tpu.settings import Settings
+
+        if not (Settings.SECURE_AGGREGATION and Settings.SECAGG_DOUBLE_MASK):
+            return
+        if st.addr in args:
+            from p2pfl_tpu.learning.secagg import maybe_reveal_self_seed
+
+            maybe_reveal_self_seed(self._node, round)
 
 
 class ModelsReadyCommand(Command):

@@ -100,6 +100,16 @@ class AddModelCommand(Command):
         if not state.model_initialized_event.is_set():
             logger.debug(state.addr, f"add_model from {source} before init — ignored")
             return
+        if update is not None and update.contributors:
+            from p2pfl_tpu.learning.secagg import CLEAN_MARKER
+
+            if CLEAN_MARKER in update.contributors:
+                # Bonawitz double masking: the diffuser marked this as a
+                # FINALIZED (self-mask-free) aggregate — strip the pseudo-
+                # contributor before any coverage comparison and remember
+                # the cleanliness for GossipModelStage._secagg_finalize
+                update.contributors = [c for c in update.contributors if c != CLEAN_MARKER]
+                update.secagg_clean = True
         if state.round is not None and round < state.round:
             # stale payload from a peer still finishing an older round —
             # most often the previous round's aggregate diffused to a node

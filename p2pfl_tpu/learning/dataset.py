@@ -92,6 +92,8 @@ class FederatedDataset:
         n_test: int = 256,
         determinism: float = 0.9,
         seed: int = 17,
+        shift_frac: float = 0.0,
+        shift_seed: int = 0,
     ) -> "FederatedDataset":
         """Next-token prediction over a near-deterministic Markov chain.
 
@@ -99,9 +101,23 @@ class FederatedDataset:
         (uniform otherwise), so a causal LM can approach ``determinism``
         next-token accuracy — a learnable, download-free LM task. x = tokens,
         y = tokens shifted left (teacher forcing).
+
+        ``shift_frac``: DOMAIN SHIFT — re-derange that fraction of the
+        successor table (deterministically from ``shift_seed``) before
+        generating. A model pretrained on the unshifted chain scores about
+        ``determinism·(1−shift_frac)`` here; closing the gap is the
+        fine-tuning task (bench config 5: LoRA adapters adapt a pretrained
+        base to the shifted domain, the real LoRA use case).
         """
         rng = np.random.default_rng(seed)
         succ = rng.permutation(vocab_size)  # deterministic successor table
+        if shift_frac > 0.0:
+            r2 = np.random.default_rng(shift_seed or (seed + 1000))
+            k = max(2, int(round(shift_frac * vocab_size)))
+            idx = r2.choice(vocab_size, size=k, replace=False)
+            # cyclic rotation of the chosen entries: every selected token's
+            # successor CHANGES (a random permutation would fix ~1/k of them)
+            succ[idx] = np.roll(succ[idx], 1)
 
         def make(n: int, split_seed: int):
             r = np.random.default_rng(seed + split_seed)

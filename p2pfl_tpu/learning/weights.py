@@ -308,6 +308,14 @@ class ModelUpdate:
     #: must never mistake it for the round's authoritative aggregate, so
     #: GossipModelStage skips outward diffusion when set. Never serialized.
     noop_round: bool = False
+    #: True when this update is a FINALIZED (self-mask-free) aggregate a
+    #: peer diffused under Bonawitz double masking — set by AddModelCommand
+    #: when it strips the ``secagg.CLEAN_MARKER`` pseudo-contributor. A
+    #: full-coverage aggregate ASSEMBLED from masked partials is bit-
+    #: different from the clean diffusion (the self-mask sum still rides on
+    #: it), so the finalize step must know which kind it holds. Travels on
+    #: the wire only as the marker, never as a field.
+    secagg_clean: bool = False
     #: round-start global model for delta (topk8) wire coding — never
     #: serialized; attached by the learner, inherited through aggregation
     anchor: Optional[Pytree] = None

@@ -52,6 +52,22 @@ class TransformerConfig:
     moe_capacity: float = 1.25  # capacity factor: C = ceil(k*S/E * factor)
     moe_aux_coef: float = 1e-2  # Switch load-balance loss coefficient
     moe_zloss_coef: float = 1e-3  # router z-loss coefficient
+    # per-block rematerialization: the backward pass keeps activations only
+    # at block boundaries and recomputes the interior — the standard TPU
+    # recipe for fitting big-model / long-sequence training in HBM. Coarser
+    # than wrapping the WHOLE loss in jax.checkpoint (which re-runs the
+    # full forward and still stashes every layer during the recompute);
+    # per-block boundaries bound peak activation memory at one block.
+    remat: bool = False
+    # lax.scan over the block stack instead of Python-unrolled layers:
+    # params stack on a leading [L, ...] axis and the compiled program
+    # contains ONE block body regardless of depth — compile time and
+    # program size stop scaling with n_layers (the unrolled 16L/768d
+    # model's MLIR is big enough to overflow intermediaries; the scanned
+    # one is ~1 layer's worth). The XLA-idiomatic deep-model form.
+    # Incompatible with n_experts>0 for now (sown MoE aux losses don't
+    # thread through nn.scan broadcasts here).
+    scan_layers: bool = False
 
 
 class RMSNorm(nn.Module):
@@ -251,6 +267,19 @@ class Block(nn.Module):
         return x
 
 
+class _ScanBlock(nn.Module):
+    """nn.scan body: one Block step with the (carry, xs) -> (carry, ys)
+    signature lax.scan wants. Params gain a leading [L] axis via
+    ``variable_axes={"params": 0}``."""
+
+    cfg: TransformerConfig
+    attn_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x, _):
+        return Block(self.cfg, self.attn_fn, name="block")(x), None
+
+
 class CausalLM(nn.Module):
     cfg: TransformerConfig
     attn_fn: Optional[Callable] = None
@@ -262,8 +291,29 @@ class CausalLM(nn.Module):
             "embed", nn.initializers.normal(0.02), (cfg.vocab_size, cfg.dim)
         )
         x = emb[tokens].astype(cfg.dtype)
-        for i in range(cfg.n_layers):
-            x = Block(cfg, self.attn_fn, name=f"layer_{i}")(x)
+        if cfg.scan_layers:
+            if cfg.n_experts > 0:
+                raise NotImplementedError(
+                    "scan_layers with MoE: sown aux losses don't thread "
+                    "through this scan — use unrolled layers for MoE"
+                )
+            body = _ScanBlock
+            if cfg.remat:
+                # prevent_cse=False: inside lax.scan the remat thunk can't
+                # be CSE'd across iterations anyway, and True blocks the
+                # scan lowering (flax's documented scan-over-remat recipe)
+                body = nn.remat(body, prevent_cse=False)
+            scan = nn.scan(
+                body,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=cfg.n_layers,
+            )
+            x, _ = scan(cfg, self.attn_fn, name="layers")(x, None)
+        else:
+            block_cls = nn.remat(Block) if cfg.remat else Block
+            for i in range(cfg.n_layers):
+                x = block_cls(cfg, self.attn_fn, name=f"layer_{i}")(x)
         x = RMSNorm(cfg.dtype, name="final_norm")(x)
         logits = jnp.dot(x, emb.T.astype(cfg.dtype))  # tied embeddings
         return logits.astype(jnp.float32)
@@ -358,8 +408,8 @@ def tiny_transformer(
             # blocks must divide the basis and (on TPU Mosaic) be a multiple
             # of 8. Prefer the LARGEST block <= 512: bench config 7's sweep
             # shows bigger blocks amortize the Pallas grid bookkeeping —
-            # block 512 beat 128 at every measured length (e.g. 194 -> 86 ms
-            # at T=4096)
+            # block 512 beat 256 at every measured length (round 4: 112 ->
+            # 75 ms/train-step at T=4096)
             block = next(
                 (b for b in range(512, 7, -1) if basis % b == 0 and b % 8 == 0), None
             )

@@ -22,6 +22,8 @@ from p2pfl_tpu.commands import (
     SecAggPubCommand,
     SecAggNeedCommand,
     SecAggRecoverCommand,
+    SecAggRevealCommand,
+    SecAggShareCommand,
     StartLearningCommand,
     StopLearningCommand,
     VoteTrainSetCommand,
@@ -105,12 +107,14 @@ class Node:
             StopLearningCommand(self),
             ModelInitializedCommand(self.state),
             VoteTrainSetCommand(self.state),
-            ModelsAggregatedCommand(self.state),
+            ModelsAggregatedCommand(self),
             ModelsReadyCommand(self.state),
             MetricsCommand(self.state),
             SecAggPubCommand(self.state),
             SecAggRecoverCommand(self.state),
             SecAggNeedCommand(self),
+            SecAggShareCommand(self.state),
+            SecAggRevealCommand(self.state),
             InitModelCommand(self),
             AddModelCommand(self),
         ):

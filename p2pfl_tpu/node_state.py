@@ -47,6 +47,22 @@ class NodeState:
         # (round, dropped_addr) pairs THIS node already disclosed its seed
         # for (proactively or answering secagg_need) — disclose once
         self.secagg_disclosure_sent: set = set()
+        # Bonawitz double masking (learning/secagg.py self_mask):
+        # round -> this node's own self-mask seed b_i^r
+        self.secagg_self_seed: Dict[int, int] = {}
+        # (round, owner) -> this node's decrypted Shamir share (x, y) of
+        # owner's b^r (from owner's secagg_share broadcast)
+        self.secagg_shares_held: Dict[tuple, tuple] = {}
+        # (round, owner, revealer) -> revealed (x, y); x == 0 means the
+        # owner's DIRECT seed disclosure (y is b^r itself)
+        self.secagg_share_reveals: Dict[tuple, tuple] = {}
+        # (round, owner) reveals THIS node already broadcast — send once
+        self.secagg_reveal_sent: set = set()
+        # (round, addr) members treated as DROPPED this round (own missing
+        # set, a peer's secagg_need, or an observed pair-seed disclosure):
+        # the Bonawitz invariant — never help reconstruct b^r for a node
+        # whose pair seeds round r may have been disclosed
+        self.secagg_round_dropped: set = set()
 
         # monotonically counts experiments entered; lets harnesses distinguish
         # "never started" from "finished" (both have round None)
@@ -93,5 +109,10 @@ class NodeState:
         self.secagg_samples = None
         self.secagg_disclosed = {}
         self.secagg_disclosure_sent = set()
+        self.secagg_self_seed = {}
+        self.secagg_shares_held = {}
+        self.secagg_share_reveals = {}
+        self.secagg_reveal_sent = set()
+        self.secagg_round_dropped = set()
         self.votes_ready_event.clear()
         self.model_initialized_event.clear()

@@ -20,6 +20,7 @@ from p2pfl_tpu.parallel.pipeline import (
 from p2pfl_tpu.parallel.spmd import SpmdFederation
 
 __all__ = [
+    "ChunkedFederation",
     "PipelineFederation",
     "SpmdFederation",
     "SpmdLmFederation",
@@ -32,6 +33,7 @@ __all__ = [
 ]
 
 _LAZY = {
+    "ChunkedFederation": "p2pfl_tpu.parallel.chunked",
     "SpmdLoraFederation": "p2pfl_tpu.parallel.spmd_lora",
     "SpmdLmFederation": "p2pfl_tpu.parallel.spmd_lm",
     "PipelineFederation": "p2pfl_tpu.parallel.spmd_lm",

@@ -479,6 +479,27 @@ def spmd_eval(stacked_params, x_test, y_test, *, module):
 # ---- host-side driver ----
 
 
+def elect_train_set_mask(n: int, py_rng) -> np.ndarray:
+    """Round-0 election: every node casts weighted random votes
+    (``vote_train_set_stage.py:78-81``); top ``TRAIN_SET_SIZE`` win.
+
+    Shared by every federation runtime (SpmdFederation, ChunkedFederation)
+    so the reference vote semantics have exactly one implementation.
+    """
+    names = list(range(n))
+    tally: dict[int, int] = {}
+    k = min(Settings.TRAIN_SET_SIZE, n)
+    for _voter in names:
+        picks = py_rng.sample(names, k)
+        for i, cand in enumerate(picks):
+            tally[cand] = tally.get(cand, 0) + math.floor(py_rng.randint(0, 1000) / (i + 1))
+    ranked = sorted(tally.items(), key=lambda kv: (kv[1], kv[0]), reverse=True)
+    mask = np.zeros(n, dtype=np.float32)
+    for cand, _ in ranked[:k]:
+        mask[cand] = 1.0
+    return mask
+
+
 class SpmdFederation:
     """N federated nodes as one SPMD program over a device mesh.
 
@@ -691,20 +712,7 @@ class SpmdFederation:
     # ---- election (host control plane — reference vote semantics) ----
 
     def elect_train_set(self) -> np.ndarray:
-        """Round-0 election: every node casts weighted random votes
-        (``vote_train_set_stage.py:78-81``); top ``TRAIN_SET_SIZE`` win."""
-        names = list(range(self.n))
-        tally: dict[int, int] = {}
-        k = min(Settings.TRAIN_SET_SIZE, self.n)
-        for _voter in names:
-            picks = self._py_rng.sample(names, k)
-            for i, cand in enumerate(picks):
-                tally[cand] = tally.get(cand, 0) + math.floor(self._py_rng.randint(0, 1000) / (i + 1))
-        ranked = sorted(tally.items(), key=lambda kv: (kv[1], kv[0]), reverse=True)
-        mask = np.zeros(self.n, dtype=np.float32)
-        for cand, _ in ranked[:k]:
-            mask[cand] = 1.0
-        return mask
+        return elect_train_set_mask(self.n, self._py_rng)
 
     # ---- round driver ----
 

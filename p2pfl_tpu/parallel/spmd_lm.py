@@ -446,19 +446,29 @@ class PipelineFederation:
             yield jnp.asarray(d.x_train[idx]), jnp.asarray(d.y_train[idx])
 
     def run_round(self, epochs: int = 1) -> dict:
+        import time
+
+        prof = {"node_epoch_s": [0.0] * self.n, "fedavg_s": 0.0}
         trained, losses = [], []
         for i in range(self.n):
             p = self.params
             o = self._opts[i] if self.keep_opt_state else self.tx.init(p)
+            t0 = time.monotonic()
             for xs, ys in self._node_batches(i, epochs):
                 p, o, loss = self._epoch(p, o, xs, ys)
+            jax.block_until_ready(loss)
+            prof["node_epoch_s"][i] = round(time.monotonic() - t0, 3)
             if self.keep_opt_state:
                 self._opts[i] = o
             trained.append(p)
             losses.append(float(loss))
         # host-side FedAvg — the DCN weight exchange between slices
+        t0 = time.monotonic()
         stacked = tree_stack(trained)
         self.params = fedavg(stacked, jnp.asarray(self._samples))
+        jax.block_until_ready(self.params)
+        prof["fedavg_s"] = round(time.monotonic() - t0, 3)
+        self.last_profile = prof
         self.round += 1
         entry = {"round": self.round, "train_loss": float(np.mean(losses))}
         self.history.append(entry)

@@ -93,17 +93,25 @@ class Settings:
     # kernel over fused dense XLA attention (TPU backends only — anywhere
     # else the kernel runs in interpret mode and "auto" stays dense).
     # Crossover measured on the real chip by bench config 7 (BASELINE.md
-    # row 7, BENCH_SUITE.json). Round-3 block tuning (the kernel's
-    # block_q/block_k swept per length) moved it from 4096 down to 1024:
-    # at block 512 flash beats dense 1.40x at T=1024, 1.67x at 2048,
-    # 3.84x at 4096. Below 1024 dense remains the default (unmeasured
-    # territory + the fused-logits path is already VMEM-resident there).
+    # row 7, BENCH_SUITE.json). Round-4 re-measurement (bf16 MXU kernels,
+    # slope-based in-dispatch timing): at block 512 flash beats dense
+    # 1.38x at T=1024, 1.89x at 2048, 4.15x at 4096 on the train step,
+    # and LOSES 0.55x at T=512 — the threshold stays 1024.
     # Re-tune with `python bench_suite.py 7` if the model shape changes.
     FLASH_MIN_SEQ_LEN: int = 1024
     # How long a train-set node waits for peers' secagg_recover seed
     # disclosures after an aggregation timeout with dropouts, before giving
     # the round up (keeping the previous global instead of applying noise).
     SECAGG_RECOVERY_TIMEOUT: float = 30.0
+    # Full Bonawitz double masking: each contribution also carries a
+    # per-round SELF mask whose seed is t-of-n Shamir-shared with the train
+    # set (learning/secagg.py). Guarantees that for every (node, round) at
+    # most one of {pair seeds, self seed} ever becomes public, so a masked
+    # update captured on the wire stays masked even through dropout
+    # recovery. Costs one extra mask stream + two small control broadcasts
+    # per node per round. False = round-3 behavior (pairwise masks only,
+    # with the documented single-update disclosure risk on dropout).
+    SECAGG_DOUBLE_MASK: bool = True
 
 
 def set_low_latency_settings() -> None:

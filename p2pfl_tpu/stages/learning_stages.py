@@ -260,19 +260,59 @@ class TrainStage(Stage):
             and not node.learning_interrupted()
         ):
             time.sleep(0.1)
+        round_no = state.round or 0
+        # snapshot ONCE: the gossip thread keeps latching pubs while we run;
+        # a key arriving between the double-mask gate and mask_update would
+        # otherwise produce a pair-masked contribution with NO self mask and
+        # no distributed shares — unresolvable for every peer, a guaranteed
+        # federation-wide no-op round
+        pubs = dict(state.secagg_pubs)
+        self_seed = None
+        if Settings.SECAGG_DOUBLE_MASK and all(n in pubs for n in peers):
+            # Bonawitz double mask: fresh per-round self seed, t-of-n
+            # Shamir-shared with the train-set peers BEFORE contributing —
+            # if we crash after our masked update lands, the surviving
+            # majority reconstructs b^r and unsticks the aggregate, while
+            # a wire snoop (who never gets t shares' plaintext — each is
+            # encrypted to its holder) cannot strip the self mask
+            import secrets as _secrets
+
+            self_seed = _secrets.randbits(256)
+            state.secagg_self_seed[round_no] = self_seed
+            holders = sorted(peers)
+            t = secagg.share_threshold(len(state.train_set))
+            shares = secagg.shamir_split(self_seed, len(holders), t)
+            exp = state.experiment_name or ""
+            payload: list[str] = [exp]
+            for holder, (x, y) in zip(holders, shares):
+                key = secagg.dh_share_key(
+                    state.secagg_priv, pubs[holder][0], exp
+                )
+                payload += [
+                    holder,
+                    str(x),
+                    secagg.encrypt_share(y, key, round_no, node.addr, holder).hex(),
+                ]
+            node.protocol.broadcast(
+                node.protocol.build_msg("secagg_share", payload, round=round_no)
+            )
         try:
             return secagg.mask_update(
                 own,
                 node.addr,
                 state.train_set,
                 state.secagg_priv,
-                dict(state.secagg_pubs),
+                pubs,
                 state.experiment_name or "",
-                state.round or 0,
+                round_no,
                 announced_samples=state.secagg_samples,
+                self_seed=self_seed,
             )
         except SecAggError as exc:
             logger.error(node.addr, f"SecAgg: {exc} — skipping this round's contribution")
+            # peers hold shares of our self seed but our masked update never
+            # entered the aggregate: make sure WE never reveal b^r either
+            state.secagg_self_seed.pop(round_no, None)
             return None
 
     @staticmethod
@@ -344,6 +384,20 @@ class WaitAggregatedModelsStage(Stage):
         return GossipModelStage
 
 
+
+def _noop_round_update(node: "Node", train: set):
+    """The shared failed-recovery fallback: keep the round-start globals,
+    flagged ``noop_round`` so GossipModelStage never diffuses them as the
+    round's aggregate (ADVICE r3). One definition — three recovery paths
+    (pair seeds, self seeds, missing weights) must stay in sync."""
+    from p2pfl_tpu.learning.weights import ModelUpdate
+
+    prev = getattr(node, "round_start_params", None)
+    if prev is None:
+        prev = node.learner.get_parameters()
+    return ModelUpdate(prev, sorted(train), 1, noop_round=True)
+
+
 class GossipModelStage(Stage):
     """Close the round's aggregation and diffuse the result outward."""
 
@@ -391,6 +445,13 @@ class GossipModelStage(Stage):
         def model_fn(nei: str):
             update = node.learner.get_model_update()
             update.contributors = list(state.train_set)
+            if Settings.SECURE_AGGREGATION and Settings.SECAGG_DOUBLE_MASK:
+                # mark the diffusion as FINALIZED (self-mask-free): a
+                # receiver's aggregator may otherwise hold a bit-different
+                # full-coverage sum assembled from still-masked partials
+                from p2pfl_tpu.learning.secagg import CLEAN_MARKER
+
+                update.contributors = [*update.contributors, CLEAN_MARKER]
             return node.protocol.build_weights("add_model", state.round or 0, update)
 
         node.protocol.gossip_weights(
@@ -405,16 +466,55 @@ class GossipModelStage(Stage):
 
     @staticmethod
     def _secagg_finalize(node: "Node", agg):
-        """Dropout recovery: strip uncancelled masks from a partial aggregate.
+        """Strip whatever masks remain on the round's resolved aggregate.
 
-        Full coverage → masks cancelled, pass through. Partial coverage
-        (some train-set member died before contributing) → the Bonawitz-style
-        seed-recovery round (``learning/secagg.py`` module docs): every
-        survivor re-discloses its pair seeds *for the missing members only*
-        (``secagg_recover`` broadcast), then everyone subtracts the exact
-        uncancelled mask sum and continues with the survivors' clean partial
-        aggregate — the same graceful degradation the reference's plain path
-        has (``p2pfl/learning/aggregators/aggregator.py:236-242``). If the
+        Three layers, each a no-op when not applicable:
+
+        1. PAIR recovery (partial coverage): the Bonawitz-style seed
+           re-disclosure round (:meth:`_secagg_pair_recovery`).
+        2. SELF-mask removal (``Settings.SECAGG_DOUBLE_MASK``): every
+           contributor's per-round self mask is subtracted once its seed is
+           revealed by its owner — or reconstructed from t-of-n Shamir
+           shares when the owner contributed and then crashed
+           (:meth:`_secagg_self_unmask`).
+        3. Aggregates a peer diffused AFTER finalizing (``secagg_clean``
+           flag from the wire marker) are already mask-free and pass
+           through.
+
+        Any failure resolves to a no-op round (round-start global kept)
+        rather than applying a noised model.
+        """
+        state = node.state
+        train = set(state.train_set)
+        covered = set(agg.contributors)
+        if len(train) <= 1 or agg.secagg_clean or agg.noop_round:
+            return agg
+        if covered != train:
+            agg = GossipModelStage._secagg_pair_recovery(node, agg)
+            if agg.noop_round:
+                return agg
+        elif node.addr not in train:
+            # waiting-mode nodes only ever accept full-coverage diffusions;
+            # an unmarked one predates double masking (or it is off) —
+            # nothing to strip here either way
+            return agg
+        if Settings.SECAGG_DOUBLE_MASK:
+            agg = GossipModelStage._secagg_self_unmask(node, agg)
+        return agg
+
+    @staticmethod
+    def _secagg_pair_recovery(node: "Node", agg):
+        """Dropout recovery: strip uncancelled PAIR masks from a partial
+        aggregate.
+
+        Partial coverage (some train-set member died before contributing) →
+        the Bonawitz-style seed-recovery round (``learning/secagg.py``
+        module docs): every survivor re-discloses its pair seeds *for the
+        missing members only* (``secagg_recover`` broadcast), then everyone
+        subtracts the exact uncancelled mask sum and continues with the
+        survivors' clean partial aggregate — the same graceful degradation
+        the reference's plain path has
+        (``p2pfl/learning/aggregators/aggregator.py:236-242``). If the
         disclosures do not complete in ``Settings.SECAGG_RECOVERY_TIMEOUT``,
         the noised aggregate is DISCARDED and the round resolves to the
         round-start global (a no-op round) rather than destroying the model.
@@ -425,10 +525,12 @@ class GossipModelStage(Stage):
         state = node.state
         train = set(state.train_set)
         covered = set(agg.contributors)
-        if covered == train or len(train) <= 1:
-            return agg
         round_no = state.round or 0
         missing = sorted(train - covered)
+        for j in missing:
+            # Bonawitz invariant: members whose pair seeds this round may
+            # get disclosed must never have their self seed reconstructed
+            state.secagg_round_dropped.add((round_no, j))
         survivors = sorted(covered)
         logger.warning(
             node.addr,
@@ -473,7 +575,34 @@ class GossipModelStage(Stage):
                 )
             )
         if recoverable and node.addr in covered and len(survivors) > 1:
+            # same standard of evidence as the secagg_need ANSWER path
+            # (SecAggNeedCommand's liveness check): a member merely missing
+            # from OUR coverage view may have contributed elsewhere and
+            # already revealed its self seed on that evidence — proactively
+            # disclosing its pair seeds while it is still live on the
+            # overlay would publish both seed types for one (node, round)
+            live = set(node.protocol.get_neighbors(only_direct=False))
             for j in missing:
+                if j in live:
+                    logger.warning(
+                        node.addr,
+                        f"SecAgg: {j} is missing from our coverage but still "
+                        "live — withholding its pair seeds (a peer may hold "
+                        "its contribution)",
+                    )
+                    continue
+                if (round_no, j, j) in state.secagg_share_reveals:
+                    # j's SELF seed is already public this round (it
+                    # contributed somewhere and revealed before dying):
+                    # disclosing its pair seeds too would publish both seed
+                    # types for one (node, round) — the exact breach double
+                    # masking exists to prevent. Privacy over availability.
+                    logger.warning(
+                        node.addr,
+                        f"SecAgg: {j} already revealed its self seed this "
+                        "round — withholding its pair seeds",
+                    )
+                    continue
                 if j not in state.secagg_pubs or (round_no, j) in state.secagg_disclosure_sent:
                     continue
                 state.secagg_disclosure_sent.add((round_no, j))
@@ -518,12 +647,7 @@ class GossipModelStage(Stage):
                 "SecAgg: seed recovery incomplete — discarding the noised "
                 "aggregate; this round is a no-op (round-start global kept)",
             )
-            prev = getattr(node, "round_start_params", None)
-            if prev is None:
-                prev = node.learner.get_parameters()
-            return ModelUpdate(
-                prev, sorted(train), max(int(agg.num_samples), 1), noop_round=True
-            )
+            return _noop_round_update(node, train)
 
         correction = secagg.dropout_correction(
             agg.params, survivors, missing, seeds, weights, round_no
@@ -535,6 +659,125 @@ class GossipModelStage(Stage):
             node.addr,
             f"SecAgg: recovered the survivors' clean aggregate ({len(survivors)} "
             f"of {len(train)} members, {len(missing)} seed set(s) disclosed)",
+        )
+        return ModelUpdate(params, list(agg.contributors), agg.num_samples)
+
+    @staticmethod
+    def _secagg_self_unmask(node: "Node", agg):
+        """Bonawitz double masking, unmask phase (VERDICT r3 #8).
+
+        Every contributor's ``STD·PRG_self(b_i^r)`` still rides on the
+        aggregate. This node (a) discloses its OWN per-round seed — unless
+        any pair-seed disclosure about it was observed this round (the
+        at-most-one-of-{pair,self} invariant); (b) waits for every
+        contributor's seed, revealing its held Shamir shares ONLY for
+        owners whose direct reveal hasn't landed after a grace period (the
+        crash backstop — flooding all n−1 shares every round would be
+        O(n²) control traffic for nothing in the no-crash common case);
+        then (c) subtracts the summed self masks. Incomplete ⇒ no-op
+        round, exactly like pair recovery: privacy over availability.
+        """
+        from p2pfl_tpu.learning import secagg
+        from p2pfl_tpu.learning.weights import ModelUpdate
+
+        state = node.state
+        train = set(state.train_set)
+        round_no = state.round or 0
+        contributors = sorted(set(agg.contributors))
+        exp = state.experiment_name or ""
+        my_b = state.secagg_self_seed.get(round_no)
+
+        if node.addr in contributors:
+            secagg.maybe_reveal_self_seed(node, round_no)
+
+        t = secagg.share_threshold(len(train))
+
+        def resolve_seeds():
+            """(seeds or None, owners still unresolved)."""
+            seeds: dict[str, int] = {}
+            unresolved: list[str] = []
+            for i in contributors:
+                if i == node.addr and my_b is not None:
+                    seeds[i] = my_b
+                    continue
+                direct = state.secagg_share_reveals.get((round_no, i, i))
+                if direct is not None and direct[0] == 0:
+                    seeds[i] = direct[1]
+                    continue
+                distinct = {
+                    xy[0]: xy[1]
+                    for (r, o, _src), xy in list(state.secagg_share_reveals.items())
+                    if r == round_no and o == i and xy[0] >= 1
+                }
+                own_share = state.secagg_shares_held.get((round_no, i))
+                if own_share is not None:
+                    # our own held share never rides the broadcast back to
+                    # us (protocol.broadcast is neighbors-only) — without it
+                    # a single crash is unrecoverable for n <= 5
+                    distinct.setdefault(own_share[0], own_share[1])
+                if len(distinct) >= t:
+                    b = secagg.shamir_reconstruct(list(distinct.items()))
+                    if b < (1 << 256):  # corrupted shares reconstruct garbage
+                        seeds[i] = b
+                        continue
+                unresolved.append(i)
+            return (None if unresolved else seeds), unresolved
+
+        def reveal_shares_for(owners: list[str]) -> None:
+            for i in owners:
+                if i == node.addr or (round_no, i) in state.secagg_round_dropped:
+                    continue
+                if (round_no, i) in state.secagg_reveal_sent:
+                    continue
+                share = state.secagg_shares_held.get((round_no, i))
+                if share is None:
+                    continue
+                state.secagg_reveal_sent.add((round_no, i))
+                node.protocol.broadcast(
+                    node.protocol.build_msg(
+                        "secagg_reveal",
+                        [exp, i, str(share[0]), f"{share[1]:x}"],
+                        round=round_no,
+                    )
+                )
+
+        deadline = time.monotonic() + Settings.SECAGG_RECOVERY_TIMEOUT
+        grace = time.monotonic() + min(2.0, Settings.SECAGG_RECOVERY_TIMEOUT / 3)
+        seeds, unresolved = resolve_seeds()
+        while seeds is None and time.monotonic() < deadline and not node.learning_interrupted():
+            if time.monotonic() >= grace and unresolved:
+                reveal_shares_for(unresolved)  # latched: re-calls are no-ops
+            time.sleep(0.1)
+            seeds, unresolved = resolve_seeds()
+
+        if seeds is None:
+            logger.error(
+                node.addr,
+                "SecAgg: self-mask seeds unresolved — discarding the masked "
+                "aggregate; this round is a no-op (round-start global kept)",
+            )
+            return _noop_round_update(node, train)
+
+        weights: dict[str, int] = {n: pk[1] for n, pk in state.secagg_pubs.items()}
+        if state.secagg_samples is not None:
+            weights[node.addr] = state.secagg_samples
+        if any(i not in weights for i in contributors):
+            logger.error(
+                node.addr,
+                "SecAgg: missing announced weights for a contributor — "
+                "cannot scale self-mask correction; no-op round",
+            )
+            return _noop_round_update(node, train)
+        correction = secagg.self_mask_correction(
+            agg.params, contributors, seeds, weights, round_no
+        )
+        params = secagg.apply_dropout_correction(
+            agg.params, correction, float(agg.num_samples)
+        )
+        logger.info(
+            node.addr,
+            f"SecAgg: self masks removed for {len(contributors)} contributor(s) "
+            f"(round {round_no})",
         )
         return ModelUpdate(params, list(agg.contributors), agg.num_samples)
 

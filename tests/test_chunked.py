@@ -1,0 +1,119 @@
+"""ChunkedFederation: time-shared node streaming (VERDICT r3 #3).
+
+The class exists so v4-128-sized federations (config 3's 64 ResNet-50
+nodes) EXECUTE on one chip. These tests pin its round semantics against
+SpmdFederation on small models where both fit.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from p2pfl_tpu.learning.dataset import FederatedDataset
+from p2pfl_tpu.models import mlp
+from p2pfl_tpu.parallel import ChunkedFederation, SpmdFederation
+
+
+def _data(n_train=256, seed=5):
+    return FederatedDataset.synthetic_mnist(n_train=n_train, n_test=64, seed=seed)
+
+
+def _max_diff(a, b):
+    return max(
+        float(jnp.max(jnp.abs(x.astype(jnp.float32) - y.astype(jnp.float32))))
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+def test_single_chunk_matches_spmd_federation():
+    """chunk_size == n, keep_opt_state=False: identical round semantics to
+    SpmdFederation (same perms come from the same seeded rng calls)."""
+    data = _data()
+    kw = dict(n_nodes=4, batch_size=16, vote=False, seed=7)
+    spmd = SpmdFederation.from_dataset(mlp(seed=0), data, **kw)
+    chunked = ChunkedFederation.from_dataset(mlp(seed=0), data, chunk_size=4, **kw)
+    for _ in range(2):
+        spmd.run_round(epochs=1)
+        chunked.run_round(epochs=1)
+    assert _max_diff(spmd.node_params(0), chunked.params) < 2e-2  # bf16-scale tolerance
+    sa = spmd.evaluate()["test_acc"]
+    ca = chunked.evaluate()["test_acc"]
+    assert abs(sa - ca) < 0.05
+
+
+def test_chunking_is_invariant_to_chunk_size():
+    """Streaming in chunks of 2 gives the same aggregate as one chunk of 4
+    (FedAvg is a weighted sum — associative across chunks)."""
+    data = _data()
+    kw = dict(n_nodes=4, batch_size=16, vote=False, seed=3)
+    one = ChunkedFederation.from_dataset(mlp(seed=0), data, chunk_size=4, **kw)
+    two = ChunkedFederation.from_dataset(mlp(seed=0), data, chunk_size=2, **kw)
+    for _ in range(2):
+        one.run_round(epochs=1)
+        two.run_round(epochs=1)
+    assert _max_diff(one.params, two.params) < 2e-2
+
+
+def test_mask_skips_chunks_and_excludes_contribution():
+    """A dropped node contributes nothing; a fully-masked chunk is skipped
+    (no dispatch) and the aggregate comes from the surviving chunk."""
+    data = _data()
+    fed = ChunkedFederation.from_dataset(
+        mlp(seed=0), data, chunk_size=2, n_nodes=4, batch_size=16, vote=False, seed=3
+    )
+    ref = ChunkedFederation.from_dataset(
+        mlp(seed=0), data, chunk_size=2, n_nodes=4, batch_size=16, vote=False, seed=3
+    )
+    # drop the whole second chunk in fed; ref trains only nodes 0-1 too by
+    # masking — but uses a DIFFERENT chunk split so the weighted result
+    # must still match
+    fed.drop_node(2)
+    fed.drop_node(3)
+    ref.chunk_size = 4
+    ref.drop_node(2)
+    ref.drop_node(3)
+    fed.run_round(epochs=1)
+    ref.run_round(epochs=1)
+    assert _max_diff(fed.params, ref.params) < 2e-2
+
+
+def test_keep_opt_state_moment_averaging_trains():
+    """The documented divergence: aggregated Adam moments + surviving
+    schedule step counts still train (loss decreases over rounds), and the
+    optimizer state's integer count leaves advance."""
+    data = _data(n_train=512)
+    sched = optax.warmup_cosine_decay_schedule(0.0, 3e-3, 8, 64, end_value=1e-4)
+    fed = ChunkedFederation.from_dataset(
+        mlp(seed=0), data, chunk_size=2, n_nodes=4, batch_size=16, vote=False,
+        seed=3, tx=optax.adam(sched), keep_opt_state=True,
+    )
+    losses = [fed.run_round(epochs=1)["train_loss"] for _ in range(4)]
+    assert losses[-1] < losses[0]
+    counts = [
+        int(leaf)
+        for leaf in jax.tree.leaves(fed.opt_state)
+        if jnp.issubdtype(leaf.dtype, jnp.integer) and leaf.ndim == 0
+    ]
+    assert counts and all(c == 4 * fed._nb for c in counts)
+    assert fed.evaluate()["test_acc"] > 0.5
+
+
+def test_vote_and_round_flops():
+    data = _data()
+    fed = ChunkedFederation.from_dataset(
+        mlp(seed=0), data, chunk_size=2, n_nodes=4, batch_size=16, vote=True, seed=3
+    )
+    fed.run_round(epochs=1)
+    assert fed.train_mask.sum() >= 1
+    fl = fed.round_flops()
+    assert fl is None or fl > 0
+
+
+def test_rejects_indivisible_chunks():
+    data = _data()
+    with pytest.raises(ValueError, match="not divisible"):
+        ChunkedFederation.from_dataset(
+            mlp(seed=0), data, chunk_size=3, n_nodes=4, batch_size=16
+        )

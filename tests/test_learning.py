@@ -328,3 +328,20 @@ def test_bulyan_resists_coordinate_attack():
 
     with pytest.raises(ValueError, match="4f"):
         bulyan(tree_stack([m.params for m in models[:5]]), n_byzantine=1)
+
+
+def test_synthetic_lm_domain_shift():
+    """shift_frac re-deranges part of the successor table: the shifted
+    dataset is a DIFFERENT chain (same seed), and every selected token's
+    successor actually changes (cyclic rotation, no fixed points)."""
+    from p2pfl_tpu.learning.dataset import FederatedDataset
+
+    base = FederatedDataset.synthetic_lm(vocab_size=64, seq_len=32, n_train=32, n_test=16)
+    shifted = FederatedDataset.synthetic_lm(
+        vocab_size=64, seq_len=32, n_train=32, n_test=16, shift_frac=0.25
+    )
+    same = FederatedDataset.synthetic_lm(vocab_size=64, seq_len=32, n_train=32, n_test=16)
+    import numpy as np
+
+    assert np.array_equal(base.x_train, same.x_train)  # deterministic
+    assert not np.array_equal(base.x_train, shifted.x_train)  # shifted domain
