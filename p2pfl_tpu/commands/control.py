@@ -182,11 +182,16 @@ class SecAggNeedCommand(Command):
             return
         live = set(node.protocol.get_neighbors(only_direct=False))
         for j in args[1:]:
-            if j in train:
+            if j in train and j != node.addr:
                 # a need CLAIM alone poisons j's self-seed reconstruction
                 # for this round (Bonawitz invariant: some peer may answer
                 # it even if we refuse) — conservative, costs availability
-                # only in the forged/split-brain case
+                # only in the forged/split-brain case. NOT for ourselves:
+                # while we are alive, honest peers refuse to disclose our
+                # pair seeds regardless of claims (their liveness check),
+                # so our own reveal stays safe — self-poisoning here would
+                # let any split-brain need starve a round whose clean
+                # aggregate exists (the rescue path depends on our reveal)
                 st.secagg_round_dropped.add((round, j))
             if j == node.addr or j == source or j not in train or j not in st.secagg_pubs:
                 continue
@@ -354,11 +359,14 @@ class SecAggRevealCommand(Command):
                     "assigned share index — rejected (forgery or stale train set)",
                 )
                 return
-        if st.round is not None and round not in (st.round - 1, st.round, st.round + 1):
+        if st.round is None or round not in (st.round - 1, st.round, st.round + 1):
             # one round AHEAD is legitimate: reveals are latched send-once,
             # and a fast peer already finalizing round r+1 broadcasts its
             # direct reveal while we are still resolving round r — dropping
-            # it would permanently starve OUR r+1 finalize
+            # it would permanently starve OUR r+1 finalize. st.round None
+            # (idle) accepts nothing: fabricated round numbers would
+            # otherwise grow secagg_share_reveals without bound (same
+            # rationale as SecAggShareCommand's window)
             return
         st.secagg_share_reveals.setdefault((round, owner, source), (x, y))
 

@@ -47,17 +47,33 @@ the exact uncancelled sum (:func:`dropout_correction`) and recover the
 survivors' clean aggregate — availability degrades to a partial
 aggregate, like the reference's plain path
 (``p2pfl/learning/aggregators/aggregator.py:236-242``), instead of a
-destroyed model. Residual risk, documented: if a "dropped" node's masked
-update was captured on the wire but never reached an aggregator, the
-disclosed seeds could unmask that single update; the same applies to a
-node declared missing by SOME survivors' coverage views but not others
-(disclosures cover the union of announced missing sets, trading that
-node's single-update privacy for round availability). The full Bonawitz
-double-mask (a self-mask whose shares are never disclosed together with
-the pair seeds) closes this; under the passive-snooping threat model the
-race requires adversarial timing that is out of scope. A lone survivor
-never discloses anything — it corrects locally (its "aggregate" is its
-own model, which aggregation cannot protect anyway).
+destroyed model. A lone survivor never discloses anything — it corrects
+locally (its "aggregate" is its own model, which aggregation cannot
+protect anyway).
+
+Double masking (``Settings.SECAGG_DOUBLE_MASK``, default on): pair-seed
+disclosure alone would let a snoop unmask a dropped node's update that
+was captured on the wire but never reached an aggregator. The full
+Bonawitz construction closes this: every contribution also carries a
+per-round SELF mask (:func:`self_mask`) whose seed is t-of-n
+Shamir-shared with the train set (:func:`shamir_split`; shares travel
+encrypted under :func:`dh_share_key` — a sibling hash of the DH secret
+that disclosure of the pair MASK seed reveals nothing about). The seed
+is revealed by its owner once its contribution demonstrably landed, or
+reconstructed by the surviving majority when the owner contributed and
+then crashed. Invariant, enforced at every disclosure site in both
+directions: no honest participant KNOWINGLY publishes the second seed
+type for a (node, round) — pair-seed disclosure is refused for members
+whose self-seed reveal was observed (and for live members), and
+self-seed help is refused for members any pair disclosure or dropout
+claim was observed for. The guarantee is per-participant-observation:
+with synchronized views at most one of {pair seeds, self seed} becomes
+public and a captured masked update stays masked through every recovery
+path; the residual exposure requires a member to die mid-protocol while
+the overlay is PARTITIONED such that some survivor saw neither its
+contribution nor its reveal — adversarially timing that is outside the
+passive-snooping threat model above. An unresolvable round degrades to
+a no-op rather than a disclosure.
 
 Limits (documented, matching the protocol's nature):
 

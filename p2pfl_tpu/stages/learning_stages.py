@@ -491,7 +491,9 @@ class GossipModelStage(Stage):
             return agg
         if covered != train:
             agg = GossipModelStage._secagg_pair_recovery(node, agg)
-            if agg.noop_round:
+            if agg.noop_round or agg.secagg_clean:
+                # secagg_clean: the split-brain rescue adopted a recovered
+                # peer's finalized diffusion — already self-mask-free
                 return agg
         elif node.addr not in train:
             # waiting-mode nodes only ever accept full-coverage diffusions;
@@ -574,6 +576,7 @@ class GossipModelStage(Stage):
                     round=round_no,
                 )
             )
+        live = set(node.protocol.get_neighbors(only_direct=False))
         if recoverable and node.addr in covered and len(survivors) > 1:
             # same standard of evidence as the secagg_need ANSWER path
             # (SecAggNeedCommand's liveness check): a member merely missing
@@ -581,7 +584,6 @@ class GossipModelStage(Stage):
             # already revealed its self seed on that evidence — proactively
             # disclosing its pair seeds while it is still live on the
             # overlay would publish both seed types for one (node, round)
-            live = set(node.protocol.get_neighbors(only_direct=False))
             for j in missing:
                 if j in live:
                     logger.warning(
@@ -610,6 +612,24 @@ class GossipModelStage(Stage):
                 node.protocol.broadcast(
                     node.protocol.build_msg("secagg_recover", [j, f"{seed:x}"], round=round_no)
                 )
+        if recoverable and any(j in live for j in missing):
+            # a LIVE "missing" member means every honest peer (us included)
+            # refuses to disclose its pair seeds — this seed recovery
+            # provably cannot complete. Its contribution reached somebody
+            # (that is why it is alive and un-evicted), so skip the futile
+            # disclosure wait and adopt the recovered peers' finalized
+            # diffusion instead — entering waiting mode NOW, while their
+            # diffusion gossip is still retrying against us.
+            rescued = GossipModelStage._secagg_split_brain_rescue(node, train, missing)
+            if rescued is not None:
+                return rescued
+            logger.error(
+                node.addr,
+                "SecAgg: split-brain with a live missing member and no "
+                "finalized diffusion arrived — no-op round",
+            )
+            return _noop_round_update(node, train)
+
         deadline = time.monotonic() + Settings.SECAGG_RECOVERY_TIMEOUT
         while (
             recoverable
@@ -640,6 +660,11 @@ class GossipModelStage(Stage):
                         )
 
         if not recoverable:
+            rescued = GossipModelStage._secagg_split_brain_rescue(
+                node, train, missing
+            )
+            if rescued is not None:
+                return rescued
             # ADVICE r2: never apply or diffuse a known-noised model — give
             # the round up instead, keeping the round-start global
             logger.error(
@@ -661,6 +686,45 @@ class GossipModelStage(Stage):
             f"of {len(train)} members, {len(missing)} seed set(s) disclosed)",
         )
         return ModelUpdate(params, list(agg.contributors), agg.num_samples)
+
+    @staticmethod
+    def _secagg_split_brain_rescue(node: "Node", train: set, missing: list):
+        """Pair recovery failed but a "missing" member is still LIVE: it
+        contributed to peers whose coverage view includes it (that is WHY
+        everyone refuses to disclose its pair seeds — the refusal protects
+        a real contribution). Those peers therefore hold the round's clean
+        aggregate and their diffusion targets us — we have not announced
+        ``models_ready`` yet, so we count as behind. Wait for the finalized
+        diffusion like a non-train-set node instead of no-opping a round
+        whose result demonstrably exists. Returns the adopted update, or
+        None when no (trustably finalized) diffusion arrives in time.
+        """
+        state = node.state
+        live = set(node.protocol.get_neighbors(only_direct=False))
+        if not any(j in live for j in missing):
+            return None  # genuinely dead members: nothing to wait for
+        logger.warning(
+            node.addr,
+            "SecAgg: a missing member is still live (split-brain coverage) "
+            "— waiting for a recovered peer's finalized diffusion instead "
+            "of no-opping",
+        )
+        node.aggregator.set_waiting_aggregated_model(list(train))
+        try:
+            rescued = node.aggregator.wait_and_get_aggregation(
+                timeout=Settings.SECAGG_RECOVERY_TIMEOUT
+            )
+        except Exception:  # noqa: BLE001 — nothing arrived: fall through to no-op
+            return None
+        finalized = rescued.secagg_clean or not Settings.SECAGG_DOUBLE_MASK
+        if set(rescued.contributors) == train and finalized:
+            logger.info(
+                node.addr,
+                "SecAgg: adopted a recovered peer's finalized aggregate "
+                "(split-brain rescue)",
+            )
+            return rescued
+        return None
 
     @staticmethod
     def _secagg_self_unmask(node: "Node", agg):

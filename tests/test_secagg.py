@@ -739,3 +739,135 @@ def test_dropped_node_self_seed_never_revealed():
     # than applying the still-masked aggregate
     assert not any(m[0] == "secagg_reveal" and m[1][1] == "b" for m in sent)
     assert out.noop_round
+
+
+def test_self_seed_shamir_reconstruction_for_crashed_contributor():
+    """The crash backstop end to end at the state level: contributor 'd'
+    double-masked and died before revealing b_d. Node 'a' reconstructs it
+    from its OWN held share plus two peers' revealed shares (t=3 of the 3
+    holders), resolves every other seed from direct reveals, and strips
+    the exact self-mask sum from the aggregate."""
+    import secrets as pysecrets
+
+    from p2pfl_tpu.node_state import NodeState
+    from p2pfl_tpu.stages.learning_stages import GossipModelStage
+
+    train = ["a", "b", "c", "d"]
+    weights = {"a": 3, "b": 5, "c": 7, "d": 9}
+    seeds = {n: pysecrets.randbits(256) for n in train}
+    round_no = 0
+    w_total = float(sum(weights.values()))
+    template = {"w": np.zeros((6, 4), np.float32)}
+
+    # the aggregate = clean weighted mean + Σ w_i·STD·PRG_self(b_i)/W
+    clean = np.full((6, 4), 0.25, np.float32)
+    masked = clean.copy()
+    for n in train:
+        m = secagg.self_mask(template, seeds[n], round_no)["w"]
+        masked = masked + (weights[n] / w_total) * m
+
+    st = NodeState("a")
+    st.set_experiment("exp", 1)
+    st.round = round_no
+    st.train_set = list(train)
+    st.secagg_samples = weights["a"]
+    st.secagg_pubs = {n: (2, weights[n]) for n in ("b", "c", "d")}
+    st.secagg_self_seed[round_no] = seeds["a"]
+    # direct reveals from the living contributors b and c
+    st.secagg_share_reveals[(round_no, "b", "b")] = (0, seeds["b"])
+    st.secagg_share_reveals[(round_no, "c", "c")] = (0, seeds["c"])
+    # d's seed: t = 3 of holders [a, b, c]; a holds its own share, b and c
+    # revealed theirs — d itself revealed NOTHING (it crashed)
+    shares = secagg.shamir_split(seeds["d"], 3, secagg.share_threshold(4))
+    st.secagg_shares_held[(round_no, "d")] = shares[0]  # a's (x=1)
+    st.secagg_share_reveals[(round_no, "d", "b")] = shares[1]
+    st.secagg_share_reveals[(round_no, "d", "c")] = shares[2]
+
+    sent = []
+
+    class _Proto:
+        def broadcast(self, msg):
+            sent.append(msg)
+
+        def build_msg(self, cmd, args, round=0):  # noqa: A002
+            return (cmd, list(args), round)
+
+    class _FakeNode:
+        addr = "a"
+        protocol = _Proto()
+        state = st
+        learner = None
+
+        def learning_interrupted(self):
+            return False
+
+    agg = ModelUpdate({"w": masked}, list(train), sum(weights.values()))
+    out = GossipModelStage._secagg_self_unmask(_FakeNode(), agg)
+    assert not out.noop_round
+    np.testing.assert_allclose(np.asarray(out.params["w"]), clean, atol=1e-3)
+    # 'a' revealed its own seed (it contributed and is not conflicted)
+    assert any(m[0] == "secagg_reveal" and m[1][1] == "a" for m in sent)
+
+
+def test_split_brain_rescue_adopts_finalized_diffusion():
+    """Pair recovery with a LIVE missing member (split-brain coverage: it
+    contributed to peers, not to us) must skip the futile disclosure wait,
+    reopen the aggregator in waiting mode, and adopt a recovered peer's
+    finalized (secagg_clean) diffusion instead of no-opping."""
+    from p2pfl_tpu.node_state import NodeState
+    from p2pfl_tpu.stages.learning_stages import GossipModelStage
+
+    Settings.SECURE_AGGREGATION = True
+    Settings.SECAGG_RECOVERY_TIMEOUT = 2.0
+    train = ["a", "b", "c"]
+    clean = {"w": np.full((2, 2), 3.0, np.float32)}
+    calls = {"waiting": None}
+
+    class _Agg:
+        def set_waiting_aggregated_model(self, nodes):
+            calls["waiting"] = list(nodes)
+
+        def wait_and_get_aggregation(self, timeout=None):
+            return ModelUpdate(clean, list(train), 3, secagg_clean=True)
+
+    class _Proto:
+        def broadcast(self, msg):
+            pass
+
+        def build_msg(self, cmd, args, round=0):  # noqa: A002
+            return (cmd, list(args), round)
+
+        def get_neighbors(self, only_direct=False):
+            return {"b": None, "c": None}  # the "missing" member c is LIVE
+
+    st = NodeState("a")
+    st.set_experiment("exp", 1)
+    st.round = 0
+    st.train_set = list(train)
+    priv, _pub = secagg.dh_keypair()
+    st.secagg_priv = priv
+    st.secagg_samples = 5
+    for n in ("b", "c"):
+        _p, pub_n = secagg.dh_keypair()
+        st.secagg_pubs[n] = (pub_n, 5)
+
+    class _FakeNode:
+        addr = "a"
+        state = st
+        protocol = _Proto()
+        aggregator = _Agg()
+        learner = None
+
+        def learning_interrupted(self):
+            return False
+
+    # partial aggregate: only a and b contributed; c is missing but live
+    agg = ModelUpdate({"w": np.zeros((2, 2), np.float32)}, ["a", "b"], 10)
+    out = GossipModelStage._secagg_pair_recovery(_FakeNode(), agg)
+    assert sorted(calls["waiting"]) == train  # aggregator reopened in waiting mode
+    assert out.secagg_clean and not out.noop_round
+    np.testing.assert_array_equal(np.asarray(out.params["w"]), clean["w"])
+    # and the finalize wrapper passes the rescued (already clean) update
+    # through without a self-unmask pass
+    out2 = GossipModelStage._secagg_finalize(_FakeNode(), agg)
+    assert out2.secagg_clean
