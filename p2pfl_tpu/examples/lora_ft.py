@@ -23,6 +23,11 @@ def main(argv=None) -> None:
     parser.add_argument("--batch-size", type=int, default=16)
     parser.add_argument("--lr", type=float, default=1e-2)
     parser.add_argument("--spmd", action="store_true", help="one-program mesh mode")
+    parser.add_argument(
+        "--big-model", action="store_true",
+        help="per-block remat + lax.scan over layers (the 1B-scale recipe: "
+             "memory bounded at one block, compile size independent of depth)",
+    )
     parser.add_argument("--measure_time", action="store_true")
     args = parser.parse_args(argv)
 
@@ -37,6 +42,8 @@ def main(argv=None) -> None:
         ffn_hidden=args.dim * 8 // 3,
         lora_rank=args.rank,
         lora_mlp=True,
+        remat=args.big_model,
+        scan_layers=args.big_model,
     )
     data = FederatedDataset.synthetic_lm(vocab_size=cfg.vocab_size, seq_len=args.seq_len)
     t0 = time.monotonic()

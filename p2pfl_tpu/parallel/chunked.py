@@ -194,6 +194,8 @@ class ChunkedFederation:
         self.active_mask[i] = 1.0
 
     def elect_train_set(self) -> np.ndarray:
+        """Reference vote semantics — delegates to
+        :func:`~p2pfl_tpu.parallel.spmd.elect_train_set_mask`."""
         return elect_train_set_mask(self.n, self._py_rng)
 
     def _make_perm_np(self, epochs: int) -> np.ndarray:

@@ -712,6 +712,8 @@ class SpmdFederation:
     # ---- election (host control plane — reference vote semantics) ----
 
     def elect_train_set(self) -> np.ndarray:
+        """Reference vote semantics — delegates to
+        :func:`elect_train_set_mask`."""
         return elect_train_set_mask(self.n, self._py_rng)
 
     # ---- round driver ----

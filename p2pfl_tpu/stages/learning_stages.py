@@ -716,12 +716,17 @@ class GossipModelStage(Stage):
             )
         except Exception:  # noqa: BLE001 — nothing arrived: fall through to no-op
             return None
-        finalized = rescued.secagg_clean or not Settings.SECAGG_DOUBLE_MASK
-        if set(rescued.contributors) == train and finalized:
+        if set(rescued.contributors) == train:
+            # a still-MASKED full-coverage aggregate (a peer's partial
+            # gossip covering the whole train set, no CLEAN_MARKER) is just
+            # as good: pair masks cancel at full coverage and the caller's
+            # finalize flow runs the normal self-unmask pass on anything
+            # not flagged clean — rejecting it would throw away the round's
+            # result AND burn the one-shot waiting window
             logger.info(
                 node.addr,
-                "SecAgg: adopted a recovered peer's finalized aggregate "
-                "(split-brain rescue)",
+                "SecAgg: adopted a peer's full-coverage aggregate "
+                f"(split-brain rescue, finalized={rescued.secagg_clean})",
             )
             return rescued
         return None

@@ -116,3 +116,63 @@ def test_federated_lora_over_memory_transport():
     for n in nodes:
         n.stop()
     MemoryRegistry.reset()
+
+
+def test_scan_layers_matches_unrolled():
+    """cfg.scan_layers stacks params on a leading [L] axis and must compute
+    the SAME function as the unrolled model (copy unrolled layer params into
+    the stacked layout and compare logits); remat composes with the scan and
+    LoRA grads flow."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from p2pfl_tpu.learning.lora import merge_params, split_lora
+    from p2pfl_tpu.models.transformer import TransformerConfig, tiny_transformer
+
+    kw = dict(
+        vocab_size=128, dim=32, n_layers=3, n_heads=2, n_kv_heads=1,
+        ffn_hidden=48, lora_rank=4, dtype=jnp.float32,
+    )
+    mu = tiny_transformer(seq_len=16, cfg=TransformerConfig(**kw))
+    ms = tiny_transformer(
+        seq_len=16, cfg=TransformerConfig(**kw, scan_layers=True, remat=True)
+    )
+    assert set(ms.params) == {"embed", "final_norm", "layers"}
+    stacked = jax.tree.map(
+        lambda *xs: jnp.stack(xs), *[mu.params[f"layer_{i}"] for i in range(3)]
+    )
+    ps = {"embed": mu.params["embed"], "final_norm": mu.params["final_norm"],
+          "layers": {"block": stacked}}
+    tok = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 128)
+    lu = mu.module.apply({"params": mu.params}, tok)
+    ls = ms.module.apply({"params": ps}, tok)
+    np.testing.assert_allclose(np.asarray(lu), np.asarray(ls), atol=1e-5)
+
+    lora, base = split_lora(ps)
+    assert jax.tree.leaves(lora), "stacked layout must still expose lora_* leaves"
+
+    def loss(lo):
+        p = merge_params(lo, base)
+        logits = ms.module.apply({"params": p}, tok)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, jnp.roll(tok, -1, 1)
+        ).mean()
+
+    g = jax.grad(loss)(lora)
+    assert all(bool(jnp.isfinite(x).all()) for x in jax.tree.leaves(g))
+    assert sum(float(jnp.sum(jnp.abs(x))) for x in jax.tree.leaves(g)) > 0
+
+
+def test_scan_layers_rejects_moe():
+    import pytest as _pytest
+
+    from p2pfl_tpu.models.transformer import TransformerConfig, tiny_transformer
+
+    cfg = TransformerConfig(
+        vocab_size=64, dim=16, n_layers=2, n_heads=2, n_kv_heads=2,
+        ffn_hidden=32, n_experts=2, scan_layers=True,
+    )
+    with _pytest.raises(NotImplementedError, match="scan_layers with MoE"):
+        tiny_transformer(seq_len=8, cfg=cfg)
