@@ -346,8 +346,14 @@ def resolve_attention(
     axis_name: str = "model",
     block: int = 128,
     seq_len: Optional[int] = None,
+    block_bwd: Optional[int] = None,
 ) -> Optional[Callable]:
-    """Map an attention backend name to an ``(q, k, v) -> out`` callable."""
+    """Map an attention backend name to an ``(q, k, v) -> out`` callable.
+
+    ``block_bwd``: backward-pass-specific flash block size (the dQ/dKV
+    kernels prefer larger blocks than the forward — see
+    ``ops/flash_attention.flash_attention``); None = use ``block``.
+    """
     if attn == "auto":
         if seq_len is None:
             raise ValueError("attn='auto' needs seq_len to pick a backend")
@@ -360,7 +366,8 @@ def resolve_attention(
         # Pallas runs natively on TPU; anywhere else use interpret mode
         interpret = jax.default_backend() != "tpu"
         return partial(
-            flash_attention, causal=True, block_q=block, block_k=block, interpret=interpret
+            flash_attention, causal=True, block_q=block, block_k=block,
+            interpret=interpret, block_q_bwd=block_bwd, block_k_bwd=block_bwd,
         )
     if attn in ("ring", "ring_flash"):
         if mesh is None:
@@ -402,26 +409,36 @@ def tiny_transformer(
             from p2pfl_tpu.settings import Settings
 
             basis = seq_len // mesh.shape[Settings.MESH_MODEL_AXIS]
+        def largest_block(hi: int, lo: int):
+            # blocks must divide the basis and (on TPU Mosaic) be a
+            # multiple of 8 — the single place the tiling rule lives
+            return next(
+                (b for b in range(hi, lo, -1) if basis % b == 0 and b % 8 == 0),
+                None,
+            )
+
         if basis <= 512:
             block = basis  # block == T always satisfies the TPU tiling rule
         else:
-            # blocks must divide the basis and (on TPU Mosaic) be a multiple
-            # of 8. Prefer the LARGEST block <= 512: bench config 7's sweep
+            # Prefer the LARGEST block <= 512: bench config 7's sweep
             # shows bigger blocks amortize the Pallas grid bookkeeping —
             # block 512 beat 256 at every measured length (round 4: 112 ->
             # 75 ms/train-step at T=4096)
-            block = next(
-                (b for b in range(512, 7, -1) if basis % b == 0 and b % 8 == 0), None
-            )
+            block = largest_block(512, 7)
             if block is None and attn in ("flash", "ring_flash"):
-                # the sweep goes down to 8, so this only fires when the
+                # the search goes down to 8, so this only fires when the
                 # attended length itself is not a multiple of 8
                 raise ValueError(
                     f"attn={attn!r} needs the attended length to be a "
                     f"multiple of 8 (Mosaic tiling); got {basis} (seq_len "
                     "per shard)"
                 )
-        attn_fn = resolve_attention(attn, mesh=mesh, block=block)
+        # the BACKWARD kernels prefer larger blocks at wide heads (measured:
+        # D=128 bwd 56% MFU at block 1024 vs 45% at 512; noise at D=64)
+        block_bwd = None
+        if attn == "flash" and cfg.dim // cfg.n_heads >= 128 and basis > (block or 0):
+            block_bwd = largest_block(min(1024, basis), block)
+        attn_fn = resolve_attention(attn, mesh=mesh, block=block, block_bwd=block_bwd)
     module = CausalLM(cfg, attn_fn)
     rng = jax.random.PRNGKey(seed)
     dummy = jnp.zeros((1, seq_len), dtype=jnp.int32)

@@ -261,12 +261,21 @@ def _flash_bwd_bthd(q, k, v, do, lse, delta, *, block_q, block_k, causal, interp
     return dq, dk, dv
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def flash_attention(
-    q, k, v, causal: bool = True, block_q: int = 128, block_k: int = 128, interpret: bool = False
+    q, k, v, causal: bool = True, block_q: int = 128, block_k: int = 128,
+    interpret: bool = False, block_q_bwd=None, block_k_bwd=None,
 ):
-    """Flash attention. q,k,v: [B, T, H, D] (GQA heads pre-repeated)."""
-    out, _ = _fwd(q, k, v, causal, block_q, block_k, interpret)
+    """Flash attention. q,k,v: [B, T, H, D] (GQA heads pre-repeated).
+
+    ``block_q_bwd`` / ``block_k_bwd`` (default: the forward blocks): the
+    backward kernels prefer LARGER blocks than the forward — measured at
+    T=4096 D=128 on a v5e, bwd at 1024/1024 runs 56% MFU vs 45% at the
+    forward-optimal 512/512 (+25%); at D=64 the difference is noise. The
+    saved log-sum-exp is stored in the forward's block layout and reshaped
+    to the backward's on the XLA side (a free relayout next to the kernel).
+    """
+    out, _ = _fwd(q, k, v, causal, block_q, block_k, interpret, block_q_bwd, block_k_bwd)
     return out
 
 
@@ -276,7 +285,7 @@ def _clamp_blocks(t, block_q, block_k):
     return block_q, block_k
 
 
-def _fwd(q, k, v, causal, block_q, block_k, interpret):
+def _fwd(q, k, v, causal, block_q, block_k, interpret, block_q_bwd=None, block_k_bwd=None):
     t = q.shape[1]
     block_q, block_k = _clamp_blocks(t, block_q, block_k)
     qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
@@ -286,16 +295,22 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret):
     return out.transpose(0, 2, 1, 3), (q, k, v, out, lse)
 
 
-def _bwd(causal, block_q, block_k, interpret, res, g):
+def _bwd(causal, block_q, block_k, interpret, block_q_bwd, block_k_bwd, res, g):
     q, k, v, out_bhtd, lse = res
     t = q.shape[1]
-    block_q, block_k = _clamp_blocks(t, block_q, block_k)
+    bq, bk = _clamp_blocks(
+        t, block_q_bwd or block_q, block_k_bwd or block_k
+    )
     b, h = out_bhtd.shape[:2]
     do = g.transpose(0, 2, 1, 3)  # [B, H, T, D]
+    # lse was saved in the FORWARD's [B, H, nq_f, bq_f] block layout;
+    # relayout to the backward's block size (pure reshape — row-major over
+    # the flattened T axis either way)
+    lse = lse.reshape(b, h, t // bq, bq)
     # Δ_i = Σ_d dO_id · O_id, in the same block-aligned layout as lse
     delta = (
         jnp.sum(do.astype(jnp.float32) * out_bhtd.astype(jnp.float32), axis=-1)
-        .reshape(b, h, t // block_q, block_q)
+        .reshape(b, h, t // bq, bq)
     )
     dq, dk, dv = _flash_bwd_bthd(
         q.transpose(0, 2, 1, 3),
@@ -304,8 +319,8 @@ def _bwd(causal, block_q, block_k, interpret, res, g):
         do,
         lse,
         delta,
-        block_q=block_q,
-        block_k=block_k,
+        block_q=bq,
+        block_k=bk,
         causal=causal,
         interpret=interpret,
     )

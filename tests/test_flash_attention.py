@@ -145,3 +145,25 @@ def test_flash_resolver_rejects_unknown():
         resolve_attention("nope")
     with pytest.raises(ValueError):
         resolve_attention("ring")  # needs a mesh
+
+
+@pytest.mark.slow
+def test_bwd_specific_blocks_match_shared_blocks():
+    """block_q_bwd/block_k_bwd change only the backward SCHEDULE: gradients
+    must match the shared-block configuration (the saved lse is relayouted
+    from the forward's block layout to the backward's)."""
+    q, k, v = _qkv(t=256, h=2)
+
+    def loss(blocks_bwd):
+        def f(q_, k_, v_):
+            o = flash_attention(
+                q_, k_, v_, True, 64, 64, True, blocks_bwd, blocks_bwd
+            )
+            return jnp.sum(o * o)
+
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    g_shared = loss(None)       # bwd uses the fwd's 64-blocks
+    g_bwd128 = loss(128)        # bwd re-blocks to 128
+    for a, bb in zip(g_shared, g_bwd128):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(bb), atol=1e-4)
