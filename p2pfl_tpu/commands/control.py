@@ -290,7 +290,12 @@ class SecAggShareCommand(Command):
             except (ValueError, SecAggError):
                 logger.error(st.addr, f"Malformed secagg_share from {source}")
                 return
-            if not 1 <= x <= 1024 or not 0 <= y < secagg.SHAMIR_PRIME:
+            # share indices run 1..len(holders) < sender's train set, which
+            # may differ from OUR latched set for the r±1 rounds this
+            # handler accepts — a sanity cap, not an exact bound: scale with
+            # membership but never below the legacy 1024 floor
+            max_x = max(2 * len(st.train_set), 1024)
+            if not 1 <= x <= max_x or not 0 <= y < secagg.SHAMIR_PRIME:
                 logger.error(st.addr, f"Out-of-range secagg_share from {source} — rejected")
                 return
             st.secagg_shares_held[(round, source)] = (x, y)
@@ -331,7 +336,7 @@ class SecAggRevealCommand(Command):
         except ValueError:
             logger.error(st.addr, f"Malformed secagg_reveal values from {source}")
             return
-        if not 0 <= x <= 1024 or not 0 <= y < secagg.SHAMIR_PRIME:
+        if not 0 <= x <= max(2 * len(st.train_set), 1024) or not 0 <= y < secagg.SHAMIR_PRIME:
             logger.error(st.addr, f"Out-of-range secagg_reveal from {source} — rejected")
             return
         if x == 0 and (source != owner or y >= (1 << 256)):

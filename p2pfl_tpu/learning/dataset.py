@@ -93,7 +93,7 @@ class FederatedDataset:
         determinism: float = 0.9,
         seed: int = 17,
         shift_frac: float = 0.0,
-        shift_seed: int = 0,
+        shift_seed: Optional[int] = None,
     ) -> "FederatedDataset":
         """Next-token prediction over a near-deterministic Markov chain.
 
@@ -112,7 +112,7 @@ class FederatedDataset:
         rng = np.random.default_rng(seed)
         succ = rng.permutation(vocab_size)  # deterministic successor table
         if shift_frac > 0.0:
-            r2 = np.random.default_rng(shift_seed or (seed + 1000))
+            r2 = np.random.default_rng(seed + 1000 if shift_seed is None else shift_seed)
             k = max(2, int(round(shift_frac * vocab_size)))
             idx = r2.choice(vocab_size, size=k, replace=False)
             # cyclic rotation of the chosen entries: every selected token's

@@ -401,6 +401,7 @@ class PipelineFederation:
         self._samples = np.asarray([d.num_samples for d in datasets], np.float32)
         self.round = 0
         self.history: list[dict] = []
+        self.last_profile: Optional[dict] = None
 
         mesh_, axis_, n_micro_, cfg_ = self.mesh, self.axis, self.n_micro, cfg
 
@@ -445,7 +446,13 @@ class PipelineFederation:
             idx = idx.reshape(nb, self.batch_size)
             yield jnp.asarray(d.x_train[idx]), jnp.asarray(d.y_train[idx])
 
-    def run_round(self, epochs: int = 1) -> dict:
+    def run_round(self, epochs: int = 1, profile: bool = False) -> dict:
+        """One federated round; ``profile=True`` adds per-node host syncs.
+
+        The default keeps dispatch fully async (node i+1's epochs enqueue
+        while node i computes); profiling inserts a ``block_until_ready``
+        per node to attribute wall time, which serializes the round.
+        """
         import time
 
         prof = {"node_epoch_s": [0.0] * self.n, "fedavg_s": 0.0}
@@ -454,23 +461,27 @@ class PipelineFederation:
             p = self.params
             o = self._opts[i] if self.keep_opt_state else self.tx.init(p)
             t0 = time.monotonic()
+            loss = None
             for xs, ys in self._node_batches(i, epochs):
                 p, o, loss = self._epoch(p, o, xs, ys)
-            jax.block_until_ready(loss)
-            prof["node_epoch_s"][i] = round(time.monotonic() - t0, 3)
+            if profile:
+                jax.block_until_ready(loss)
+                prof["node_epoch_s"][i] = round(time.monotonic() - t0, 3)
             if self.keep_opt_state:
                 self._opts[i] = o
             trained.append(p)
-            losses.append(float(loss))
+            losses.append(loss)
         # host-side FedAvg — the DCN weight exchange between slices
         t0 = time.monotonic()
         stacked = tree_stack(trained)
         self.params = fedavg(stacked, jnp.asarray(self._samples))
-        jax.block_until_ready(self.params)
-        prof["fedavg_s"] = round(time.monotonic() - t0, 3)
-        self.last_profile = prof
+        if profile:
+            jax.block_until_ready(self.params)
+            prof["fedavg_s"] = round(time.monotonic() - t0, 3)
+        # stale profiles must not be attributed to an unprofiled round
+        self.last_profile = prof if profile else None
         self.round += 1
-        entry = {"round": self.round, "train_loss": float(np.mean(losses))}
+        entry = {"round": self.round, "train_loss": float(np.mean([float(x) for x in losses]))}
         self.history.append(entry)
         return entry
 

@@ -268,7 +268,7 @@ class TrainStage(Stage):
         # federation-wide no-op round
         pubs = dict(state.secagg_pubs)
         self_seed = None
-        if Settings.SECAGG_DOUBLE_MASK and all(n in pubs for n in peers):
+        if Settings.SECAGG_DOUBLE_MASK and peers and all(n in pubs for n in peers):
             # Bonawitz double mask: fresh per-round self seed, t-of-n
             # Shamir-shared with the train-set peers BEFORE contributing —
             # if we crash after our masked update lands, the surviving
