@@ -757,7 +757,19 @@ def config5_nameplate_1b() -> None:
 
 def config6_heterogeneous_algorithms() -> None:
     """Beyond-reference breadth: FedAvg vs FedProx vs SCAFFOLD vs FedAdam on
-    Dirichlet(0.3) non-IID shards (the reference ships FedAvg only)."""
+    Dirichlet(0.3) non-IID shards (the reference ships FedAvg only).
+
+    SCAFFOLD is an SGD-family correction (its control-variate update is
+    coupled to the SGD step size, Karimireddy et al. 2020 eq. 4), so its
+    honest baseline is FedAvg with the SAME local SGD — the ``fedavg_sgd``
+    row. Round 4 compared it against FedAvg-with-Adam and concluded
+    SCAFFOLD "loses on the setting it exists for"; the 3-seed matched
+    sweep (2026-07-31) shows SCAFFOLD > FedAvg-SGD at every seed at
+    lr 0.02 (mean 0.679 vs 0.433 at 1 epoch; 0.976 vs 0.934 at 2), and
+    that the correction destabilizes when K·η grows (lr 0.05 × 2 epochs:
+    0.922 vs 0.995) — the known large-step regime, not a bug.
+    ``tests/test_fedopt_scaffold.py`` pins the matched-pair ordering.
+    """
     from p2pfl_tpu.learning.dataset import FederatedDataset
     from p2pfl_tpu.models import mlp
     from p2pfl_tpu.parallel import SpmdFederation
@@ -769,7 +781,8 @@ def config6_heterogeneous_algorithms() -> None:
     for algo, kwargs in {
         "fedavg": {},
         "fedprox": {"prox_mu": 0.1},
-        "scaffold": {"scaffold": True, "optimizer": "sgd", "learning_rate": 0.05},
+        "fedavg_sgd": {"optimizer": "sgd", "learning_rate": 0.02},
+        "scaffold": {"scaffold": True, "optimizer": "sgd", "learning_rate": 0.02},
         "fedadam": {"server_opt": "adam", "server_lr": 0.01},
     }.items():
         fed = SpmdFederation.from_dataset(
@@ -802,6 +815,14 @@ def config6_heterogeneous_algorithms() -> None:
         "n_nodes": n_nodes,
         "partition": "dirichlet(0.3)",
         "data": "synthetic-hard",
+        "scaffold_vs_matched_fedavg": round(
+            results["scaffold"][-1] - results["fedavg_sgd"][-1], 4
+        ),
+        "scaffold_note": (
+            "scaffold's baseline is fedavg_sgd (same local SGD, lr 0.02) — "
+            "the control-variate update is coupled to the SGD step; "
+            "adam rows are a different local optimizer family"
+        ),
         "devices": len(jax.devices()),
     })
 
@@ -839,9 +860,15 @@ def _fused_timer(fn, args, iters=30):
         return time.monotonic() - t0
 
     run(2)  # compile + warm
-    t_lo = run(iters)
-    t_hi = run(3 * iters)
-    return max(t_hi - t_lo, 1e-9) / (2 * iters)
+    # tunnel latency is variable run to run (measured ±20% on the same
+    # kernel); the median of repeated slopes is stable where one is not
+    slopes = []
+    for _ in range(3):
+        t_lo = run(iters)
+        t_hi = run(3 * iters)
+        slopes.append(max(t_hi - t_lo, 1e-9) / (2 * iters))
+    slopes.sort()
+    return slopes[1]
 
 
 def config7_long_context_flash() -> None:

@@ -21,6 +21,14 @@ def main(argv=None) -> None:
     parser.add_argument("--batch-size", type=int, default=128)
     parser.add_argument("--samples", type=int, default=8192, help="total training samples")
     parser.add_argument("--measure_time", action="store_true")
+    parser.add_argument(
+        "--plot",
+        nargs="?",
+        const="mnist_metrics.png",
+        default=None,
+        metavar="PNG",
+        help="render global metric curves to PNG (reference mnist.py:133-161)",
+    )
     args = parser.parse_args(argv)
 
     from p2pfl_tpu.learning.dataset import FederatedDataset
@@ -53,6 +61,17 @@ def main(argv=None) -> None:
     for node in nodes:
         print(f"{node.addr}: {node.learner.evaluate()}")
         node.stop()
+    if args.plot:
+        import os
+
+        from p2pfl_tpu.management.plotting import plot_global_metrics, plot_local_metrics
+
+        path = plot_global_metrics(args.plot)
+        print(f"global metric curves: {path or 'nothing to plot'}")
+        stem, ext = os.path.splitext(args.plot)
+        local = plot_local_metrics(f"{stem}_local{ext or '.png'}")
+        if local:
+            print(f"local metric curves: {local}")
     if args.measure_time:
         print(f"elapsed: {time.monotonic() - t0:.2f}s")
 

@@ -24,6 +24,14 @@ def main(argv=None) -> None:
     parser.add_argument("--measure_time", action="store_true")
     parser.add_argument("--dp-clip", type=float, default=0.0, help="DP-SGD clip norm (0 = off)")
     parser.add_argument("--dp-noise", type=float, default=0.0, help="DP-SGD noise multiplier")
+    parser.add_argument(
+        "--plot",
+        nargs="?",
+        const="spmd_mnist_metrics.png",
+        default=None,
+        metavar="PNG",
+        help="render the per-round loss/accuracy curves to PNG",
+    )
     args = parser.parse_args(argv)
 
     from p2pfl_tpu.learning.dataset import FederatedDataset
@@ -43,10 +51,17 @@ def main(argv=None) -> None:
         dp_noise=args.dp_noise,
     )
     t0 = time.monotonic()
+    history = []
     for r in range(args.rounds):
         entry = fed.run_round(epochs=args.epochs)
         metrics = fed.evaluate()
         print(f"round {entry['round']}: loss={entry['train_loss']:.4f} acc={metrics['test_acc']:.4f}")
+        history.append({**entry, "test_acc": float(metrics["test_acc"])})
+    if args.plot:
+        from p2pfl_tpu.management.plotting import plot_history
+
+        path = plot_history(history, args.plot, title=f"spmd {args.nodes} nodes")
+        print(f"metric curves: {path or 'nothing to plot'}")
     if args.measure_time:
         print(f"elapsed: {time.monotonic() - t0:.2f}s ({args.nodes} nodes)")
     if fed.accountant is not None:

@@ -433,11 +433,11 @@ def tiny_transformer(
                     f"multiple of 8 (Mosaic tiling); got {basis} (seq_len "
                     "per shard)"
                 )
-        # the BACKWARD kernels prefer larger blocks at wide heads (measured:
-        # D=128 bwd 56% MFU at block 1024 vs 45% at 512; noise at D=64)
+        # backward block sizes are decided INSIDE flash_attention's vjp
+        # (ops/flash_attention._default_bwd_blocks) — fused sweep keeps the
+        # forward blocks, split two-pass upsizes at wide heads. block_bwd
+        # here is an explicit override only.
         block_bwd = None
-        if attn == "flash" and cfg.dim // cfg.n_heads >= 128 and basis > (block or 0):
-            block_bwd = largest_block(min(1024, basis), block)
         attn_fn = resolve_attention(attn, mesh=mesh, block=block, block_bwd=block_bwd)
     module = CausalLM(cfg, attn_fn)
     rng = jax.random.PRNGKey(seed)

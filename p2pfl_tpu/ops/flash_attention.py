@@ -143,13 +143,48 @@ def _dq_kernel(
     dq_ref[:] = dq.astype(dq_ref.dtype)
 
 
+def _dkv_step(
+    i, dk, dv, *, q_ref, do_ref, lse_ref, delta_ref, k, v, kj,
+    block_q, block_k, scale, dt, masked, dq_acc=None,
+):
+    """One q-block's contribution to (dK_j, dV_j) — the body shared by the
+    split ``_dkv_kernel`` and the fused ``_dkvq_kernel``, which adds only
+    the ``dq_acc`` accumulation on top of identical S/P/dP/ds math."""
+    q = q_ref[pl.ds(i * block_q, block_q), :]
+    do = do_ref[pl.ds(i * block_q, block_q), :]
+    lse = lse_ref[pl.ds(i, 1), :].reshape(block_q, 1)
+    delta = delta_ref[pl.ds(i, 1), :].reshape(block_q, 1)
+    s = scale * jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [BQ, BK]
+    if masked:
+        rows = i * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        cols = kj * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(rows >= cols, s, NEG_INF)
+    p = jnp.exp(s - lse)  # [BQ, BK]
+    dv = dv + jax.lax.dot_general(
+        p.astype(dt), do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [BQ, BK]
+    ds = (p * (dp - delta)).astype(dt)
+    dk = dk + scale * jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    if dq_acc is not None:
+        dq_acc[pl.ds(i * block_q, block_q), :] += scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+    return dk, dv
+
+
 def _dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     *, block_q, block_k, causal, scale,
 ):
     kj = pl.program_id(2)
     t = q_ref.shape[0]
-    dt = q_ref.dtype
     k = k_ref[:]  # [BK, D]
     v = v_ref[:]  # [BK, D]
 
@@ -158,31 +193,11 @@ def _dkv_kernel(
     n_blocks = t // block_q
 
     def body(i, carry, *, masked):
-        dk, dv = carry
-        q = q_ref[pl.ds(i * block_q, block_q), :]
-        do = do_ref[pl.ds(i * block_q, block_q), :]
-        lse = lse_ref[pl.ds(i, 1), :].reshape(block_q, 1)
-        delta = delta_ref[pl.ds(i, 1), :].reshape(block_q, 1)
-        s = scale * jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [BQ, BK]
-        if masked:
-            rows = i * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            cols = kj * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse)  # [BQ, BK]
-        pd = p.astype(dt)
-        dv = dv + jax.lax.dot_general(
-            pd, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        return _dkv_step(
+            i, *carry, q_ref=q_ref, do_ref=do_ref, lse_ref=lse_ref,
+            delta_ref=delta_ref, k=k, v=v, kj=kj, block_q=block_q,
+            block_k=block_k, scale=scale, dt=q_ref.dtype, masked=masked,
         )
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [BQ, BK]
-        ds = p * (dp - delta)
-        dk = dk + scale * jax.lax.dot_general(
-            ds.astype(dt), q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        return dk, dv
 
     if causal:
         # q blocks strictly before the frontier never see this K block; q
@@ -195,6 +210,60 @@ def _dkv_kernel(
         dk, dv = lax.fori_loop(0, n_blocks, partial(body, masked=False), (dk, dv))
     dk_ref[:] = dk.astype(dk_ref.dtype)
     dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
+def _dkvq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, dq_ref,
+    dq_acc, *, block_q, block_k, causal, scale,
+):
+    """Single-pass backward: dK/dV per k-block AND dQ in one sweep.
+
+    The split backward (``_dq_kernel`` + ``_dkv_kernel``) recomputes
+    S = QK^T and dP = dO V^T in BOTH passes — 7 block matmuls executed for
+    the 5 the MFU accounting counts (measured: bwd trailed fwd by exactly
+    that ~1.4× on a v5e at D=128). Here the grid's k-block dimension runs
+    sequentially on the core, so dQ accumulates across grid steps in a
+    persistent fp32 VMEM scratch: S and dP are computed ONCE and all five
+    products (dV, dK, dQ + the two recomputes) come out of one sweep.
+    Scratch is zeroed at the first k-block and flushed to ``dq_ref`` at the
+    last; q/do stay VMEM-resident (same full-block residency the split
+    dkv kernel already required).
+    """
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+    t = q_ref.shape[0]
+    k = k_ref[:]  # [BK, D]
+    v = v_ref[:]  # [BK, D]
+
+    @pl.when(kj == 0)
+    def _zero():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    dk = jnp.zeros((block_k, k.shape[-1]), jnp.float32)
+    dv = jnp.zeros((block_k, k.shape[-1]), jnp.float32)
+    nq = t // block_q
+
+    def body(i, carry, *, masked):
+        return _dkv_step(
+            i, *carry, q_ref=q_ref, do_ref=do_ref, lse_ref=lse_ref,
+            delta_ref=delta_ref, k=k, v=v, kj=kj, block_q=block_q,
+            block_k=block_k, scale=scale, dt=q_ref.dtype, masked=masked,
+            dq_acc=dq_acc,
+        )
+
+    if causal:
+        start = lax.div(kj * block_k, block_q)
+        full = lax.div((kj + 1) * block_k + block_q - 1, block_q)
+        dk, dv = lax.fori_loop(start, full, partial(body, masked=True), (dk, dv))
+        dk, dv = lax.fori_loop(full, nq, partial(body, masked=False), (dk, dv))
+    else:
+        dk, dv = lax.fori_loop(0, nq, partial(body, masked=False), (dk, dv))
+    dk_ref[:] = dk.astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+    @pl.when(kj == nk - 1)
+    def _flush():
+        dq_ref[:] = dq_acc[...].astype(dq_ref.dtype)
 
 
 def _specs(block_q, block_k, t, d):
@@ -231,12 +300,52 @@ def _flash_fwd_bthd(q, k, v, *, block_q, block_k, causal, interpret):
     )(q, k, v)
 
 
+# backward structure: "fused" = one sweep with a persistent dQ scratch
+# (5 block matmuls, the MFU-accounted minimum); "split" = separate dq/dkv
+# kernels (7 — recomputes S and dP twice); "auto" picks fused whenever the
+# fp32 dQ scratch fits comfortably in VMEM next to resident q/do.
+BWD_MODE = "auto"
+_FUSED_SCRATCH_LIMIT = 4 * 1024 * 1024  # bytes of fp32 [T, D] dQ scratch
+
+
+def _bwd_use_fused(t: int, d: int) -> bool:
+    if BWD_MODE == "fused":
+        return True
+    if BWD_MODE == "split":
+        return False
+    return t * d * 4 <= _FUSED_SCRATCH_LIMIT
+
+
 def _flash_bwd_bthd(q, k, v, do, lse, delta, *, block_q, block_k, causal, interpret):
     b, h, t, d = q.shape
     scale = d ** -0.5
     qspec, kvfull, lse_full = _specs(block_q, block_k, t, d)
     qfull = pl.BlockSpec((None, None, t, d), lambda bi, hi, i: (bi, hi, 0, 0))
     kvspec = pl.BlockSpec((None, None, block_k, d), lambda bi, hi, j: (bi, hi, j, 0))
+
+    if _bwd_use_fused(t, d):
+        try:
+            from jax.experimental.pallas import tpu as pltpu
+
+            scratch = [pltpu.VMEM((t, d), jnp.float32)]
+        except ImportError:
+            scratch = [pl.MemorySpace.ANY((t, d), jnp.float32)]  # pragma: no cover
+        dk, dv, dq = pl.pallas_call(
+            partial(
+                _dkvq_kernel, block_q=block_q, block_k=block_k, causal=causal, scale=scale
+            ),
+            grid=(b, h, t // block_k),
+            in_specs=[qfull, kvspec, kvspec, qfull, lse_full, lse_full],
+            out_specs=[kvspec, kvspec, qfull],
+            out_shape=[
+                jax.ShapeDtypeStruct(k.shape, k.dtype),
+                jax.ShapeDtypeStruct(v.shape, v.dtype),
+                jax.ShapeDtypeStruct(q.shape, q.dtype),
+            ],
+            scratch_shapes=scratch,
+            interpret=interpret,
+        )(q, k, v, do, lse, delta)
+        return dq, dk, dv
 
     dq = pl.pallas_call(
         partial(_dq_kernel, block_q=block_q, block_k=block_k, causal=causal, scale=scale),
@@ -268,12 +377,12 @@ def flash_attention(
 ):
     """Flash attention. q,k,v: [B, T, H, D] (GQA heads pre-repeated).
 
-    ``block_q_bwd`` / ``block_k_bwd`` (default: the forward blocks): the
-    backward kernels prefer LARGER blocks than the forward — measured at
-    T=4096 D=128 on a v5e, bwd at 1024/1024 runs 56% MFU vs 45% at the
-    forward-optimal 512/512 (+25%); at D=64 the difference is noise. The
-    saved log-sum-exp is stored in the forward's block layout and reshaped
-    to the backward's on the XLA side (a free relayout next to the kernel).
+    ``block_q_bwd`` / ``block_k_bwd`` are explicit overrides; when None the
+    backward picks its own blocks (``_default_bwd_blocks``): the fused
+    single-pass kernel keeps the forward's, the split two-pass upsizes to
+    <=1024 at wide heads (both measured on a v5e at T=4096). The saved
+    log-sum-exp is stored in the forward's block layout and reshaped to the
+    backward's on the XLA side (a free relayout next to the kernel).
     """
     out, _ = _fwd(q, k, v, causal, block_q, block_k, interpret, block_q_bwd, block_k_bwd)
     return out
@@ -295,12 +404,32 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret, block_q_bwd=None, block_k
     return out.transpose(0, 2, 1, 3), (q, k, v, out, lse)
 
 
+def _default_bwd_blocks(t, d, block_q, block_k):
+    """The ONE place backward block sizes are decided (callers pass
+    ``block_q_bwd`` only to override). Fused single-pass: the forward's own
+    blocks are fastest (measured D=128/T=4096: 66.7% MFU at 512 vs 57.5%
+    at 1024). Split two-pass at wide heads: the largest block <= 1024
+    (measured 56% vs 45% at 512)."""
+    if _bwd_use_fused(t, d):
+        return block_q, block_k
+    if d >= 128:
+        big = next(
+            (b for b in range(min(1024, t), block_q, -1) if t % b == 0 and b % 8 == 0),
+            None,
+        )
+        if big:
+            return big, big
+    return block_q, block_k
+
+
 def _bwd(causal, block_q, block_k, interpret, block_q_bwd, block_k_bwd, res, g):
     q, k, v, out_bhtd, lse = res
     t = q.shape[1]
-    bq, bk = _clamp_blocks(
-        t, block_q_bwd or block_q, block_k_bwd or block_k
-    )
+    if block_q_bwd is None and block_k_bwd is None:
+        bq, bk = _default_bwd_blocks(t, q.shape[-1], block_q, block_k)
+    else:
+        bq, bk = block_q_bwd or block_q, block_k_bwd or block_k
+    bq, bk = _clamp_blocks(t, bq, bk)
     b, h = out_bhtd.shape[:2]
     do = g.transpose(0, 2, 1, 3)  # [B, H, T, D]
     # lse was saved in the FORWARD's [B, H, nq_f, bq_f] block layout;

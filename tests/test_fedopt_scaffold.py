@@ -270,3 +270,31 @@ def test_spmd_scaffold_requires_sgd():
         SpmdFederation.from_dataset(
             mlp(), data, n_nodes=2, batch_size=64, scaffold=True
         )
+
+
+@pytest.mark.slow
+def test_scaffold_beats_matched_fedavg_on_noniid():
+    """SCAFFOLD's drift correction must beat FedAvg under the SAME local
+    SGD on Dirichlet(0.3) non-IID shards (Karimireddy et al. 2020). Round
+    4's bench compared it against FedAvg-with-ADAM and mis-read the result
+    as a SCAFFOLD defect; this pins the matched-optimizer ordering at the
+    regime where the correction matters (lr 0.02, 1 local epoch, seeds
+    averaged — measured margin ~0.25 mean acc, far above seed noise)."""
+    import numpy as np
+
+    from p2pfl_tpu.learning.dataset import FederatedDataset as FD
+
+    data = FD.mnist(None, modes=8, noise=0.7, proto_scale=0.5)
+
+    def final_acc(seed, **kwargs):
+        fed = SpmdFederation.from_dataset(
+            mlp(), data, n_nodes=8, strategy="dirichlet", alpha=0.3,
+            batch_size=64, vote=False, seed=seed,
+            optimizer="sgd", learning_rate=0.02, **kwargs,
+        )
+        entries = fed.run_fused(10, epochs=1, eval=True)
+        return float(entries[-1]["test_acc"])
+
+    fa = np.mean([final_acc(s) for s in (7, 11)])
+    sc = np.mean([final_acc(s, scaffold=True) for s in (7, 11)])
+    assert sc > fa + 0.05, (sc, fa)

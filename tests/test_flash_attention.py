@@ -167,3 +167,53 @@ def test_bwd_specific_blocks_match_shared_blocks():
     g_bwd128 = loss(128)        # bwd re-blocks to 128
     for a, bb in zip(g_shared, g_bwd128):
         np.testing.assert_allclose(np.asarray(a), np.asarray(bb), atol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_fused_bwd_matches_split(causal):
+    """The single-pass dkvq kernel (persistent dQ scratch across k-block
+    grid steps) must produce the SAME gradients as the split dq/dkv pair —
+    it only removes the S/dP recompute, not any math."""
+    from p2pfl_tpu.ops import flash_attention as fa
+
+    q, k, v = _qkv(b=2, t=128, h=2, d=16)
+
+    def grads():
+        def f(q_, k_, v_):
+            o = fa.flash_attention(q_, k_, v_, causal, 32, 64, True)
+            return jnp.sum(o * o)
+
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    old = fa.BWD_MODE
+    try:
+        fa.BWD_MODE = "split"
+        g_split = grads()
+        fa.BWD_MODE = "fused"
+        g_fused = grads()
+    finally:
+        fa.BWD_MODE = old
+    for a, b in zip(g_fused, g_split):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_fused_bwd_matches_dense_gradient():
+    from p2pfl_tpu.ops import flash_attention as fa
+
+    q, k, v = _qkv(b=1, t=64, h=2, d=16)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(fa.flash_attention(q, k, v, True, 16, 32, True) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(causal_attention(q, k, v) ** 2)
+
+    old = fa.BWD_MODE
+    try:
+        fa.BWD_MODE = "fused"
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    finally:
+        fa.BWD_MODE = old
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)

@@ -1,0 +1,46 @@
+"""Metric-curve rendering (``management/plotting.py``) — parity with the
+reference example's matplotlib output (``p2pfl/examples/mnist.py:124-157``),
+rendered to PNG on this headless rig."""
+
+import os
+
+from p2pfl_tpu.management.logger import logger
+from p2pfl_tpu.management.plotting import (
+    plot_global_metrics,
+    plot_history,
+    plot_local_metrics,
+)
+
+
+def test_plot_global_and_local_from_logger(tmp_path):
+    logger.register_node("plot-node")
+    try:
+        for rnd in (0, 1, 2):
+            logger.log_metric(
+                "plot-node", "test_acc", 0.5 + 0.1 * rnd, round=rnd, experiment="plot-exp"
+            )
+            for step in range(4):
+                logger.log_metric(
+                    "plot-node", "train_loss", 2.0 - 0.1 * step, step=step,
+                    round=rnd, experiment="plot-exp",
+                )
+        g = plot_global_metrics(str(tmp_path / "g.png"), experiment="plot-exp")
+        l = plot_local_metrics(str(tmp_path / "l.png"), experiment="plot-exp")
+        assert g and os.path.getsize(g) > 1000
+        assert l and os.path.getsize(l) > 1000
+    finally:
+        logger.unregister_node("plot-node")
+
+
+def test_plot_global_empty_returns_none(tmp_path):
+    assert plot_global_metrics(str(tmp_path / "x.png"), experiment="no-such-exp") is None
+
+
+def test_plot_history(tmp_path):
+    hist = [
+        {"round": r, "train_loss": 2.0 / (r + 1), "test_acc": 0.3 + 0.2 * r}
+        for r in range(4)
+    ]
+    p = plot_history(hist, str(tmp_path / "h.png"), title="t")
+    assert p and os.path.getsize(p) > 1000
+    assert plot_history([], str(tmp_path / "e.png")) is None
