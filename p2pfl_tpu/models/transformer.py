@@ -26,9 +26,29 @@ from typing import Any, Callable, Optional
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from p2pfl_tpu.models.base import FlaxModel
 from p2pfl_tpu.ops.attention import causal_attention
+
+
+_REMAT_SAVE_NAMES = {
+    "mlp": ("ffn_gate", "ffn_up"),
+    "mlp_qkv": ("ffn_gate", "ffn_up", "attn_q", "attn_k", "attn_v"),
+}
+
+
+def _remat_policy(name: Optional[str]):
+    """Map ``TransformerConfig.remat_policy`` to a jax.checkpoint policy."""
+    if name is None:
+        return None  # full per-block remat: save nothing inside the block
+    try:
+        names = _REMAT_SAVE_NAMES[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown remat_policy {name!r} (None|{'|'.join(_REMAT_SAVE_NAMES)})"
+        ) from None
+    return jax.checkpoint_policies.save_only_these_names(*names)
 
 
 @dataclass(frozen=True)
@@ -59,6 +79,21 @@ class TransformerConfig:
     # full forward and still stashes every layer during the recompute);
     # per-block boundaries bound peak activation memory at one block.
     remat: bool = False
+    # selective rematerialization policy (only meaningful with remat=True):
+    #   None       — full per-block remat: nothing inside a block is saved,
+    #                the backward re-runs the whole block forward (max
+    #                memory savings, ~1/3 extra executed FLOPs);
+    #   "mlp"      — save the FFN gate/up activations (the FFN is ~70% of a
+    #                block's FLOPs) so the backward recomputes only the
+    #                attention side;
+    #   "mlp_qkv"  — additionally save post-RoPE q/k/v (k/v pre-GQA-repeat,
+    #                so 2·kv_heads·head_dim + dim per token): the backward
+    #                recomputes only the flash kernel forward (for its lse
+    #                residual) and elementwise glue.
+    # Memory cost per token-layer (bf16): mlp = 2·ffn_hidden, mlp_qkv adds
+    # dim + 2·(kv/heads)·dim. Pick the richest policy that fits HBM —
+    # bench config5_nameplate_1b measures the ladder at 0.98B.
+    remat_policy: Optional[str] = None
     # lax.scan over the block stack instead of Python-unrolled layers:
     # params stack on a leading [L, ...] axis and the compiled program
     # contains ONE block body regardless of depth — compile time and
@@ -68,6 +103,27 @@ class TransformerConfig:
     # Incompatible with n_experts>0 for now (sown MoE aux losses don't
     # thread through nn.scan broadcasts here).
     scan_layers: bool = False
+
+    def __post_init__(self) -> None:
+        if self.remat_policy is not None:
+            if self.remat_policy not in _REMAT_SAVE_NAMES:
+                raise ValueError(
+                    f"unknown remat_policy {self.remat_policy!r} "
+                    f"(None|{'|'.join(_REMAT_SAVE_NAMES)})"
+                )
+            if not self.remat:
+                raise ValueError(
+                    "remat_policy is only meaningful with remat=True — a "
+                    "policy on a no-remat model would silently change the "
+                    "memory/FLOPs profile the caller asked for"
+                )
+            if self.n_experts > 0:
+                raise ValueError(
+                    "remat_policy with MoE: MoEMLP's expert einsums carry "
+                    "no checkpoint_name tags yet, so the policy would "
+                    "silently degrade to blanket remat — use "
+                    "remat_policy=None for MoE models"
+                )
 
 
 class RMSNorm(nn.Module):
@@ -138,6 +194,11 @@ class Attention(nn.Module):
         q = rope(q.reshape(b, t, cfg.n_heads, head_dim), cfg.rope_theta)
         k = rope(k.reshape(b, t, cfg.n_kv_heads, head_dim), cfg.rope_theta)
         v = v.reshape(b, t, cfg.n_kv_heads, head_dim)
+        # selective-remat tags: saved pre-GQA-repeat (kv_heads wide, the
+        # repeat is a cheap broadcast to recompute)
+        q = checkpoint_name(q, "attn_q")
+        k = checkpoint_name(k, "attn_k")
+        v = checkpoint_name(v, "attn_v")
         # GQA: repeat K/V heads to match Q heads
         rep = cfg.n_heads // cfg.n_kv_heads
         k = jnp.repeat(k, rep, axis=2)
@@ -155,8 +216,8 @@ class MLP(nn.Module):
         cfg = self.cfg
         rank = cfg.lora_rank if cfg.lora_mlp else 0
         dense = partial(LoRADense, rank=rank, alpha=cfg.lora_alpha, dtype=cfg.dtype)
-        gate = dense(cfg.ffn_hidden, name="w1")(x)
-        up = dense(cfg.ffn_hidden, name="w3")(x)
+        gate = checkpoint_name(dense(cfg.ffn_hidden, name="w1")(x), "ffn_gate")
+        up = checkpoint_name(dense(cfg.ffn_hidden, name="w3")(x), "ffn_up")
         return dense(cfg.dim, name="w2")(nn.silu(gate) * up)
 
 
@@ -302,7 +363,9 @@ class CausalLM(nn.Module):
                 # prevent_cse=False: inside lax.scan the remat thunk can't
                 # be CSE'd across iterations anyway, and True blocks the
                 # scan lowering (flax's documented scan-over-remat recipe)
-                body = nn.remat(body, prevent_cse=False)
+                body = nn.remat(
+                    body, prevent_cse=False, policy=_remat_policy(cfg.remat_policy)
+                )
             scan = nn.scan(
                 body,
                 variable_axes={"params": 0},
@@ -311,7 +374,11 @@ class CausalLM(nn.Module):
             )
             x, _ = scan(cfg, self.attn_fn, name="layers")(x, None)
         else:
-            block_cls = nn.remat(Block) if cfg.remat else Block
+            block_cls = (
+                nn.remat(Block, policy=_remat_policy(cfg.remat_policy))
+                if cfg.remat
+                else Block
+            )
             for i in range(cfg.n_layers):
                 x = block_cls(cfg, self.attn_fn, name=f"layer_{i}")(x)
         x = RMSNorm(cfg.dtype, name="final_norm")(x)

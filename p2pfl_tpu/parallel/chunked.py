@@ -136,7 +136,7 @@ class ChunkedFederation:
         self.n = len(datasets)
         if self.n % chunk_size != 0:
             raise ValueError(f"{self.n} nodes not divisible into chunks of {chunk_size}")
-        self.chunk_size = chunk_size
+        self._chunk_size = chunk_size
         self.datasets = datasets
         self.batch_size = batch_size
         self.tx = tx if tx is not None else adam(learning_rate)
@@ -152,16 +152,13 @@ class ChunkedFederation:
             raise ValueError(f"smallest shard ({tr_min}) < batch size ({batch_size})")
         te_min = min(len(d.y_test) for d in datasets)
 
-        def wrap(a: np.ndarray, target: int) -> np.ndarray:
-            if len(a) == target:
-                return a
-            reps = -(-target // len(a))
-            return np.concatenate([a] * reps, axis=0)[:target]
-
         # whole-federation data stays on device (config 3: ~200 MB — it's
-        # the PER-NODE STATE that doesn't fit, not the data)
-        self.x_all = jax.device_put(np.stack([wrap(d.x_train, tr_max) for d in datasets]))
-        self.y_all = jax.device_put(np.stack([wrap(d.y_train, tr_max) for d in datasets]))
+        # the PER-NODE STATE that doesn't fit, not the data), PRE-SPLIT
+        # into per-chunk arrays: slicing a device array per round per chunk
+        # materializes a fresh copy every time (measured as pure HBM-copy
+        # overhead on the round path); staging the slices once removes it
+        self._tr_max = tr_max
+        self._stage_chunks()
         self.x_test = jax.device_put(np.stack([d.x_test[:te_min] for d in datasets]))
         self.y_test = jax.device_put(np.stack([d.y_test[:te_min] for d in datasets]))
         self._sizes = sizes
@@ -173,6 +170,41 @@ class ChunkedFederation:
         self.round = 0
         self.history: list[dict] = []
         self._stage_state()
+
+    def _stage_chunks(self) -> None:
+        # rebuilt from the datasets each time (only at init and on a
+        # chunk_size change) so no whole-federation numpy copy lives in
+        # host RAM for the object's lifetime
+        c = self._chunk_size
+
+        def wrap(a: np.ndarray) -> np.ndarray:
+            if len(a) == self._tr_max:
+                return a
+            reps = -(-self._tr_max // len(a))
+            return np.concatenate([a] * reps, axis=0)[: self._tr_max]
+
+        self.x_chunks = [
+            jax.device_put(np.stack([wrap(d.x_train) for d in self.datasets[c0 : c0 + c]]))
+            for c0 in range(0, self.n, c)
+        ]
+        self.y_chunks = [
+            jax.device_put(np.stack([wrap(d.y_train) for d in self.datasets[c0 : c0 + c]]))
+            for c0 in range(0, self.n, c)
+        ]
+
+    @property
+    def chunk_size(self) -> int:
+        return self._chunk_size
+
+    @chunk_size.setter
+    def chunk_size(self, value: int) -> None:
+        # re-splitting the pre-staged per-chunk data keeps the round path
+        # copy-free while letting callers retune the chunk size
+        if self.n % value != 0:
+            raise ValueError(f"{self.n} nodes not divisible into chunks of {value}")
+        if value != self._chunk_size:
+            self._chunk_size = value
+            self._stage_chunks()
 
     def _stage_state(self) -> None:
         self.params = jax.device_put(self.model.params)
@@ -230,15 +262,15 @@ class ChunkedFederation:
         # chunk k+1's staging behind chunk k's compute and defeating the
         # async dispatch pipeline this class exists for
         loss_acc = jnp.float32(0.0)
-        for c0 in range(0, self.n, c):
+        for ci, c0 in enumerate(range(0, self.n, c)):
             m = eff[c0 : c0 + c]
             if m.sum() == 0:
                 continue  # fully-masked chunk: no contribution, skip dispatch
             p_c, o_c, w_c, l_c = _chunk_round(
                 self.params,
                 self.opt_state,
-                self.x_all[c0 : c0 + c],
-                self.y_all[c0 : c0 + c],
+                self.x_chunks[ci],
+                self.y_chunks[ci],
                 jax.device_put(perm_np[c0 : c0 + c]),
                 jnp.asarray(m),
                 jnp.asarray(self._samples[c0 : c0 + c]),
@@ -290,20 +322,29 @@ class ChunkedFederation:
             "test_acc": float(np.mean(np.concatenate(accs))),
         }
 
-    def round_flops(self, epochs: int = 1) -> Optional[float]:
-        """Scan-aware model FLOPs of one full round (all N nodes)."""
+    def round_flops(self, epochs: int = 1, hw: bool = False) -> Optional[float]:
+        """Scan-aware FLOPs of one full round (all N nodes).
+
+        ``hw=False``: model FLOPs (no remat recompute) — the useful-work
+        numerator. ``hw=True``: the step probed WITH the round's actual
+        ``jax.checkpoint``, so XLA's count includes the recompute — the
+        executed-work numerator the resident SpmdFederation probes report
+        (config 3's chunked-vs-resident MFU is only comparable on this one).
+        """
         from p2pfl_tpu.management.profiling import compiled_flops
 
         def one_step(p, o, bx, by):
             def loss_fn(p_):
                 return _loss(p_, self.module, bx, by)[0]
 
+            if hw and self.remat:
+                loss_fn = jax.checkpoint(loss_fn)
             loss, grads = jax.value_and_grad(loss_fn)(p)
             updates, o = self.tx.update(grads, o, p)
             return optax.apply_updates(p, updates), o, loss
 
-        bx = self.x_all[0, : self.batch_size]
-        by = self.y_all[0, : self.batch_size]
+        bx = self.x_chunks[0][0, : self.batch_size]
+        by = self.y_chunks[0][0, : self.batch_size]
         step = compiled_flops(jax.jit(one_step), self.params, self.opt_state, bx, by)
         if step is None:
             return None

@@ -47,8 +47,17 @@ def _lora_round_core(
     out_sharding=None,
     keep_opt_state: bool = False,
     remat: bool = False,
+    node_chunk: int = 0,
 ):
-    """Trace-time body shared by the one-round and fused-round programs."""
+    """Trace-time body shared by the one-round and fused-round programs.
+
+    ``node_chunk``: train the N nodes ``node_chunk`` at a time via a
+    ``lax.scan`` of vmapped chunks instead of one N-wide vmap. Activation
+    memory scales with nodes-in-flight, so chunking buys HBM headroom for
+    a richer selective-remat policy (``TransformerConfig.remat_policy``) —
+    the 0.98B bench row trades 4× fewer nodes in flight for skipping the
+    FFN recompute entirely, a net model-MFU win. 0 = single vmap.
+    """
     n = mask.shape[0]
 
     def node_fn(lora, opt_state, x, y, idx):
@@ -81,9 +90,36 @@ def _lora_round_core(
         (lora, opt_state), losses = jax.lax.scan(epoch_body, (lora, opt_state), idx)
         return lora, opt_state, jnp.mean(losses)
 
-    trained, trained_opt, losses = jax.vmap(node_fn, in_axes=(0, 0, 0, 0, 0))(
-        stacked_lora, opt_states, x_all, y_all, perm
-    )
+    vmapped = jax.vmap(node_fn, in_axes=(0, 0, 0, 0, 0))
+    if node_chunk and node_chunk < n:
+        if n % node_chunk:
+            raise ValueError(f"node_chunk {node_chunk} must divide n_nodes {n}")
+        nc = n // node_chunk
+
+        def chunked(tree):
+            return jax.tree.map(
+                lambda a: a.reshape(nc, node_chunk, *a.shape[1:]), tree
+            )
+
+        def chunk_body(_, args):
+            return None, vmapped(*args)
+
+        _, (trained, trained_opt, losses) = jax.lax.scan(
+            chunk_body,
+            None,
+            (
+                chunked(stacked_lora), chunked(opt_states),
+                chunked(x_all), chunked(y_all), chunked(perm),
+            ),
+        )
+        trained, trained_opt = jax.tree.map(
+            lambda a: a.reshape(n, *a.shape[2:]), (trained, trained_opt)
+        )
+        losses = losses.reshape(n)
+    else:
+        trained, trained_opt, losses = vmapped(
+            stacked_lora, opt_states, x_all, y_all, perm
+        )
 
     def sel(new, old):
         m = mask.reshape((n,) + (1,) * (new.ndim - 1)).astype(new.dtype)
@@ -102,7 +138,10 @@ def _lora_round_core(
     return out, out_opt, jnp.mean(losses, where=mask.astype(bool))
 
 
-_LORA_STATICS = ("module", "tx", "agg", "trim", "out_sharding", "keep_opt_state", "remat")
+_LORA_STATICS = (
+    "module", "tx", "agg", "trim", "out_sharding", "keep_opt_state", "remat",
+    "node_chunk",
+)
 
 
 @partial(jax.jit, static_argnames=_LORA_STATICS, donate_argnums=(0, 1))
@@ -156,6 +195,7 @@ class SpmdLoraFederation(SpmdFederation):
         datasets: list[FederatedDataset],
         mesh: Optional[Mesh] = None,
         model_parallel_base: bool = False,
+        node_chunk: int = 0,
         **kwargs,
     ) -> None:
         lora0, base0 = split_lora(model.params)
@@ -164,6 +204,7 @@ class SpmdLoraFederation(SpmdFederation):
         self._lora_template = lora0
         self._base_template = base0
         self._mp_base = model_parallel_base
+        self.node_chunk = node_chunk
         super().__init__(model, datasets, mesh=mesh, **kwargs)
 
     # node-stacked state = adapters only; base placed separately
@@ -209,6 +250,7 @@ class SpmdLoraFederation(SpmdFederation):
             out_sharding=self._shard,
             keep_opt_state=self.keep_opt_state,
             remat=self.remat,
+            node_chunk=self.node_chunk,
         )
         self.round += 1
         entry = {"round": self.round, "train_loss": loss}
@@ -230,7 +272,7 @@ class SpmdLoraFederation(SpmdFederation):
             perms, mask, self._samples, sel_idx,
             module=self.module, tx=self.tx, agg=self.aggregator, trim=self.trim,
             out_sharding=self._shard, keep_opt_state=self.keep_opt_state,
-            remat=self.remat,
+            remat=self.remat, node_chunk=self.node_chunk,
         )
         entries = []
         for r in range(rounds):

@@ -1,0 +1,83 @@
+"""Real multi-process ``init_multihost`` (VERDICT r4 #7): two CPU processes
+form one JAX distributed runtime over localhost and run a global all-reduce
+— the non-noop branches of ``parallel/distributed.py``, exercised without
+TPU-pod hardware.
+
+The worker runs in subprocesses because ``jax.distributed.initialize``
+is once-per-process; the parent (which may already hold a backend) only
+orchestrates.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = r"""
+import os, sys
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the chip tunnel
+os.environ["JAX_PLATFORMS"] = "cpu"
+pid = int(sys.argv[1])
+os.environ["JAX_COORDINATOR_ADDRESS"] = "127.0.0.1:%PORT%"
+os.environ["JAX_NUM_PROCESSES"] = "2"
+os.environ["JAX_PROCESS_ID"] = str(pid)
+
+from p2pfl_tpu.parallel.distributed import init_multihost
+
+info = init_multihost()  # env-var path: the production bring-up
+assert info["initialized"], info
+assert info["process_count"] == 2, info
+assert info["process_index"] == pid, info
+assert info["global_devices"] == 2 * info["local_devices"], info
+
+# one tiny global collective across the two processes: each contributes
+# its process_index+1; the psum over the global mesh must see BOTH hosts
+import jax
+import jax.numpy as jnp
+from jax.experimental.multihost_utils import process_allgather
+
+got = process_allgather(jnp.float32(pid + 1))
+assert sorted(got.tolist()) == [1.0, 2.0], got
+print(f"OK process {pid}: {info['process_count']} procs, "
+      f"{info['global_devices']} global devices, allgather {got.tolist()}")
+"""
+
+
+@pytest.mark.slow
+def test_two_process_runtime_and_collective(tmp_path):
+    import socket
+
+    with socket.socket() as s:  # a free localhost port for the coordinator
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER.replace("%PORT%", str(port)))
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("JAX_PLATFORMS", "XLA_FLAGS", "PALLAS_AXON_POOL_IPS")
+    }
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (os.getcwd(), env.get("PYTHONPATH")) if p
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(pid)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+        )
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multi-process runtime hung (coordinator never formed)")
+        outs.append(out)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-2000:]
+    assert "OK process 0: 2 procs" in outs[0]
+    assert "OK process 1: 2 procs" in outs[1]

@@ -111,3 +111,38 @@ def test_lora_fused_matches_sequential():
     for a, b in zip(jax.tree.leaves(seq.params), jax.tree.leaves(fused.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
     assert fused.round == 3
+
+
+def test_node_chunk_matches_unchunked():
+    """``node_chunk`` reorders the node axis from one vmap into a scan of
+    vmapped chunks — identical round results, and a non-dividing chunk
+    size is rejected."""
+    import numpy as np
+
+    from p2pfl_tpu.learning.dataset import FederatedDataset
+    from p2pfl_tpu.models.transformer import TransformerConfig, tiny_transformer
+    from p2pfl_tpu.parallel import SpmdLoraFederation
+
+    data = FederatedDataset.synthetic_lm(
+        vocab_size=64, seq_len=16, n_train=32, n_test=16
+    )
+
+    def make(nc):
+        cfg = TransformerConfig(
+            vocab_size=64, dim=32, n_layers=2, n_heads=2, n_kv_heads=2,
+            ffn_hidden=64, lora_rank=2, remat=True, scan_layers=True,
+        )
+        m = tiny_transformer(seq_len=16, seed=0, cfg=cfg)
+        return SpmdLoraFederation.from_dataset(
+            m, data, n_nodes=4, batch_size=4, vote=False, seed=3, node_chunk=nc
+        )
+
+    a, b = make(0), make(2)
+    ea, eb = a.run_round(epochs=1), b.run_round(epochs=1)
+    assert float(ea["train_loss"]) == pytest.approx(float(eb["train_loss"]), abs=1e-6)
+    for x, y in zip(jax.tree.leaves(a.params), jax.tree.leaves(b.params)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), atol=1e-6)
+
+    bad = make(3)
+    with pytest.raises(ValueError, match="node_chunk"):
+        bad.run_round(epochs=1)

@@ -176,3 +176,47 @@ def test_scan_layers_rejects_moe():
     )
     with _pytest.raises(NotImplementedError, match="scan_layers with MoE"):
         tiny_transformer(seq_len=8, cfg=cfg)
+
+
+def test_remat_policy_grads_match_full_remat():
+    """Selective remat (``remat_policy``) changes WHAT the backward saves,
+    never the math: loss and grads must equal the blanket-remat ones, and
+    an unknown policy is rejected at trace time."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    import pytest as _pytest
+
+    from p2pfl_tpu.models.transformer import (
+        TransformerConfig,
+        _remat_policy,
+        tiny_transformer,
+    )
+
+    toks = jax.random.randint(jax.random.PRNGKey(0), (2, 16), 0, 64)
+    results = {}
+    for pol in (None, "mlp", "mlp_qkv"):
+        cfg = TransformerConfig(
+            vocab_size=64, dim=32, n_layers=2, n_heads=2, n_kv_heads=2,
+            ffn_hidden=64, lora_rank=2, remat=True, scan_layers=True,
+            remat_policy=pol,
+        )
+        m = tiny_transformer(seq_len=16, seed=0, cfg=cfg)
+
+        def loss(p, m=m):
+            logits = m.apply(p, toks)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, jnp.roll(toks, -1, 1)
+            ).mean()
+
+        results[pol] = jax.jit(jax.value_and_grad(loss))(m.params)
+    l0, g0 = results[None]
+    for pol in ("mlp", "mlp_qkv"):
+        l, g = results[pol]
+        assert float(l) == _pytest.approx(float(l0), abs=1e-6)
+        for a, b in zip(jax.tree.leaves(g), jax.tree.leaves(g0)):
+            import numpy as np
+
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+    with _pytest.raises(ValueError, match="remat_policy"):
+        _remat_policy("bogus")
