@@ -844,10 +844,14 @@ def config5_nameplate_1b() -> None:
         "mfu_hw": round(_mfu_from(flops_hw, sec_per_round) or 0, 4),
         "remat_note": "selective remat (save ffn gate/up + post-rope qkv, "
                       "recompute only the flash fwd) + 4-node chunking "
-                      "replaces the blanket per-block remat: measured "
-                      "8.99 -> 6.30 s/round; no-remat still OOMs (21.6G "
-                      "needed, 15.75G HBM), mlp-policy at 16 nodes in "
-                      "flight OOMs — the ladder is HBM-constrained",
+                      "replaces the blanket per-block remat: the eval-free "
+                      "policy-ladder sweep measured 8.99 -> 6.30 s/round; "
+                      "this row's headline value is the steady state "
+                      "inside the federation's eval cadence (settling "
+                      "round + eval-adjacent dispatch). No-remat still "
+                      "OOMs (21.6G needed, 15.75G HBM), mlp-policy at 16 "
+                      "nodes in flight OOMs — the ladder is "
+                      "HBM-constrained",
         "pretrain_loss_curve": pre_curve,
         "random_floor_loss": 8.318,
         "pretrained_base_acc": round(float(acc0), 4),

@@ -106,23 +106,12 @@ class TransformerConfig:
 
     def __post_init__(self) -> None:
         if self.remat_policy is not None:
-            if self.remat_policy not in _REMAT_SAVE_NAMES:
-                raise ValueError(
-                    f"unknown remat_policy {self.remat_policy!r} "
-                    f"(None|{'|'.join(_REMAT_SAVE_NAMES)})"
-                )
+            _remat_policy(self.remat_policy)  # raises on an unknown name
             if not self.remat:
                 raise ValueError(
                     "remat_policy is only meaningful with remat=True — a "
                     "policy on a no-remat model would silently change the "
                     "memory/FLOPs profile the caller asked for"
-                )
-            if self.n_experts > 0:
-                raise ValueError(
-                    "remat_policy with MoE: MoEMLP's expert einsums carry "
-                    "no checkpoint_name tags yet, so the policy would "
-                    "silently degrade to blanket remat — use "
-                    "remat_policy=None for MoE models"
                 )
 
 
@@ -296,8 +285,15 @@ class MoEMLP(nn.Module):
         w2 = self.param("w2", nn.initializers.lecun_normal(), (e, f, d))
 
         xe = jnp.einsum("sec,sd->ecd", dispatch, xs.astype(cfg.dtype))  # [E, C, D]
-        gate_h = jnp.einsum("ecd,edf->ecf", xe, w1.astype(cfg.dtype))
-        up_h = jnp.einsum("ecd,edf->ecf", xe, w3.astype(cfg.dtype))
+        # same selective-remat tags as the dense MLP: the "mlp" policy
+        # saves the expert hidden activations so the backward skips the
+        # two big expert einsums (the layer's dominant FLOPs)
+        gate_h = checkpoint_name(
+            jnp.einsum("ecd,edf->ecf", xe, w1.astype(cfg.dtype)), "ffn_gate"
+        )
+        up_h = checkpoint_name(
+            jnp.einsum("ecd,edf->ecf", xe, w3.astype(cfg.dtype)), "ffn_up"
+        )
         ye = jnp.einsum("ecf,efd->ecd", nn.silu(gate_h) * up_h, w2.astype(cfg.dtype))
         out = jnp.einsum("sec,ecd->sd", combine.astype(cfg.dtype), ye)  # [S, D]
 

@@ -316,6 +316,17 @@ def _bwd_use_fused(t: int, d: int) -> bool:
     return t * d * 4 <= _FUSED_SCRATCH_LIMIT
 
 
+def _dq_scratch(t: int, d: int):
+    """The fused backward's persistent fp32 [T, D] dQ accumulator — the one
+    place the VMEM-scratch spec (and its non-TPU fallback) is defined."""
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+
+        return [pltpu.VMEM((t, d), jnp.float32)]
+    except ImportError:  # pragma: no cover — non-TPU pallas build
+        return [pl.MemorySpace.ANY((t, d), jnp.float32)]
+
+
 def _flash_bwd_bthd(q, k, v, do, lse, delta, *, block_q, block_k, causal, interpret):
     b, h, t, d = q.shape
     scale = d ** -0.5
@@ -324,12 +335,6 @@ def _flash_bwd_bthd(q, k, v, do, lse, delta, *, block_q, block_k, causal, interp
     kvspec = pl.BlockSpec((None, None, block_k, d), lambda bi, hi, j: (bi, hi, j, 0))
 
     if _bwd_use_fused(t, d):
-        try:
-            from jax.experimental.pallas import tpu as pltpu
-
-            scratch = [pltpu.VMEM((t, d), jnp.float32)]
-        except ImportError:
-            scratch = [pl.MemorySpace.ANY((t, d), jnp.float32)]  # pragma: no cover
         dk, dv, dq = pl.pallas_call(
             partial(
                 _dkvq_kernel, block_q=block_q, block_k=block_k, causal=causal, scale=scale
@@ -342,7 +347,7 @@ def _flash_bwd_bthd(q, k, v, do, lse, delta, *, block_q, block_k, causal, interp
                 jax.ShapeDtypeStruct(v.shape, v.dtype),
                 jax.ShapeDtypeStruct(q.shape, q.dtype),
             ],
-            scratch_shapes=scratch,
+            scratch_shapes=_dq_scratch(t, d),
             interpret=interpret,
         )(q, k, v, do, lse, delta)
         return dq, dk, dv
@@ -573,23 +578,47 @@ def _dq_kernel_offs(
     dq_ref[:] = dq.astype(dq_ref.dtype)
 
 
-def _dkv_kernel_offs(
-    offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, glse_ref, dk_ref, dv_ref,
-    *, block_q, block_k, scale,
+def _dkv_step_offs(
+    i, dk, dv, *, q_ref, do_ref, lse_ref, delta_ref, glse_ref, k, v, kj,
+    q_off, k_off, block_q, block_k, scale, dt, masked, dq_acc=None,
 ):
-    kj = pl.program_id(2)
-    t = q_ref.shape[0]
-    dt = q_ref.dtype
-    q_off, k_off = offs_ref[0], offs_ref[1]
-    k = k_ref[:]
-    v = v_ref[:]
+    """Offset-aware sibling of :func:`_dkv_step` (global-coordinate mask,
+    lse sentinel guard, lse-cotangent term), shared by the split and fused
+    offset backward kernels."""
+    q = q_ref[pl.ds(i * block_q, block_q), :]
+    do = do_ref[pl.ds(i * block_q, block_q), :]
+    lse = lse_ref[pl.ds(i, 1), :].reshape(block_q, 1)
+    delta = delta_ref[pl.ds(i, 1), :].reshape(block_q, 1)
+    glse = glse_ref[pl.ds(i, 1), :].reshape(block_q, 1)
+    s = scale * jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    if masked:
+        rows = q_off + i * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        cols = k_off + kj * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(rows >= cols, s, NEG_INF)
+    p = jnp.where(lse <= NEG_INF / 2, 0.0, jnp.exp(s - lse))
+    dv = dv + jax.lax.dot_general(
+        p.astype(dt), do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ds = (p * (dp - delta + glse)).astype(dt)
+    dk = dk + scale * jax.lax.dot_general(
+        ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    if dq_acc is not None:
+        dq_acc[pl.ds(i * block_q, block_q), :] += scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+    return dk, dv
 
-    dk = jnp.zeros((block_k, k.shape[-1]), jnp.float32)
-    dv = jnp.zeros((block_k, k.shape[-1]), jnp.float32)
-    nq = t // block_q
-    # first q block whose last global row reaches this k block's first col,
-    # and first q block whose FIRST row clears this k block's last col (all
-    # q blocks past that see the whole k block — no mask)
+
+def _offs_kv_bounds(kj, q_off, k_off, block_q, block_k, nq):
+    """(start, full): first q block whose last global row reaches this k
+    block's first col, and first q block whose FIRST row clears its last
+    col (q blocks past that see the whole k block — no mask)."""
     first_col = k_off + kj * block_k
     start = jnp.clip(lax.div(first_col - q_off, block_q), 0, nq)
     full = jnp.clip(
@@ -597,38 +626,79 @@ def _dkv_kernel_offs(
         start,
         nq,
     )
+    return start, full
+
+
+def _dkv_kernel_offs(
+    offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, glse_ref, dk_ref, dv_ref,
+    *, block_q, block_k, scale,
+):
+    kj = pl.program_id(2)
+    t = q_ref.shape[0]
+    q_off, k_off = offs_ref[0], offs_ref[1]
+    k = k_ref[:]
+    v = v_ref[:]
+
+    dk = jnp.zeros((block_k, k.shape[-1]), jnp.float32)
+    dv = jnp.zeros((block_k, k.shape[-1]), jnp.float32)
+    nq = t // block_q
+    start, full = _offs_kv_bounds(kj, q_off, k_off, block_q, block_k, nq)
 
     def body(i, carry, *, masked):
-        dk, dv = carry
-        q = q_ref[pl.ds(i * block_q, block_q), :]
-        do = do_ref[pl.ds(i * block_q, block_q), :]
-        lse = lse_ref[pl.ds(i, 1), :].reshape(block_q, 1)
-        delta = delta_ref[pl.ds(i, 1), :].reshape(block_q, 1)
-        glse = glse_ref[pl.ds(i, 1), :].reshape(block_q, 1)
-        s = scale * jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        return _dkv_step_offs(
+            i, *carry, q_ref=q_ref, do_ref=do_ref, lse_ref=lse_ref,
+            delta_ref=delta_ref, glse_ref=glse_ref, k=k, v=v, kj=kj,
+            q_off=q_off, k_off=k_off, block_q=block_q, block_k=block_k,
+            scale=scale, dt=q_ref.dtype, masked=masked,
         )
-        if masked:
-            rows = q_off + i * block_q + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            cols = k_off + kj * block_k + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.where(lse <= NEG_INF / 2, 0.0, jnp.exp(s - lse))
-        dv = dv + jax.lax.dot_general(
-            p.astype(dt), do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        ds = p * (dp - delta + glse)
-        dk = dk + scale * jax.lax.dot_general(
-            ds.astype(dt), q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        return dk, dv
 
     dk, dv = lax.fori_loop(start, full, partial(body, masked=True), (dk, dv))
     dk, dv = lax.fori_loop(full, nq, partial(body, masked=False), (dk, dv))
     dk_ref[:] = dk.astype(dk_ref.dtype)
     dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
+def _dkvq_kernel_offs(
+    offs_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, glse_ref,
+    dk_ref, dv_ref, dq_ref, dq_acc, *, block_q, block_k, scale,
+):
+    """Offset-aware single-pass backward (see :func:`_dkvq_kernel`): dQ
+    accumulates across the sequential k-block grid steps in a persistent
+    fp32 scratch, so S and dP are computed once per (i, j) pair. q blocks
+    invisible to every k block in this hop keep their zeroed scratch —
+    the correct zero cotangent for rows the hop never attends."""
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+    t = q_ref.shape[0]
+    q_off, k_off = offs_ref[0], offs_ref[1]
+    k = k_ref[:]
+    v = v_ref[:]
+
+    @pl.when(kj == 0)
+    def _zero():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    dk = jnp.zeros((block_k, k.shape[-1]), jnp.float32)
+    dv = jnp.zeros((block_k, k.shape[-1]), jnp.float32)
+    nq = t // block_q
+    start, full = _offs_kv_bounds(kj, q_off, k_off, block_q, block_k, nq)
+
+    def body(i, carry, *, masked):
+        return _dkv_step_offs(
+            i, *carry, q_ref=q_ref, do_ref=do_ref, lse_ref=lse_ref,
+            delta_ref=delta_ref, glse_ref=glse_ref, k=k, v=v, kj=kj,
+            q_off=q_off, k_off=k_off, block_q=block_q, block_k=block_k,
+            scale=scale, dt=q_ref.dtype, masked=masked, dq_acc=dq_acc,
+        )
+
+    dk, dv = lax.fori_loop(start, full, partial(body, masked=True), (dk, dv))
+    dk, dv = lax.fori_loop(full, nq, partial(body, masked=False), (dk, dv))
+    dk_ref[:] = dk.astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+    @pl.when(kj == nk - 1)
+    def _flush():
+        dq_ref[:] = dq_acc[...].astype(dq_ref.dtype)
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
@@ -688,25 +758,42 @@ def _fab_bwd(block_q, block_k, interpret, res, cts):
     # rows invisible in this hop (lse at the -1e30 sentinel) carry no lse
     # gradient; NEG_INF is finite, so compare, don't isfinite
     g_lse = jnp.where(lse <= NEG_INF / 2, 0.0, g_lse.astype(jnp.float32))
-    dq = pl.pallas_call(
-        partial(_dq_kernel_offs, block_q=block_q, block_k=block_k, scale=scale),
-        grid=(b, h, t // block_q),
-        in_specs=[_SMEM_SPEC, qspec, kvfull, kvfull, qspec, lse_full, lse_full, lse_full],
-        out_specs=qspec,
-        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
-        interpret=interpret,
-    )(offs, qt, kt, vt, do, lse, delta, g_lse)
-    dk, dv = pl.pallas_call(
-        partial(_dkv_kernel_offs, block_q=block_q, block_k=block_k, scale=scale),
-        grid=(b, h, t // block_k),
-        in_specs=[_SMEM_SPEC, qfull, kvspec, kvspec, qfull, lse_full, lse_full, lse_full],
-        out_specs=[kvspec, kvspec],
-        out_shape=[
-            jax.ShapeDtypeStruct(kt.shape, k.dtype),
-            jax.ShapeDtypeStruct(vt.shape, v.dtype),
-        ],
-        interpret=interpret,
-    )(offs, qt, kt, vt, do, lse, delta, g_lse)
+    if _bwd_use_fused(t, d):
+        dk, dv, dq = pl.pallas_call(
+            partial(_dkvq_kernel_offs, block_q=block_q, block_k=block_k, scale=scale),
+            grid=(b, h, t // block_k),
+            in_specs=[
+                _SMEM_SPEC, qfull, kvspec, kvspec, qfull, lse_full, lse_full, lse_full,
+            ],
+            out_specs=[kvspec, kvspec, qfull],
+            out_shape=[
+                jax.ShapeDtypeStruct(kt.shape, k.dtype),
+                jax.ShapeDtypeStruct(vt.shape, v.dtype),
+                jax.ShapeDtypeStruct(qt.shape, q.dtype),
+            ],
+            scratch_shapes=_dq_scratch(t, d),
+            interpret=interpret,
+        )(offs, qt, kt, vt, do, lse, delta, g_lse)
+    else:
+        dq = pl.pallas_call(
+            partial(_dq_kernel_offs, block_q=block_q, block_k=block_k, scale=scale),
+            grid=(b, h, t // block_q),
+            in_specs=[_SMEM_SPEC, qspec, kvfull, kvfull, qspec, lse_full, lse_full, lse_full],
+            out_specs=qspec,
+            out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+            interpret=interpret,
+        )(offs, qt, kt, vt, do, lse, delta, g_lse)
+        dk, dv = pl.pallas_call(
+            partial(_dkv_kernel_offs, block_q=block_q, block_k=block_k, scale=scale),
+            grid=(b, h, t // block_k),
+            in_specs=[_SMEM_SPEC, qfull, kvspec, kvspec, qfull, lse_full, lse_full, lse_full],
+            out_specs=[kvspec, kvspec],
+            out_shape=[
+                jax.ShapeDtypeStruct(kt.shape, k.dtype),
+                jax.ShapeDtypeStruct(vt.shape, v.dtype),
+            ],
+            interpret=interpret,
+        )(offs, qt, kt, vt, do, lse, delta, g_lse)
     dq, dk, dv = (x.transpose(0, 2, 1, 3) for x in (dq, dk, dv))
     zero = jnp.zeros((), jnp.float32)  # int offsets carry no gradient
     return dq, dk, dv, zero, zero

@@ -217,3 +217,36 @@ def test_fused_bwd_matches_dense_gradient():
     gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(gf, gd):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_fused_bwd_offs_matches_split():
+    """Offset-variant single-pass backward == split pair, including the
+    lse cotangent path the ring merge differentiates through."""
+    from p2pfl_tpu.ops import flash_attention as fa
+
+    q, k, v = _qkv(b=1, t=64, h=2, d=16)
+
+    def grads(q_off, k_off):
+        def f(q_, k_, v_):
+            o, lse = fa.flash_attention_block(
+                q_, k_, v_, jnp.int32(q_off), jnp.int32(k_off), 16, 32, True
+            )
+            # touch BOTH outputs so the lse cotangent is non-trivial
+            return jnp.sum(o * o) + jnp.sum(jnp.where(lse <= -5e29, 0.0, lse)) * 1e-3
+
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    old = fa.BWD_MODE
+    try:
+        for q_off, k_off in ((0, 0), (64, 0), (0, 64), (64, 64)):
+            fa.BWD_MODE = "split"
+            g_split = grads(q_off, k_off)
+            fa.BWD_MODE = "fused"
+            g_fused = grads(q_off, k_off)
+            for a, b in zip(g_fused, g_split):
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), atol=1e-5,
+                    err_msg=f"offsets ({q_off}, {k_off})",
+                )
+    finally:
+        fa.BWD_MODE = old
