@@ -935,3 +935,32 @@ def test_secagg_mask_lone_member_direct_no_shamir_crash():
     out = TrainStage._secagg_mask(_FakeNode(), u)
     assert out is not None
     np.testing.assert_array_equal(np.asarray(out.params["w"]), u.params["w"])
+
+
+def test_share_index_cap_scales_with_membership():
+    """ADVICE r4: the share/reveal index sanity cap derives from the live
+    train set (2x membership, 1024 floor) instead of a hard 1024 — a
+    >1025-member federation's high share indices must be stored, and junk
+    far beyond the cap still rejected."""
+    from p2pfl_tpu.commands.control import SecAggShareCommand
+    from p2pfl_tpu.node_state import NodeState
+
+    st = NodeState("me")
+    st.round = 1
+    st.experiment_name = "exp"
+    priv_o, pub_o = secagg.dh_keypair()
+    st.secagg_priv, _my_pub = secagg.dh_keypair()
+    st.secagg_pubs["owner"] = (pub_o, 5)
+    st.train_set = {f"n{i}" for i in range(1500)} | {"me", "owner"}
+
+    key = secagg.dh_share_key(priv_o, _my_pub, "exp")
+    cmd = SecAggShareCommand(st)
+    # share index 1400 (> the old hard 1024 cap, <= 2x membership): stored
+    ct = secagg.encrypt_share(12345, key, 1, "owner", "me").hex()
+    cmd.execute("owner", 1, "exp", "me", "1400", ct)
+    assert st.secagg_shares_held.get((1, "owner")) == (1400, 12345)
+    # far beyond the cap: rejected (not stored)
+    st.secagg_shares_held.clear()
+    cmd.execute("owner", 1, "exp", "me", str(2 * 1502 + 1), ct)
+    assert (1, "owner") not in st.secagg_shares_held
+
