@@ -1,9 +1,12 @@
 """Command-line interface.
 
 Reference: Typer app with ``experiment list`` / ``experiment run``
-(``p2pfl/cli.py:65-203``). argparse here (typer isn't in this image);
-same surface: examples are discovered from ``p2pfl_tpu/examples/`` and run
-in-process with their own argv.
+(``p2pfl/cli.py:65-203``), a Rich logo banner and Rich tables. argparse
+here (typer/rich aren't in this image); same surface — examples are
+discovered from ``p2pfl_tpu/examples/`` and run in-process with their own
+argv — with a dependency-free equivalent of the Rich UX: an ANSI banner
+and box-drawing tables on a UTF-8 interactive terminal; pipes and
+ASCII-only stdouts keep the plain machine-parseable two-column listing.
 """
 
 from __future__ import annotations
@@ -12,6 +15,56 @@ import argparse
 import importlib
 import pkgutil
 import sys
+
+_BANNER = r"""
+  ___ ___ ___ ___ _      _____ ___ _   _
+ | _ \_  ) _ \ __| |    |_   _| _ \ | | |
+ |  _// /|  _/ _|| |__    | | |  _/ |_| |
+ |_| /___|_| |_| |____|   |_| |_|  \___/
+"""
+
+
+def _fancy() -> bool:
+    """Decorate only for a UTF-8-capable interactive terminal — a pipe or
+    an ASCII-only stdout keeps the plain machine-parseable two-column form
+    (the pre-round-5 output)."""
+    if not sys.stdout.isatty():
+        return False
+    try:
+        "┌".encode(getattr(sys.stdout, "encoding", "") or "ascii")
+    except (UnicodeEncodeError, LookupError):
+        return False
+    return True
+
+
+def _color(s: str, code: str) -> str:
+    return f"\033[{code}m{s}\033[0m"
+
+
+def _banner() -> str:
+    return _color(_BANNER, "34") + _color(
+        "  peer-to-peer federated learning, TPU-native\n", "2"
+    )
+
+
+def _table(headers: list[str], rows: list[list[str]]) -> str:
+    """Box-drawing table (no ANSI — pure glyphs) — the in-image stand-in
+    for Rich's Table (reference ``cli.py:112-125``)."""
+    widths = [
+        max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+        for i, h in enumerate(headers)
+    ]
+
+    def line(left: str, mid: str, right: str, fill: str = "─") -> str:
+        return left + mid.join(fill * (w + 2) for w in widths) + right
+
+    def row(cells: list[str]) -> str:
+        return "│" + "│".join(f" {c:<{w}} " for w, c in zip(widths, cells)) + "│"
+
+    parts = [line("┌", "┬", "┐"), row(headers), line("├", "┼", "┤")]
+    parts += [row(r) for r in rows]
+    parts.append(line("└", "┴", "┘"))
+    return "\n".join(parts)
 
 
 def _discover() -> dict[str, str]:
@@ -48,8 +101,13 @@ def main(argv=None) -> int:
         return 0
     if args.command == "experiment":
         if args.action == "list":
-            for name, doc in sorted(_discover().items()):
-                print(f"{name:20s} {doc}")
+            entries = sorted(_discover().items())
+            if _fancy():
+                print(_banner())
+                print(_table(["experiment", "description"], [[n, d] for n, d in entries]))
+            else:
+                for name, doc in entries:
+                    print(f"{name:20s} {doc}")
             return 0
         if args.action == "run":
             examples = _discover()

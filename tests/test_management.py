@@ -13,6 +13,31 @@ def test_cli_experiment_list(capsys):
     assert main(["experiment", "list"]) == 0
     out = capsys.readouterr().out
     assert "mnist" in out and "spmd_mnist" in out
+    # piped/non-TTY stdout (pytest capture) keeps the plain parseable
+    # two-column form — no box glyphs, no ANSI
+    assert "┌" not in out and "\033[" not in out
+
+
+def test_cli_experiment_list_fancy_on_tty(capsys, monkeypatch):
+    """Reference-parity UX (Typer/Rich stand-in, reference cli.py:30-125):
+    banner + box-drawing table on an interactive UTF-8 terminal."""
+    import p2pfl_tpu.cli as cli
+
+    monkeypatch.setattr(cli, "_fancy", lambda: True)
+    assert cli.main(["experiment", "list"]) == 0
+    out = capsys.readouterr().out
+    assert "┌" in out and "│ experiment" in out and "└" in out
+    assert "mnist" in out
+
+
+def test_cli_table_renders_rows():
+    from p2pfl_tpu.cli import _table
+
+    t = _table(["a", "bb"], [["x", "y"], ["longer", "z"]])
+    lines = t.splitlines()
+    assert lines[0].startswith("┌") and lines[-1].startswith("└")
+    assert len({len(line) for line in lines}) == 1  # aligned columns
+    assert "longer" in t and "bb" in t
 
 
 def test_cli_unknown_experiment():
