@@ -1,0 +1,253 @@
+"""Gossip data-plane benchmark: encode-once payload cache + concurrent fan-out.
+
+Three measurements, all on the in-memory transport with the byte path forced
+(``Settings.MEMORY_WIRE_CODEC=True`` — payloads are really encoded, shipped
+and materialized, just without sockets):
+
+1. **Codec microbench** — ``encode_params``/``decode_params`` wall-clock per
+   payload for the MLP and transformer configs, per wire compression.
+2. **Encode accounting** — encode-pipeline invocations per node per round on
+   a federation run, plus the payload cache's hit/miss counters as exported
+   through ``logger.get_comm_metrics()``. Pre-overhaul behavior was one
+   encode per candidate per tick (O(neighbors × ticks)); with the cache it
+   is bounded by distinct payload contents per round — own model versions
+   (~2: post-fit contribution + post-aggregation diffusion) plus distinct
+   partial-aggregation contents.
+3. **Slow-peer round time** — end-to-end wall-clock of a federated round on
+   an 8-node federation with one peer whose receive path stalls, comparing
+   the pre-overhaul data plane (sequential sends, no cache, no send budget:
+   ``GOSSIP_SEND_WORKERS=1``, ``GOSSIP_PAYLOAD_CACHE=False``, huge
+   ``GOSSIP_SEND_TIMEOUT``) against the shipped defaults (4 send workers,
+   cache on, 0.5 s budget).
+
+``--smoke`` runs a shrunken federation and asserts the encode-once
+invariant (encodes per node-round bounded by distinct contents, cache hits
+present) — the CI guard that keeps the cache from silently regressing.
+
+usage: JAX_PLATFORMS=cpu python bench_gossip.py [--smoke] [--out BENCH_GOSSIP.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def _make_model(name: str, seed: int = 0):
+    from p2pfl_tpu.models import mlp
+    from p2pfl_tpu.models.transformer import TransformerConfig, tiny_transformer
+
+    if name == "mlp":
+        return mlp(seed=seed)
+    cfg = TransformerConfig(
+        vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=4,
+        ffn_hidden=128, lora_rank=0,
+    )
+    return tiny_transformer(seq_len=32, cfg=cfg, seed=seed)
+
+
+def bench_codec(repeats: int = 5) -> dict:
+    """encode/decode wall-clock per payload, per model config × compression."""
+    from p2pfl_tpu.learning.weights import decode_params, encode_params
+
+    out: dict = {}
+    for name in ("mlp", "transformer"):
+        model = _make_model(name)
+        params = {k: np.asarray(v) for k, v in _flatten(model.params).items()}
+        anchor = {k: v - 0.01 if v.dtype.kind == "f" else v for k, v in params.items()}
+        entry: dict = {"param_bytes": int(sum(v.nbytes for v in params.values()))}
+        for comp in ("none", "int8", "topk8"):
+            kw = {"compression": comp}
+            if comp == "topk8":
+                kw.update(anchor=anchor, anchor_tag="0:0")
+            payload = encode_params(params, **kw)  # warmup
+            t0 = time.perf_counter()
+            for _ in range(repeats):
+                payload = encode_params(params, **kw)
+            enc_ms = (time.perf_counter() - t0) / repeats * 1e3
+            dkw = {"anchor": anchor, "anchor_tag": "0:0"} if comp == "topk8" else {}
+            t0 = time.perf_counter()
+            for _ in range(repeats):
+                decode_params(payload, **dkw)
+            dec_ms = (time.perf_counter() - t0) / repeats * 1e3
+            entry[comp] = {
+                "payload_bytes": len(payload),
+                "encode_ms": round(enc_ms, 3),
+                "decode_ms": round(dec_ms, 3),
+            }
+        out[name] = entry
+    return out
+
+
+def _flatten(tree):
+    from p2pfl_tpu.learning.weights import _flatten_named
+
+    return _flatten_named(tree)
+
+
+def run_federation(
+    n_nodes: int,
+    rounds: int,
+    model_name: str = "mlp",
+    slow_peer_delay: float = 0.0,
+    workers: int = 4,
+    cache: bool = True,
+    send_timeout: float = 0.5,
+    train_set_size: int = 0,
+) -> dict:
+    """One timed federation run on the in-memory byte path.
+
+    Returns round wall-clock plus encode/cache/send accounting. epochs=0
+    keeps device compute out of the measurement — what remains IS the
+    gossip data plane (init push, partial gossip, diffusion).
+    """
+    from p2pfl_tpu.communication.memory import MemoryRegistry
+    from p2pfl_tpu.learning import weights as W
+    from p2pfl_tpu.learning.dataset import FederatedDataset
+    from p2pfl_tpu.learning.learner import JaxLearner
+    from p2pfl_tpu.management.logger import logger
+    from p2pfl_tpu.node import Node
+    from p2pfl_tpu.settings import Settings, set_test_settings
+    from p2pfl_tpu.utils import full_connection, wait_convergence, wait_to_finish
+
+    set_test_settings()
+    logger.set_level("ERROR")
+    Settings.MEMORY_WIRE_CODEC = True
+    Settings.GOSSIP_SEND_WORKERS = workers
+    Settings.GOSSIP_PAYLOAD_CACHE = cache
+    Settings.GOSSIP_SEND_TIMEOUT = send_timeout
+    if train_set_size:
+        # slow-peer configs elect EVERYONE so the stalled node is a
+        # train-set member being gossiped partials every tick — the
+        # worst case the fan-out is built for
+        Settings.TRAIN_SET_SIZE = train_set_size
+    MemoryRegistry.reset()
+    logger.reset_comm_metrics()
+
+    if model_name == "transformer":
+        full = FederatedDataset.synthetic_lm(
+            n_train=n_nodes * 32, n_test=32, seq_len=32, vocab_size=256
+        )
+    else:
+        full = FederatedDataset.synthetic_mnist(n_train=n_nodes * 64, n_test=64)
+    nodes = []
+    for i in range(n_nodes):
+        learner = JaxLearner(
+            _make_model(model_name, seed=i), full.partition(i, n_nodes), batch_size=16
+        )
+        nodes.append(Node(learner=learner))
+    try:
+        for node in nodes:
+            node.start()
+        for node in nodes:
+            full_connection(node, nodes)
+        wait_convergence(nodes, n_nodes - 1, only_direct=True, wait=15)
+
+        if slow_peer_delay > 0:
+            slow = nodes[-1]
+            orig = slow.protocol.handle_weights
+
+            def slow_handle(env):
+                time.sleep(slow_peer_delay)
+                return orig(env)
+
+            slow.protocol.handle_weights = slow_handle
+
+        encodes_before = W.encode_call_count()
+        t0 = time.perf_counter()
+        nodes[0].set_start_learning(rounds=rounds, epochs=0)
+        # with a stalled peer injected, the figure of merit is when the
+        # HEALTHY nodes close their rounds — the stalled peer is slow by
+        # construction (it pays its own sleeps) and catches up afterwards
+        wait_to_finish(nodes[:-1] if slow_peer_delay > 0 else nodes, timeout=300)
+        wall_s = time.perf_counter() - t0
+        encodes = W.encode_call_count() - encodes_before
+        comm = logger.get_comm_metrics()
+
+        def total(metric):
+            return int(sum(m.get(metric, 0) for m in comm.values()))
+
+        return {
+            "n_nodes": n_nodes,
+            "rounds": rounds,
+            "model": model_name,
+            "workers": workers,
+            "cache": cache,
+            "send_timeout_s": send_timeout,
+            "slow_peer_delay_s": slow_peer_delay,
+            "round_wall_s": round(wall_s / rounds, 3),
+            "total_wall_s": round(wall_s, 3),
+            "encode_calls": encodes,
+            "encode_calls_per_node_round": round(encodes / (n_nodes * rounds), 3),
+            "cache_hits": total("encode_cache_hit"),
+            "cache_misses": total("encode_cache_miss"),
+            "sends_ok": total("gossip_send_ok"),
+            "send_timeouts": total("gossip_send_timeout"),
+            "inflight_skips": total("gossip_send_inflight_skip"),
+        }
+    finally:
+        for node in nodes:
+            node.stop()
+        MemoryRegistry.reset()
+        Settings.MEMORY_WIRE_CODEC = False
+        Settings.GOSSIP_PAYLOAD_CACHE = True
+        Settings.GOSSIP_SEND_WORKERS = 4
+
+
+# distinct payload contents a node can produce in one epochs=0 round: the
+# init-model push, its (unfit) contribution, one combined partial, and the
+# post-aggregation diffusion — the encode-once ceiling asserted in CI
+MAX_ENCODES_PER_NODE_ROUND = 4.0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true", help="small run + invariant asserts (CI)")
+    ap.add_argument("--out", default="BENCH_GOSSIP.json")
+    args = ap.parse_args()
+
+    results: dict = {"smoke": bool(args.smoke)}
+
+    if args.smoke:
+        fed = run_federation(n_nodes=3, rounds=1)
+        results["federation"] = fed
+        assert fed["cache_hits"] >= 1, "payload cache never hit on the byte path"
+        assert fed["encode_calls_per_node_round"] <= MAX_ENCODES_PER_NODE_ROUND, (
+            f"encode-once regressed: {fed['encode_calls_per_node_round']} encodes "
+            f"per node-round (max {MAX_ENCODES_PER_NODE_ROUND}) — the cache is "
+            "not being reused across candidates/ticks"
+        )
+        print(json.dumps(results, indent=2))
+        print("SMOKE OK: encode-once invariant holds")
+        return 0
+
+    results["codec"] = bench_codec()
+    # warm the jit/codec caches so neither timed variant pays first-compile
+    run_federation(n_nodes=8, rounds=1)
+    results["sequential_nocache"] = run_federation(
+        n_nodes=8, rounds=1, slow_peer_delay=2.0, workers=1, cache=False,
+        send_timeout=60.0, train_set_size=8,
+    )
+    results["concurrent_cached"] = run_federation(
+        n_nodes=8, rounds=1, slow_peer_delay=2.0, workers=4, cache=True,
+        send_timeout=0.25, train_set_size=8,
+    )
+    results["transformer_federation"] = run_federation(
+        n_nodes=8, rounds=1, model_name="transformer"
+    )
+    seq, conc = results["sequential_nocache"], results["concurrent_cached"]
+    results["round_speedup_with_slow_peer"] = round(
+        seq["round_wall_s"] / max(conc["round_wall_s"], 1e-9), 2
+    )
+    with open(args.out, "w") as f:
+        json.dump(results, f, indent=2)
+    print(json.dumps(results, indent=2))
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

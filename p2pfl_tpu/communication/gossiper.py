@@ -13,6 +13,22 @@ Reference semantics (``p2pfl/communication/gossiper.py:31-243``):
     when there are no candidates, the early-stop predicate fires, or the
     observed status is unchanged for ``GOSSIP_EXIT_ON_X_EQUAL_ROUNDS`` ticks
     (convergence detector, reference 209-226).
+
+Concurrent fan-out (departure from the reference, which sends strictly
+sequentially on both planes): sends are dispatched through a bounded
+``ThreadPoolExecutor`` of ``Settings.GOSSIP_SEND_WORKERS`` threads with a
+per-batch wall-clock budget of ``Settings.GOSSIP_SEND_TIMEOUT``. A stalled
+peer therefore costs one worker slot, not the tick: the other candidates'
+payloads are already on the wire while it hangs, and the tick moves on once
+the budget expires. A send still in flight marks its neighbor busy — the
+next tick skips that neighbor instead of stacking a second worker behind the
+same stall — and results are collected in submission order so the caller's
+convergence accounting is deterministic. Payload construction (``model_fn``)
+stays on the calling thread: with the encode-once payload cache
+(``learning/weights.py``) it is a cheap lookup after the first candidate,
+and keeping it serial means aggregator/learner state is never read
+concurrently. Send outcomes are counted into the logger's communication
+metrics (``gossip_send_ok`` / ``_fail`` / ``_timeout`` / ``_inflight_skip``).
 """
 
 from __future__ import annotations
@@ -20,6 +36,8 @@ from __future__ import annotations
 import threading
 import time
 from collections import OrderedDict, deque
+from concurrent.futures import CancelledError, Future, ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FuturesTimeout  # builtin alias only on 3.11+
 from typing import Callable, Optional
 
 from p2pfl_tpu.communication.message import Message
@@ -37,11 +55,25 @@ class Gossiper:
         self._processed_lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._pool: Optional[ThreadPoolExecutor] = None
+        # neighbor -> the specific send task that outlived its budget and is
+        # STILL running — guarded by _stalled_lock, cleared when THAT task
+        # completes (a different plane's send to the same neighbor finishing
+        # must not unmark a still-stuck one). Only marked neighbors are
+        # skipped. NOTE: ordering is guaranteed per neighbor only WITHIN a
+        # dispatch batch; cross-batch sends to one neighbor may interleave
+        # (receivers' dedup/overlap rejection absorbs reordering).
+        self._stalled: dict[str, Future] = {}
+        self._stalled_lock = threading.Lock()
 
     # ---- lifecycle ----
 
     def start(self) -> None:
         self._stop.clear()
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(1, Settings.GOSSIP_SEND_WORKERS),
+            thread_name_prefix=f"gossip-send-{self.self_addr}",
+        )
         self._thread = threading.Thread(
             target=self._run, name=f"gossiper-{self.self_addr}", daemon=True
         )
@@ -54,6 +86,10 @@ class Gossiper:
         if self._thread is not None:
             self._thread.join(timeout=2)
             self._thread = None
+        if self._pool is not None:
+            # don't wait: a stalled peer's send may never return
+            self._pool.shutdown(wait=False, cancel_futures=True)
+            self._pool = None
 
     # ---- dedup ring ----
 
@@ -66,6 +102,137 @@ class Gossiper:
             while len(self._processed) > Settings.AMOUNT_LAST_MESSAGES_SAVED:
                 self._processed.popitem(last=False)
             return True
+
+    # ---- concurrent send dispatch (both planes) ----
+
+    def _dispatch_sends(
+        self, sends: list[tuple[str, object]], create_connection: bool = False
+    ) -> tuple[list[Optional[bool]], list[tuple[str, object]]]:
+        """Fan ``(neighbor, envelope)`` sends out across the worker pool.
+
+        Sends are grouped per neighbor — one worker task per batch per
+        neighbor runs that neighbor's envelopes in order (distinct
+        neighbors proceed concurrently; ordering across batches is NOT
+        guaranteed). Returns ``(results, skipped)``: per-send outcomes in
+        submission order — True/False from the transport, or None when the
+        send outlived its ``GOSSIP_SEND_TIMEOUT`` budget (it keeps running
+        on its worker; the neighbor is marked stalled until that exact
+        task finishes) — plus the sends that were never submitted because
+        their neighbor was already stalled (the message plane requeues
+        those; the model plane rebuilds next tick anyway).
+        """
+        pool = self._pool
+        if pool is None or Settings.GOSSIP_SEND_WORKERS <= 1:
+            # not started (unit tests poking the loop directly), or
+            # explicitly sequential: send inline on the calling thread —
+            # the pre-overhaul behavior, each plane its own serial lane
+            out: list[Optional[bool]] = []
+            for nei, env in sends:
+                ok = self._send(nei, env, create_connection=create_connection)
+                logger.log_comm_metric(
+                    self.self_addr, "gossip_send_ok" if ok else "gossip_send_fail"
+                )
+                out.append(ok)
+            return out, []
+        timeout = Settings.GOSSIP_SEND_TIMEOUT
+        workers = max(1, Settings.GOSSIP_SEND_WORKERS)
+        results: list[Optional[bool]] = [None] * len(sends)
+        grouped: "OrderedDict[str, list[tuple[int, object]]]" = OrderedDict()
+        for i, (nei, env) in enumerate(sends):
+            grouped.setdefault(nei, []).append((i, env))
+
+        # per-task start times: the per-send budget counts from when the
+        # task actually STARTS on a worker — a healthy send queued behind a
+        # full pool is not "stalled", it just hasn't run yet
+        starts: dict[str, float] = {}
+
+        def send_all(nei: str, envs: list[object]) -> list[bool]:
+            starts[nei] = time.monotonic()
+            return [self._send(nei, env, create_connection=create_connection) for env in envs]
+
+        skipped: list[tuple[str, object]] = []
+        futures: list[tuple[str, list[int], Future]] = []
+        for nei, items in grouped.items():
+            with self._stalled_lock:
+                if nei in self._stalled:
+                    # a previous batch's send to this peer is stuck past its
+                    # budget — submitting more would strand a second worker
+                    # behind the same stall
+                    logger.log_comm_metric(
+                        self.self_addr, "gossip_send_inflight_skip", len(items)
+                    )
+                    for i, env in items:
+                        results[i] = False
+                        skipped.append((nei, env))
+                    continue
+            try:
+                fut = pool.submit(send_all, nei, [env for _i, env in items])
+            except RuntimeError:  # stop() shut the pool down under us
+                for i, _env in items:
+                    results[i] = False
+                continue
+
+            def _done(_fut, nei=nei):
+                with self._stalled_lock:
+                    # only the task that set the mark may clear it — another
+                    # plane's send to the same neighbor finishing must not
+                    # unmark a still-stuck one
+                    if self._stalled.get(nei) is _fut:
+                        del self._stalled[nei]
+
+            fut.add_done_callback(_done)
+            futures.append((nei, [i for i, _env in items], fut))
+        # everything-is-stuck backstop: enough budget for every task to get
+        # a worker slot and its own timeout, then stop waiting regardless
+        hard_deadline = time.monotonic() + timeout * (1 + len(futures) / workers)
+        for nei, idxs, fut in futures:
+            timed_out = False
+            while True:
+                now = time.monotonic()
+                started = starts.get(nei)
+                if not fut.done():  # a finished task is never "timed out"
+                    if started is not None and now - started >= timeout:
+                        timed_out = True  # genuinely running too long
+                        break
+                    if now >= hard_deadline:
+                        timed_out = True
+                        break
+                # queued tasks get short polls; running ones their remainder
+                wait = 0.05 if started is None else max(0.0, started + timeout - now)
+                try:
+                    oks = fut.result(timeout=max(0.0, min(wait, hard_deadline - now)))
+                except (FuturesTimeout, TimeoutError):
+                    continue
+                except CancelledError:  # stop() cancelled the queued send
+                    oks = None
+                except Exception as exc:  # noqa: BLE001 — transport raised on the worker
+                    oks = None
+                    logger.debug(self.self_addr, f"Send to {nei} raised {exc!r}")
+                if oks is None:
+                    for i in idxs:
+                        results[i] = False
+                    logger.log_comm_metric(self.self_addr, "gossip_send_fail", len(idxs))
+                else:
+                    for i, ok in zip(idxs, oks):
+                        results[i] = bool(ok)
+                        logger.log_comm_metric(
+                            self.self_addr, "gossip_send_ok" if ok else "gossip_send_fail"
+                        )
+                break
+            if timed_out:
+                with self._stalled_lock:
+                    # mark only tasks that actually STARTED and overran: a
+                    # task still queued at the hard deadline is a healthy
+                    # neighbor behind a congested pool, not a stall
+                    if not fut.done() and starts.get(nei) is not None:
+                        self._stalled[nei] = fut
+                logger.log_comm_metric(self.self_addr, "gossip_send_timeout")
+                logger.debug(
+                    self.self_addr,
+                    f"Send to {nei} exceeded GOSSIP_SEND_TIMEOUT "
+                    f"({timeout}s) — continuing without it",
+                )
+        return results, skipped
 
     # ---- message plane ----
 
@@ -82,20 +249,25 @@ class Gossiper:
                 if not self._queue:
                     self._queue_cv.wait(timeout=Settings.GOSSIP_PERIOD)
                     continue
-                batch: list[tuple[Message, str]] = []
+                batch: list[tuple[str, Message]] = []
                 budget = Settings.GOSSIP_MESSAGES_PER_PERIOD
                 while self._queue and budget > 0:
                     msg, neis = self._queue.popleft()
                     take, rest = neis[:budget], neis[budget:]
-                    batch.extend((msg, n) for n in take)
+                    batch.extend((n, msg) for n in take)
                     budget -= len(take)
                     if rest:
                         self._queue.appendleft((msg, rest))
                         break
-            for msg, nei in batch:
-                if self._stop.is_set():
-                    return
-                self._send(nei, msg)
+            if self._stop.is_set():
+                return
+            _results, skipped = self._dispatch_sends(batch)
+            for nei, msg in skipped:
+                # control messages must not be lost to a transient stall —
+                # requeue for the stalled neighbor (the pre-overhaul serial
+                # plane eventually delivered them); delivery resumes once
+                # the stuck task completes or the neighbor is evicted
+                self.add_message(msg, [nei])
             time.sleep(Settings.GOSSIP_PERIOD)
 
     # ---- model plane ----
@@ -132,9 +304,13 @@ class Gossiper:
             else:
                 equal_ticks = 0
                 last_status = status
+            # build payloads serially (cache-hit cheap), fan the sends out
+            sends: list[tuple[str, object]] = []
             for nei in random_subset(candidates, Settings.GOSSIP_MODELS_PER_ROUND):
                 payload = model_fn(nei)
                 if payload is None:
                     continue
-                self._send(nei, payload, create_connection=create_connection)
+                sends.append((nei, payload))
+            if sends:
+                self._dispatch_sends(sends, create_connection=create_connection)
             time.sleep(period)

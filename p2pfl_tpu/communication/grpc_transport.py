@@ -75,6 +75,9 @@ def decode_message(data: bytes) -> Message:
 
 
 def encode_weights(env: WeightsEnvelope) -> bytes:
+    # update.encode() is served by the encode-once payload cache while the
+    # sender's model version is unchanged (learning/weights.py) — only this
+    # small envelope header is built per send
     header = json.dumps(
         {
             "src": env.source,
@@ -85,7 +88,7 @@ def encode_weights(env: WeightsEnvelope) -> bytes:
             "id": env.msg_id,
         }
     ).encode()
-    return len(header).to_bytes(4, "little") + header + env.update.encode()
+    return b"".join((len(header).to_bytes(4, "little"), header, env.update.encode()))
 
 
 def decode_weights(data: bytes) -> WeightsEnvelope:

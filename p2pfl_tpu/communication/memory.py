@@ -8,6 +8,11 @@ reference:
 - weights are passed **by reference** as a live :class:`ModelUpdate`, so a
   simulated federation never serializes: pytrees stay device-resident
   (the reference memory transport still moves pickled bytes);
+  ``Settings.MEMORY_WIRE_CODEC=True`` opts back into the byte path — the
+  payload is encoded on send and materialized by the receiver's learner,
+  exactly like a network transport — so the wire codec and the encode-once
+  payload cache are testable and benchable without sockets
+  (``bench_gossip.py``);
 - delivery goes through the same :meth:`CommunicationProtocol.handle_message`
   / :meth:`handle_weights` dispatch as every other transport, so TTL, dedup
   and command semantics are tested identically.
@@ -104,6 +109,21 @@ class InMemoryProtocol(CommunicationProtocol):
             return False
         try:
             if isinstance(env, WeightsEnvelope):
+                from p2pfl_tpu.settings import Settings
+
+                if Settings.MEMORY_WIRE_CODEC and env.update.params is not None:
+                    # byte-path simulation: ship encoded bytes (hitting the
+                    # payload cache like a network transport would) and let
+                    # the receiver materialize against its own learner
+                    from p2pfl_tpu.learning.weights import ModelUpdate
+
+                    wire = ModelUpdate(
+                        params=None,
+                        contributors=list(env.update.contributors),
+                        num_samples=env.update.num_samples,
+                        encoded=env.update.encode(),
+                    )
+                    env = WeightsEnvelope(env.source, env.round, env.cmd, wire, env.msg_id)
                 return peer.handle_weights(env).ok
             if isinstance(env, Message):
                 return peer.handle_message(env).ok

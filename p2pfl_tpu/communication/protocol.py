@@ -87,6 +87,10 @@ class CommunicationProtocol(ABC):
     def build_weights(
         self, cmd: str, round: int, update: ModelUpdate
     ) -> WeightsEnvelope:
+        # the round completes the payload-cache key (learning/weights.py):
+        # byte transports then reuse the encode across candidates and ticks
+        # for as long as the learner's model version is unchanged
+        update.cache_round = round
         return WeightsEnvelope(self._address, round, cmd, update)
 
     # ---- sending ----

@@ -55,6 +55,15 @@ class Aggregator:
         self._train_set: list[str] = []
         self._waiting: bool = False
         self._models: dict[frozenset, ModelUpdate] = {}
+        # gossip ships the same partial to several peers per tick: memoize
+        # the combined update per exact set of source groups, so the
+        # (stateless) aggregate — and, downstream, its wire encode via the
+        # returned instance's byte cache — runs once, not once per
+        # candidate. Invalidated whenever the collected model set changes;
+        # the generation counter keeps an aggregate computed against a
+        # superseded model set from being inserted after an invalidation.
+        self._partial_memo: dict[frozenset, ModelUpdate] = {}
+        self._memo_gen = 0
 
     # ---- round lifecycle ----
 
@@ -65,6 +74,8 @@ class Aggregator:
             self._train_set = list(nodes)
             self._waiting = False
             self._models = {}
+            self._partial_memo = {}
+            self._memo_gen += 1
             self._complete.clear()
 
     def set_waiting_aggregated_model(self, nodes: list[str]) -> None:
@@ -76,6 +87,8 @@ class Aggregator:
             self._train_set = list(nodes)
             self._waiting = True
             self._models = {}
+            self._partial_memo = {}
+            self._memo_gen += 1
             self._complete.clear()
 
     def clear(self) -> None:
@@ -83,6 +96,8 @@ class Aggregator:
             self._train_set = []
             self._waiting = False
             self._models = {}
+            self._partial_memo = {}
+            self._memo_gen += 1
             self._complete.set()
 
     def reset_experiment(self) -> None:
@@ -133,6 +148,8 @@ class Aggregator:
                     logger.debug(self.node_name, "Rejecting model: already received while waiting")
                     return []
                 self._models = {contributors: update}
+                self._partial_memo = {}
+                self._memo_gen += 1
                 self._complete.set()
                 return list(update.contributors)
 
@@ -160,6 +177,8 @@ class Aggregator:
             if contributors == train:
                 # full-coverage update replaces everything (reference 156-168)
                 self._models = {contributors: update}
+                self._partial_memo = {}
+                self._memo_gen += 1
                 self._complete.set()
                 return sorted(train)
 
@@ -172,6 +191,8 @@ class Aggregator:
                 return []
 
             self._models[contributors] = update
+            self._partial_memo = {}
+            self._memo_gen += 1
             covered |= contributors
             if covered == train:
                 self._complete.set()
@@ -251,7 +272,7 @@ class Aggregator:
             return todo[0]
         if not self.SUPPORTS_PARTIALS:
             return None
-        return self._inherit_anchor(self.aggregate(todo), todo)
+        return self._memoized_aggregate(todo)
 
     def get_models_to_send(self, except_nodes: list[str]) -> list[ModelUpdate]:
         """Payloads to gossip to a peer that already covers ``except_nodes``.
@@ -264,8 +285,29 @@ class Aggregator:
         if not todo:
             return []
         if self.SUPPORTS_PARTIALS and len(todo) > 1:
-            return [self._inherit_anchor(self.aggregate(todo), todo)]
+            return [self._memoized_aggregate(todo)]
         return todo
+
+    def _memoized_aggregate(self, todo: list[ModelUpdate]) -> ModelUpdate:
+        """One combined update per distinct set of source groups.
+
+        Only reached from the partial-gossip getters, whose strategies are
+        stateless partial-supporting ones (``SUPPORTS_PARTIALS=False``
+        families never get here), so re-using the combined result is pure
+        memoization — and returning the SAME instance lets its encoded
+        bytes be reused across every candidate it is sent to.
+        """
+        memo_key = frozenset(frozenset(m.contributors) for m in todo)
+        with self._lock:
+            hit = self._partial_memo.get(memo_key)
+            gen = self._memo_gen
+        if hit is not None:
+            return hit
+        result = self._inherit_anchor(self.aggregate(todo), todo)
+        with self._lock:
+            if self._memo_gen == gen:  # collected set unchanged since read
+                self._partial_memo[memo_key] = result
+        return result
 
     def _models_not_covered(self, except_nodes: list[str]) -> list[ModelUpdate]:
         skip = set(except_nodes)

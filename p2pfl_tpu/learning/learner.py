@@ -69,12 +69,42 @@ class NodeLearner(ABC):
     def set_addr(self, addr: str) -> None:
         self.addr = addr
 
+    @property
+    def model_version(self) -> int:
+        """Monotone counter identifying the current parameter content.
+
+        Bumped by :meth:`bump_model_version` on every ``set_parameters`` /
+        ``fit`` (and by anything that mutates the error-feedback residual
+        outside :func:`~p2pfl_tpu.learning.weights.encode_params`). The
+        payload cache keys encoded gossip bytes on it — see the
+        ``learning/weights.py`` module docstring.
+        """
+        return getattr(self, "_model_version", 0)
+
+    def bump_model_version(self) -> None:
+        self._model_version = self.model_version + 1
+
+    def payload_cache(self):
+        """The learner's shared encode-once cache (created on first use)."""
+        from p2pfl_tpu.learning.weights import PayloadCache
+
+        cache = getattr(self, "_payload_cache", None)
+        if cache is None:
+            cache = PayloadCache(owner=self.addr)
+            self._payload_cache = cache
+        cache.owner = self.addr  # addr may be set after first use
+        return cache
+
     def get_model_update(self) -> ModelUpdate:
         update = ModelUpdate(self.get_parameters(), [self.addr], self.get_num_samples())
         anchor = getattr(self, "_wire_anchor", None)
         if anchor is not None:
             update.anchor = anchor
             update.anchor_tag = getattr(self, "_wire_anchor_tag", None)
+        # encode-once: every update handed out shares the learner's payload
+        # cache, keyed on the version of the params it was built from
+        update.payload_cache = self.payload_cache()
+        update.cache_version = self.model_version
         return update
 
     def set_wire_anchor(self, params, tag: str) -> None:
@@ -98,7 +128,11 @@ class NodeLearner(ABC):
         """The node's error-feedback residual ({path: dropped delta mass}).
 
         Attached by TrainStage to the node's OWN contribution only — it
-        must accumulate exactly one encode per round.
+        must accumulate exactly one encode per round (the payload cache
+        enforces this: repeat sends of the contribution hit the cached
+        bytes instead of re-folding). Code that mutates the returned dict
+        directly must call :meth:`bump_model_version` so cached payloads
+        built from the old residual are never replayed.
         """
         if not hasattr(self, "_ef_residual"):
             self._ef_residual = {}
@@ -257,6 +291,7 @@ class JaxLearner(NodeLearner):
 
             raise ModelNotMatchingError("incoming params do not match model structure")
         self.params = params
+        self.bump_model_version()
         if not self.keep_opt_state:
             # reference behavior: a fresh Trainer (and optimizer) per round
             # (lightning_learner.py:180-198). keep_opt_state=True carries the
@@ -276,6 +311,7 @@ class JaxLearner(NodeLearner):
         self._interrupt.clear()
         if self.epochs == 0:
             return  # test mode, like the reference's epochs=0 CI runs
+        self.bump_model_version()
         # round's global model (FedProx anchor — used by both DP and plain paths)
         anchor = self.params if self.prox_mu > 0.0 else None
         for _ in range(self.epochs):
@@ -330,6 +366,7 @@ class DummyLearner(NodeLearner):
 
             raise ModelNotMatchingError("structure mismatch")
         self.params = params
+        self.bump_model_version()
 
     def get_parameters(self):
         return self.params
@@ -339,6 +376,7 @@ class DummyLearner(NodeLearner):
 
     def fit(self):
         self.params = jax.tree.map(lambda x: x + 1.0, self.params)
+        self.bump_model_version()
 
     def interrupt_fit(self):
         pass

@@ -13,13 +13,37 @@ and dtypes, followed by raw little-endian buffers. This gives
 - native bfloat16 support via ml_dtypes.
 
 On transports that stay in-process (memory, mesh-collective) the pytree is
-passed by reference and never hits this codec — weights stay device-resident.
+passed by reference and never hits this codec — weights stay device-resident
+(``Settings.MEMORY_WIRE_CODEC`` opts the memory transport into the byte path
+for benching/testing the codec without sockets).
+
+Encode-once, send-many
+----------------------
+Gossip pushes the SAME model to many peers over many ticks, so the encode
+pipeline (flatten → quantize → CRC32C → frame) must run once per *model
+version*, not once per send. :class:`PayloadCache` is the content-addressed
+store behind that: the learner attaches it (plus its monotone model-version
+counter) to every :meth:`ModelUpdate.encode`-able update it hands out, and
+``encode()`` keys the bytes on
+
+``(model version, round, wire compression, anchor_tag, error-feedback?)``
+
+The version bumps on ``set_parameters`` / ``fit`` / external residual
+mutation, so a stale encode can never be replayed; ``anchor_tag`` is in the
+key because topk8 bytes are deltas against a specific round's anchor — the
+same params delta-coded against a different anchor are different bytes. The
+error-feedback flag isolates the one encode per round that folds (and
+mutates) the residual store from residual-free encodes of the same version:
+a cache hit on the ``ef`` entry is exactly the "residual folded once per
+round" contract (Seide et al. 2014) — repeat sends reuse the bytes instead
+of double-folding.
 """
 
 from __future__ import annotations
 
 import json
 import struct
+import threading
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
@@ -29,6 +53,57 @@ import numpy as np
 from p2pfl_tpu.exceptions import AnchorMismatchError, DecodingParamsError, ModelNotMatchingError
 
 Pytree = Any
+
+# process-wide encode accounting (bench_gossip reads this): every real run
+# of the encode pipeline counts, cache hits don't
+_encode_lock = threading.Lock()
+_encode_calls = 0
+
+
+def encode_call_count() -> int:
+    """Total :func:`encode_params` invocations in this process."""
+    with _encode_lock:
+        return _encode_calls
+
+
+class PayloadCache:
+    """Content-addressed cache of encoded weight payloads (encode-once).
+
+    A small FIFO-bounded map — keys are monotone (the model version only
+    grows), so old entries die naturally; the bound only guards against a
+    pathological interleave. Hit/miss counters feed the logger's
+    communication metrics (``logger.get_comm_metrics``) so the cache's
+    effect is observable per node.
+    """
+
+    MAX_ENTRIES = 4
+
+    def __init__(self, owner: str = "") -> None:
+        self.owner = owner
+        self._lock = threading.Lock()
+        self._entries: "dict[tuple, bytes]" = {}
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key: tuple) -> Optional[bytes]:
+        from p2pfl_tpu.management.logger import logger
+
+        with self._lock:
+            cached = self._entries.get(key)
+            if cached is not None:
+                self.hits += 1
+            else:
+                self.misses += 1
+        logger.log_comm_metric(
+            self.owner, "encode_cache_hit" if cached is not None else "encode_cache_miss"
+        )
+        return cached
+
+    def put(self, key: tuple, payload: bytes) -> None:
+        with self._lock:
+            self._entries[key] = payload
+            while len(self._entries) > self.MAX_ENTRIES:
+                self._entries.pop(next(iter(self._entries)))
 
 _MAGIC = b"P2TW"  # p2pfl-tpu weights
 _VERSION = 1
@@ -109,6 +184,10 @@ def encode_params(
     """
     from p2pfl_tpu import native
 
+    global _encode_calls
+    with _encode_lock:
+        _encode_calls += 1
+
     if compression is None:
         from p2pfl_tpu.settings import Settings
 
@@ -149,31 +228,40 @@ def encode_params(
                 sent = np.zeros_like(delta)
                 sent[idx] = native.dequantize(q, scale)
                 residual[key] = delta - sent
-            buf = idx.tobytes() + q.tobytes()
+            # two pieces, no concat copy: CRC chains across them and the
+            # framing loop below writes them back to back
+            bufs = (idx.tobytes(), q.tobytes())
             entry["enc"] = "tk8"
             entry["scale"] = scale
             entry["nnz"] = int(k)
         elif compression in ("int8", "topk8") and arr.dtype.kind == "f":
             q, scale = native.quantize(np.asarray(arr, dtype=np.float32))
-            buf = q.tobytes()
+            bufs = (q.tobytes(),)
             entry["enc"] = "i8"
             entry["scale"] = scale
         else:
-            buf = np.ascontiguousarray(arr).tobytes()
-        entry["n"] = len(buf)
-        crc = native.crc32c(buf, crc)
+            bufs = (np.ascontiguousarray(arr).tobytes(),)
+        entry["n"] = sum(len(b) for b in bufs)
+        for b in bufs:
+            crc = native.crc32c(b, crc)
+            buffers.append(b)
         entries.append(entry)
-        buffers.append(buf)
     head = {"v": _VERSION, "t": entries, "crc": crc}
     if any(e.get("enc") == "tk8" for e in entries):
         head["anchor_tag"] = anchor_tag if anchor_tag is not None else ""
     header = json.dumps(head).encode("utf-8")
-    out = bytearray()
-    out += _MAGIC
-    out += struct.pack("<I", len(header))
-    out += header
-    for buf in buffers:
-        out += buf
+    # single preallocated frame: sizes are all known here, so the payload is
+    # written exactly once instead of growing a bytearray per tensor
+    total = 8 + len(header) + sum(len(b) for b in buffers)
+    out = bytearray(total)
+    out[0:4] = _MAGIC
+    struct.pack_into("<I", out, 4, len(header))
+    off = 8
+    out[off : off + len(header)] = header
+    off += len(header)
+    for b in buffers:
+        out[off : off + len(b)] = b
+        off += len(b)
     return bytes(out)
 
 
@@ -192,10 +280,13 @@ def decode_params(
     that divergence is part of the codec's loss budget.
     """
     try:
-        if payload[:4] != _MAGIC:
+        # memoryview slicing: header parse + per-tensor CRC walk the frame
+        # without copying tensor bytes (np.frombuffer below is zero-copy too)
+        mv = memoryview(payload)
+        if bytes(mv[:4]) != _MAGIC:
             raise DecodingParamsError("bad magic — not a p2pfl_tpu weights payload")
-        (hlen,) = struct.unpack("<I", payload[4:8])
-        header = json.loads(payload[8 : 8 + hlen].decode("utf-8"))
+        (hlen,) = struct.unpack("<I", mv[4:8])
+        header = json.loads(bytes(mv[8 : 8 + hlen]).decode("utf-8"))
         if header["v"] != _VERSION:
             raise DecodingParamsError(f"unsupported weights version {header['v']}")
         from p2pfl_tpu import native
@@ -231,7 +322,7 @@ def decode_params(
                 raise DecodingParamsError(f"inconsistent header for {e['k']}: n={e['n']} vs shape {e['shape']}")
             if off + e["n"] > len(payload):
                 raise DecodingParamsError(f"truncated payload at {e['k']}")
-            crc = native.crc32c(payload[off : off + e["n"]], crc)
+            crc = native.crc32c(mv[off : off + e["n"]], crc)
             if e.get("enc") == "tk8":
                 nnz = int(e["nnz"])
                 if anchor_flat is None or e["k"] not in anchor_flat:
@@ -326,15 +417,54 @@ class ModelUpdate:
     #: aggregate-encode error) so dropped delta coordinates re-enter the
     #: next round
     ef_residual: Optional[dict] = None
+    #: encode-once plumbing (module docstring) — the learner's shared
+    #: :class:`PayloadCache` plus its model-version counter at the time
+    #: this update was handed out; ``cache_round`` is stamped by
+    #: ``protocol.build_weights``. None ⇒ encode() bypasses the cache.
+    #: Never serialized.
+    payload_cache: Optional["PayloadCache"] = None
+    cache_version: Optional[int] = None
+    cache_round: Optional[int] = None
+    #: serializes encode(): the concurrent send fan-out may encode the same
+    #: instance from several worker threads, and an error-feedback encode
+    #: mutates the residual store — exactly once, under this lock
+    _encode_lock: threading.Lock = field(default_factory=threading.Lock, repr=False, compare=False)
 
     def encode(self) -> bytes:
-        if self.encoded is None:
-            self.encoded = encode_params(
-                self.params,
-                anchor=self.anchor,
-                anchor_tag=self.anchor_tag,
-                residual=self.ef_residual,
+        with self._encode_lock:
+            return self._encode_locked()
+
+    def _encode_locked(self) -> bytes:
+        if self.encoded is not None:
+            return self.encoded
+        from p2pfl_tpu.settings import Settings
+
+        cache = self.payload_cache
+        key = None
+        if (
+            cache is not None
+            and self.cache_version is not None
+            and Settings.GOSSIP_PAYLOAD_CACHE
+        ):
+            key = (
+                self.cache_version,
+                self.cache_round,
+                Settings.WIRE_COMPRESSION,
+                self.anchor_tag,
+                self.ef_residual is not None,
             )
+            cached = cache.get(key)
+            if cached is not None:
+                self.encoded = cached
+                return cached
+        self.encoded = encode_params(
+            self.params,
+            anchor=self.anchor,
+            anchor_tag=self.anchor_tag,
+            residual=self.ef_residual,
+        )
+        if key is not None:
+            cache.put(key, self.encoded)
         return self.encoded
 
     @staticmethod

@@ -86,6 +86,12 @@ class P2pflLogger:
         self._file_handler: Optional[logging.Handler] = None
         self.local_metrics = LocalMetricStorage()
         self.global_metrics = GlobalMetricStorage()
+        # communication-plane counters (gossip data plane: payload-cache
+        # hits/misses, send outcomes/timeouts) — plain accumulators keyed
+        # (node, metric), incremented from gossip worker threads, so they
+        # need no experiment context unlike the two metric stores above
+        self._comm_metrics: Dict[str, Dict[str, float]] = {}
+        self._comm_lock = threading.Lock()
         # addr -> (node_state, simulation_flag)
         self._nodes: Dict[str, Tuple[Any, bool]] = {}
         self._nodes_lock = threading.Lock()
@@ -191,6 +197,26 @@ class P2pflLogger:
 
     def get_global_logs(self):
         return self.global_metrics.get_all_logs()
+
+    # ---- communication metrics (gossip data plane observability) ----
+
+    def log_comm_metric(self, node: str, metric: str, value: float = 1.0) -> None:
+        """Accumulate a communication counter (thread-safe, no experiment
+        context needed — callable from gossip/send worker threads)."""
+        with self._comm_lock:
+            per_node = self._comm_metrics.setdefault(node, {})
+            per_node[metric] = per_node.get(metric, 0.0) + value
+
+    def get_comm_metrics(self, node: Optional[str] = None) -> Dict:
+        """Counter snapshot: one node's ``{metric: value}``, or all nodes'."""
+        with self._comm_lock:
+            if node is not None:
+                return dict(self._comm_metrics.get(node, {}))
+            return {n: dict(d) for n, d in self._comm_metrics.items()}
+
+    def reset_comm_metrics(self) -> None:
+        with self._comm_lock:
+            self._comm_metrics.clear()
 
     # ---- node registry (reference logger.py:491-543) ----
 

@@ -111,8 +111,10 @@ def plot_history(history: list, out_path: str, title: str = "federation") -> Opt
         except (TypeError, ValueError):
             return None
 
+    # union of numeric keys across ALL entries — metrics that first appear
+    # mid-run (e.g. test_acc logged from round 2) still get a curve
     keys = sorted(
-        k for k in history[0] if k != "round" and _scalar(history[0][k]) is not None
+        {k for e in history for k in e if k != "round" and _scalar(e[k]) is not None}
     )
     if not keys:
         return None

@@ -104,9 +104,17 @@ def dequantize(arr: np.ndarray, scale: float) -> np.ndarray:
     return (flat.astype(np.float32) * scale).reshape(arr.shape)
 
 
-def crc32c(data: bytes, seed: int = 0) -> int:
+def crc32c(data, seed: int = 0) -> int:
+    """CRC32C of a bytes-like (``bytes`` or ``memoryview`` — the decode hot
+    path passes payload-frame slices without copying them out)."""
     if _lib is not None:
-        return int(_lib.p2tw_crc32c(data, len(data), seed))
+        if isinstance(data, bytes):
+            return int(_lib.p2tw_crc32c(data, len(data), seed))
+        # zero-copy pointer into the buffer (read-only buffers included,
+        # which ctypes' from_buffer would reject)
+        buf = np.frombuffer(data, dtype=np.uint8)
+        ptr = buf.ctypes.data_as(ctypes.c_char_p)
+        return int(_lib.p2tw_crc32c(ptr, buf.size, seed))
     return _crc32c_py(data, seed)
 
 

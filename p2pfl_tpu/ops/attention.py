@@ -71,11 +71,11 @@ def _ring_attention_sharded(q, k, v, *, axis_name: str, causal: bool = True):
     den = jnp.zeros((b, h, tl), jnp.float32)
     m = jnp.full((b, h, tl), NEG_INF, jnp.float32)
     # mark accumulators device-varying so the loop carry types line up with
-    # the sharded K/V blocks (jax>=0.8 shard_map vma typing)
-    if hasattr(lax, "pcast"):
-        acc, den, m = lax.pcast((acc, den, m), (axis_name,), to="varying")
-    else:  # older jax
-        acc, den, m = lax.pvary((acc, den, m), (axis_name,))
+    # the sharded K/V blocks (jax>=0.8 shard_map vma typing; identity on
+    # older jax — parallel/compat.py)
+    from p2pfl_tpu.parallel.compat import device_varying
+
+    acc, den, m = device_varying((acc, den, m), axis_name)
     perm = [(j, (j + 1) % ring) for j in range(ring)]
 
     def body(i, carry):
@@ -114,10 +114,9 @@ def _ring_flash_sharded(q, k, v, *, axis_name: str, block: int, interpret: bool)
 
     out = jnp.zeros((b, tl, h, d), jnp.float32)
     lse = jnp.full((b, h, tl // min(block, tl), min(block, tl)), NEG_INF, jnp.float32)
-    if hasattr(lax, "pcast"):
-        out, lse = lax.pcast((out, lse), (axis_name,), to="varying")
-    else:
-        out, lse = lax.pvary((out, lse), (axis_name,))
+    from p2pfl_tpu.parallel.compat import device_varying
+
+    out, lse = device_varying((out, lse), axis_name)
 
     kb, vb = k, v
     for i in range(ring):  # ring size is static: plain python loop
@@ -153,7 +152,8 @@ def ring_attention(
     body's O(T_local²) logits matrix (causal only).
     """
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+
+    from p2pfl_tpu.parallel.compat import shard_map_compat, shard_map_unchecked
 
     spec = P(None, axis_name, None, None)
     if impl == "flash":
@@ -169,10 +169,10 @@ def ring_attention(
         )
         # pallas_call's out_shape carries no vma typing — disable the check
         # for the flash body (the collectives are still the same ring)
-        fn = shard_map(
-            body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
+        fn = shard_map_unchecked(
+            body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
         )
         return fn(q, k, v)
     body = partial(_ring_attention_sharded.__wrapped__, axis_name=axis_name, causal=causal)
-    fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    fn = shard_map_compat(body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)

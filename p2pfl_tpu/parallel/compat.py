@@ -1,0 +1,73 @@
+"""JAX version compatibility for the parallel runtime (and SPMD ops).
+
+Two moving targets pinned here once, so every ``parallel/`` module (and
+``ops/attention.py``) imports from one place instead of hard-coding a JAX
+release's layout:
+
+- ``shard_map`` graduated from ``jax.experimental.shard_map`` to a
+  top-level ``jax.shard_map`` export (jax >= 0.6). Importing it from
+  ``jax`` directly breaks every module in the package on older installs —
+  at *collection* time, before a single test runs.
+- the device-varying type system (``lax.pvary``, later ``lax.pcast``)
+  only exists on newer releases; on older JAX, shard_map has no varying
+  types and the identity is the correct (and only) lowering.
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.6: top-level export
+    from jax import shard_map
+except ImportError:  # older jax: experimental namespace
+    from jax.experimental.shard_map import shard_map  # type: ignore[no-redef]
+
+
+def device_varying(x, axis: str):
+    """Mark ``x`` (a pytree of arrays) device-varying over mesh axis ``axis``.
+
+    Scan carries under ``shard_map`` must match the varying type of values
+    produced by ``lax.axis_index`` / ``lax.ppermute`` on jax >= 0.8; older
+    releases have no varying-type checker, so the value passes through.
+    """
+    from jax import lax
+
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, (axis,), to="varying")
+    if hasattr(lax, "pvary"):
+        return lax.pvary(x, (axis,))
+    return x
+
+
+def shard_map_compat(f, **kwargs):
+    """``shard_map`` tolerant of older JAX's replication checker.
+
+    Old releases (``check_rep`` era) have no replication rules for
+    collectives like ``ppermute`` inside ``lax.scan`` bodies, so the check
+    must be off there; newer releases' vma checker handles them and stays
+    ON (the ``device_varying`` marks exist to satisfy it).
+    """
+    import inspect
+
+    params = inspect.signature(shard_map).parameters
+    if "check_rep" in params and "check_vma" not in params:
+        kwargs["check_rep"] = False
+    return shard_map(f, **kwargs)
+
+
+def shard_map_unchecked(f, **kwargs):
+    """``shard_map`` with the replication/varying checker disabled.
+
+    The kwarg spelling moved across releases (``check_rep`` →
+    ``check_vma``); bodies whose out_shape carries no vma typing (Pallas
+    calls) need it off whichever JAX is installed.
+    """
+    import inspect
+
+    params = inspect.signature(shard_map).parameters
+    for kw in ("check_vma", "check_rep"):
+        if kw in params:
+            kwargs[kw] = False
+            break
+    return shard_map(f, **kwargs)
+
+
+__all__ = ["shard_map", "device_varying", "shard_map_compat", "shard_map_unchecked"]

@@ -27,8 +27,10 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax, shard_map
+from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
+
+from p2pfl_tpu.parallel.compat import device_varying, shard_map_compat
 
 Pytree = Any
 
@@ -46,12 +48,9 @@ def stack_layers(per_layer_params: list[Pytree]) -> Pytree:
     return jax.tree.map(lambda *xs: jnp.stack(xs), *per_layer_params)
 
 
-def _varying(x, axis: str):
-    # jax>=0.8 shard_map typing: scan carries must be device-varying to
-    # match values produced by axis_index/ppermute (pcast on newer jax)
-    if hasattr(lax, "pcast"):
-        return lax.pcast(x, (axis,), to="varying")
-    return lax.pvary(x, (axis,))
+# jax>=0.8 shard_map typing: scan carries must be device-varying to match
+# values produced by axis_index/ppermute; identity on older jax (compat.py)
+_varying = device_varying
 
 
 def _pipeline_body(stage_params, xs, apply_layer: Callable, axis: str, n_stages: int):
@@ -130,7 +129,7 @@ def pipeline_apply(
         def layer_fn(p_layer, act):
             return apply_layer(p_layer, act), jnp.zeros((), jnp.float32)
 
-    fn = shard_map(
+    fn = shard_map_compat(
         partial(_pipeline_body, apply_layer=layer_fn, axis=axis, n_stages=n_stages),
         mesh=mesh,
         in_specs=(P(axis), P()),

@@ -464,6 +464,19 @@ class PipelineFederation:
             loss = None
             for xs, ys in self._node_batches(i, epochs):
                 p, o, loss = self._epoch(p, o, xs, ys)
+            if loss is None:
+                # zero batches for this node (epochs=0, or a shard shrunk
+                # under batch_size after construction): params are the
+                # untouched global — keep them in the FedAvg stack (its
+                # weights are positional) but contribute no loss term
+                from p2pfl_tpu.management.logger import logger
+
+                logger.warning(
+                    "pipeline-fed",
+                    f"node {i} produced zero batches this round — skipping its loss",
+                )
+                trained.append(p)
+                continue
             if profile:
                 jax.block_until_ready(loss)
                 prof["node_epoch_s"][i] = round(time.monotonic() - t0, 3)
@@ -481,7 +494,10 @@ class PipelineFederation:
         # stale profiles must not be attributed to an unprofiled round
         self.last_profile = prof if profile else None
         self.round += 1
-        entry = {"round": self.round, "train_loss": float(np.mean([float(x) for x in losses]))}
+        entry = {
+            "round": self.round,
+            "train_loss": float(np.mean([float(x) for x in losses])) if losses else float("nan"),
+        }
         self.history.append(entry)
         return entry
 

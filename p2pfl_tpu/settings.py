@@ -33,6 +33,27 @@ class Settings:
     GOSSIP_MODELS_PER_ROUND: int = 2
     GOSSIP_EXIT_ON_X_EQUAL_ROUNDS: int = 10
 
+    # --- gossip (data plane: encode-once + concurrent fan-out) ---
+    # Worker threads per gossiper for dispatching sends (both planes). A
+    # stalled peer occupies one worker slot instead of serializing the
+    # whole tick behind it; 1 restores the pre-overhaul strictly sequential
+    # behavior — sends run inline on the calling thread with NO send
+    # budget, so a stalled peer once again blocks its whole tick.
+    GOSSIP_SEND_WORKERS: int = 4
+    # Per-send wall-clock budget: a tick stops waiting for a send after
+    # this many seconds (the send keeps running on its worker and the
+    # neighbor is skipped while it is still in flight).
+    GOSSIP_SEND_TIMEOUT: float = 5.0
+    # Reuse encoded weight payload bytes across candidates/ticks while the
+    # model version is unchanged (learning/weights.py PayloadCache). False
+    # re-encodes per send — only useful for benchmarking the cache itself.
+    GOSSIP_PAYLOAD_CACHE: bool = True
+    # In-memory transport: round-trip weight payloads through the wire
+    # codec (encode on send, materialize on receive) instead of passing
+    # the pytree by reference. Simulations stay zero-copy by default; True
+    # exercises/benches the real byte path without sockets (bench_gossip).
+    MEMORY_WIRE_CODEC: bool = False
+
     # --- learning round ---
     TRAIN_SET_SIZE: int = 4
     VOTE_TIMEOUT: float = 60.0
@@ -167,6 +188,10 @@ def set_test_settings() -> None:
     Settings.GOSSIP_MODELS_PERIOD = 0.1
     Settings.GOSSIP_MODELS_PER_ROUND = 4
     Settings.GOSSIP_EXIT_ON_X_EQUAL_ROUNDS = 4
+    Settings.GOSSIP_SEND_WORKERS = 4
+    Settings.GOSSIP_SEND_TIMEOUT = 2.0
+    Settings.GOSSIP_PAYLOAD_CACHE = True
+    Settings.MEMORY_WIRE_CODEC = False
     Settings.TRAIN_SET_SIZE = 4
     Settings.VOTE_TIMEOUT = 10.0
     Settings.AGGREGATION_TIMEOUT = 10.0

@@ -100,6 +100,9 @@ class StartLearningStage(Stage):
             return [n for n in neis if state.nei_status.get(n, 0) != -1]
 
         def model_fn(nei: str):
+            # encode-once: the update carries the learner's payload cache,
+            # so byte transports serialize once per model version — not once
+            # per candidate per tick (learning/weights.py)
             update = node.learner.get_model_update()
             return node.protocol.build_weights("init_model", 0, update)
 
@@ -352,6 +355,9 @@ class TrainStage(Stage):
             return {n: tuple(sorted(state.models_aggregated.get(n, []))) for n in sorted(train)}
 
         def model_fn(nei: str):
+            # the aggregator memoizes the combined partial per source-group
+            # set and returns the same instance, so repeat candidates reuse
+            # both the aggregation and (on byte transports) its encode
             peer_has = state.models_aggregated.get(nei, [])
             partial = node.aggregator.get_partial_aggregation(peer_has)
             if partial is None:
@@ -443,6 +449,9 @@ class GossipModelStage(Stage):
             return [n for n in neis if state.nei_status.get(n, -1) < (state.round or 0)]
 
         def model_fn(nei: str):
+            # encode-once applies here too: contributors ride the envelope
+            # header, not the encoded tensor bytes, so rewriting them below
+            # never invalidates the cached payload
             update = node.learner.get_model_update()
             update.contributors = list(state.train_set)
             if Settings.SECURE_AGGREGATION and Settings.SECAGG_DOUBLE_MASK:

@@ -1,0 +1,263 @@
+"""Encode-once payload cache + concurrent gossip fan-out (data plane).
+
+Covers the gossip data-plane contract (``learning/weights.py`` module docs,
+``communication/gossiper.py``): payload bytes are encoded once per model
+version and reused across candidates/ticks; the cache is invalidated by
+``set_parameters``/``fit``; a topk8 error-feedback round folds the residual
+exactly once; and a stalled peer costs one send-worker slot, never the tick.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from p2pfl_tpu.communication.gossiper import Gossiper
+from p2pfl_tpu.communication.memory import MemoryRegistry
+from p2pfl_tpu.learning import weights as W
+from p2pfl_tpu.learning.dataset import FederatedDataset
+from p2pfl_tpu.learning.learner import DummyLearner, JaxLearner
+from p2pfl_tpu.learning.weights import ModelUpdate, PayloadCache
+from p2pfl_tpu.management.logger import logger
+from p2pfl_tpu.models import mlp
+from p2pfl_tpu.node import Node
+from p2pfl_tpu.settings import Settings
+from p2pfl_tpu.utils import check_equal_models, full_connection, wait_convergence, wait_to_finish
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    MemoryRegistry.reset()
+    logger.reset_comm_metrics()
+    yield
+    MemoryRegistry.reset()
+    Settings.WIRE_COMPRESSION = "none"
+    Settings.MEMORY_WIRE_CODEC = False
+    Settings.GOSSIP_PAYLOAD_CACHE = True
+    Settings.GOSSIP_SEND_WORKERS = 4
+    Settings.GOSSIP_SEND_TIMEOUT = 2.0
+
+
+# ---- payload cache ----
+
+
+def test_payload_bytes_identical_across_candidates():
+    """Within one model version, every candidate gets the SAME bytes and
+    the encode pipeline runs once (cache hits for the rest)."""
+    learner = DummyLearner()
+    learner.set_addr("cache-node")
+    before = W.encode_call_count()
+    payloads = []
+    for _ in range(5):  # five candidates, as a gossip tick would fetch
+        update = learner.get_model_update()
+        update.cache_round = 0
+        payloads.append(update.encode())
+    assert all(p is payloads[0] for p in payloads[1:])
+    assert W.encode_call_count() - before == 1
+    metrics = logger.get_comm_metrics("cache-node")
+    assert metrics["encode_cache_hit"] == 4
+    assert metrics["encode_cache_miss"] == 1
+
+
+def test_cache_invalidated_on_set_parameters_and_fit():
+    learner = DummyLearner()
+    learner.set_addr("inval-node")
+    u0 = learner.get_model_update()
+    u0.cache_round = 0
+    b0 = u0.encode()
+
+    learner.fit()  # bumps the model version
+    u1 = learner.get_model_update()
+    u1.cache_round = 0
+    b1 = u1.encode()
+    assert b1 != b0
+
+    learner.set_parameters(learner.get_parameters())  # bump even on same values
+    u2 = learner.get_model_update()
+    u2.cache_round = 0
+    before = W.encode_call_count()
+    u2.encode()
+    assert W.encode_call_count() - before == 1  # fresh encode, not a replay
+
+
+def test_cache_disabled_reencodes_per_send():
+    Settings.GOSSIP_PAYLOAD_CACHE = False
+    learner = DummyLearner()
+    learner.set_addr("nocache-node")
+    before = W.encode_call_count()
+    for _ in range(3):
+        update = learner.get_model_update()
+        update.cache_round = 0
+        update.encode()
+    assert W.encode_call_count() - before == 3
+
+
+def _topk_update(params, anchor, residual, cache, version):
+    update = ModelUpdate(params, ["a"], 1)
+    update.anchor = anchor
+    update.anchor_tag = "0:1"
+    update.ef_residual = residual
+    update.payload_cache = cache
+    update.cache_version = version
+    update.cache_round = 1
+    return update
+
+
+def test_topk_residual_folded_exactly_once_per_version():
+    """Repeat sends of the own contribution must reuse the bytes instead of
+    re-folding (and re-mutating) the error-feedback residual; a version bump
+    re-encodes against the accumulated residual."""
+    Settings.WIRE_COMPRESSION = "topk8"
+    rng = np.random.default_rng(0)
+    anchor = {"w": rng.normal(size=(64, 32)).astype(np.float32)}
+    params = {"w": anchor["w"] + rng.normal(size=(64, 32)).astype(np.float32) * 0.1}
+    residual: dict = {}
+    cache = PayloadCache("topk-node")
+
+    b1 = _topk_update(params, anchor, residual, cache, version=1).encode()
+    assert residual, "first encode must populate the residual store"
+    snapshot = {k: v.copy() for k, v in residual.items()}
+
+    b2 = _topk_update(params, anchor, residual, cache, version=1).encode()
+    assert b2 == b1
+    for k in residual:  # cache hit ⇒ store untouched
+        np.testing.assert_array_equal(residual[k], snapshot[k])
+
+    b3 = _topk_update(params, anchor, residual, cache, version=2).encode()
+    assert b3 != b1  # re-encode folds the accumulated residual
+    assert any(not np.array_equal(residual[k], snapshot[k]) for k in residual)
+
+
+# ---- concurrent fan-out ----
+
+
+def test_stalled_peer_does_not_serialize_the_tick():
+    """One peer hangs longer than GOSSIP_SEND_TIMEOUT: the other candidates'
+    sends complete immediately and the tick returns within the budget."""
+    Settings.GOSSIP_SEND_TIMEOUT = 0.5
+    delivered: list[str] = []
+    stall = 3.0
+
+    def send_fn(nei, env, create_connection=False):
+        if nei == "slow":
+            time.sleep(stall)
+        delivered.append(nei)
+        return True
+
+    gossiper = Gossiper("fanout-node", send_fn)
+    gossiper.start()
+    try:
+        ticks = iter([["slow", "fast-1", "fast-2", "fast-3"], []])
+        t0 = time.monotonic()
+        gossiper.gossip_weights(
+            early_stopping_fn=lambda: False,
+            get_candidates_fn=lambda: next(ticks),
+            status_fn=lambda: None,
+            model_fn=lambda nei: f"payload-for-{nei}",
+            period=0.01,
+        )
+        elapsed = time.monotonic() - t0
+    finally:
+        gossiper.stop()
+    assert elapsed < stall, f"tick serialized behind the stalled peer ({elapsed:.2f}s)"
+    assert {"fast-1", "fast-2", "fast-3"} <= set(delivered)
+    metrics = logger.get_comm_metrics("fanout-node")
+    assert metrics.get("gossip_send_timeout", 0) >= 1
+    assert metrics.get("gossip_send_ok", 0) >= 3
+
+
+def test_inflight_peer_skipped_not_stacked():
+    """While a send to a peer is stuck past its budget, later batches skip
+    that peer instead of stranding another worker behind the same stall."""
+    Settings.GOSSIP_SEND_TIMEOUT = 0.2
+    release = time.monotonic() + 1.5
+
+    def send_fn(nei, env, create_connection=False):
+        if nei == "slow":
+            time.sleep(max(0.0, release - time.monotonic()))
+        return True
+
+    gossiper = Gossiper("inflight-node", send_fn)
+    gossiper.start()
+    try:
+        first, first_skipped = gossiper._dispatch_sends([("slow", "p"), ("fast", "p")])
+        assert first == [None, True]  # slow timed out, fast landed
+        assert first_skipped == []
+        second, second_skipped = gossiper._dispatch_sends([("slow", "p2"), ("fast", "p2")])
+        assert second == [False, True]  # slow skipped while still in flight
+        # skipped sends are reported so the message plane can requeue them
+        assert second_skipped == [("slow", "p2")]
+    finally:
+        gossiper.stop()
+    metrics = logger.get_comm_metrics("inflight-node")
+    assert metrics.get("gossip_send_inflight_skip", 0) >= 1
+
+
+# ---- end to end over the byte path ----
+
+
+def _federation(n=3, aggregator=None):
+    full = FederatedDataset.synthetic_mnist(n_train=768, n_test=128)
+    nodes = []
+    for i in range(n):
+        learner = JaxLearner(mlp(seed=i), full.partition(i, n), batch_size=64)
+        nodes.append(Node(learner=learner, aggregator=aggregator))
+    for node in nodes:
+        node.start()
+    for node in nodes:
+        full_connection(node, nodes)
+    wait_convergence(nodes, n - 1, only_direct=True)
+    return nodes
+
+
+def test_memory_wire_codec_federation_converges_with_cache():
+    """The full byte path in-process: payloads are encoded (once per
+    version), shipped, decoded and the federation still converges; the
+    cache's effect is visible through the logger's comm metrics."""
+    Settings.MEMORY_WIRE_CODEC = True
+    nodes = _federation(3)
+    try:
+        before = W.encode_call_count()
+        nodes[0].set_start_learning(rounds=1, epochs=0)
+        wait_to_finish(nodes, timeout=90)
+        check_equal_models(nodes)
+        encodes = W.encode_call_count() - before
+        hits = sum(
+            m.get("encode_cache_hit", 0) for m in logger.get_comm_metrics().values()
+        )
+        sends = sum(
+            m.get("gossip_send_ok", 0) for m in logger.get_comm_metrics().values()
+        )
+        assert hits > 0, "byte path never hit the payload cache"
+        # encode-once: total encodes stay far below one-per-send
+        assert encodes < hits + sends, (encodes, hits, sends)
+    finally:
+        for node in nodes:
+            node.stop()
+
+
+def test_stalled_memory_peer_does_not_block_round():
+    """A peer whose receive path hangs past GOSSIP_SEND_TIMEOUT must not
+    stop the others from finishing the round."""
+    Settings.MEMORY_WIRE_CODEC = True
+    Settings.GOSSIP_SEND_TIMEOUT = 0.5
+    nodes = _federation(3)
+    slow = nodes[2]
+    orig = slow.protocol.handle_weights
+
+    def slow_handle(env):
+        time.sleep(1.2)
+        return orig(env)
+
+    slow.protocol.handle_weights = slow_handle
+    try:
+        nodes[0].set_start_learning(rounds=1, epochs=0)
+        wait_to_finish(nodes, timeout=90)
+        timeouts = sum(
+            m.get("gossip_send_timeout", 0) for m in logger.get_comm_metrics().values()
+        )
+        assert timeouts >= 1, "stall never tripped the per-send budget"
+    finally:
+        slow.protocol.handle_weights = orig
+        for node in nodes:
+            node.stop()

@@ -152,3 +152,24 @@ def test_pipelined_moe_federation_trains():
     fed = PipelineFederation(m, shards, n_stages=4, batch_size=8, seed=0)
     losses = [fed.run_round(epochs=1)["train_loss"] for _ in range(3)]
     assert losses[-1] < losses[0] - 0.15, losses
+
+
+def test_pipeline_federation_zero_batch_round_is_safe():
+    """A round that yields zero batches (epochs=0) must not let a None loss
+    reach the mean/`block_until_ready` (ADVICE r5: spmd_lm.py run_round);
+    the round records NaN and the params stay the untouched global."""
+    import math
+
+    cfg = TransformerConfig(
+        vocab_size=256, dim=32, n_layers=2, n_heads=2, n_kv_heads=2,
+        ffn_hidden=64, lora_rank=0,
+    )
+    m = tiny_transformer(seq_len=16, cfg=cfg)
+    data = FederatedDataset.synthetic_lm(n_train=2 * 16, n_test=16, seq_len=16, vocab_size=256)
+    shards = [data.partition(i, 2) for i in range(2)]
+    fed = PipelineFederation(m, shards, n_stages=2, batch_size=8, seed=0)
+    entry = fed.run_round(epochs=0, profile=True)
+    assert math.isnan(entry["train_loss"])
+    # undersized shards are still rejected loudly at construction
+    with pytest.raises(ValueError, match="batch size"):
+        PipelineFederation(m, [data.partition(0, 2)], n_stages=2, batch_size=64, seed=0)

@@ -44,3 +44,17 @@ def test_plot_history(tmp_path):
     p = plot_history(hist, str(tmp_path / "h.png"), title="t")
     assert p and os.path.getsize(p) > 1000
     assert plot_history([], str(tmp_path / "e.png")) is None
+
+
+def test_plot_history_late_appearing_metric(tmp_path):
+    """Metric keys are unioned across ALL entries (ADVICE: plotting.py
+    derived them from history[0] only) — a metric first logged in round 2
+    still gets a curve, entries missing it are just skipped points."""
+    hist = [
+        {"round": 0, "train_loss": 2.0},
+        {"round": 1, "train_loss": 1.5},
+        {"round": 2, "train_loss": 1.2, "test_acc": 0.41},
+        {"round": 3, "train_loss": 1.0, "test_acc": 0.55},
+    ]
+    p = plot_history(hist, str(tmp_path / "late.png"), title="late")
+    assert p and os.path.getsize(p) > 1000
