@@ -278,6 +278,16 @@ class SecAggShareCommand(Command):
         if source not in st.secagg_pubs:
             logger.debug(st.addr, f"secagg_share from {source} before its key — ignored")
             return
+        # share indices run 1..len(holders) over the SENDER's sorted holder
+        # list, and this very message carries that whole list (one triple
+        # per holder) — so the index bound comes from the MESSAGE, not from
+        # our instantaneous train set. The old cap
+        # max(2*len(st.train_set), 1024) mis-scored exactly the r±1 shares
+        # this handler accepts: a share arriving for round r+1 BEFORE our
+        # train set latches (len=0) fell back to the 1024 floor, so a
+        # legitimate index from a >1025-member federation was dropped,
+        # while junk indices up to 1024 sailed through a 5-member round.
+        n_holders = (len(args) - 1) // 3
         for i in range(1, len(args), 3):
             holder, x_str, ct_hex = args[i], args[i + 1], args[i + 2]
             if holder != st.addr:
@@ -290,12 +300,7 @@ class SecAggShareCommand(Command):
             except (ValueError, SecAggError):
                 logger.error(st.addr, f"Malformed secagg_share from {source}")
                 return
-            # share indices run 1..len(holders) < sender's train set, which
-            # may differ from OUR latched set for the r±1 rounds this
-            # handler accepts — a sanity cap, not an exact bound: scale with
-            # membership but never below the legacy 1024 floor
-            max_x = max(2 * len(st.train_set), 1024)
-            if not 1 <= x <= max_x or not 0 <= y < secagg.SHAMIR_PRIME:
+            if not 1 <= x <= n_holders or not 0 <= y < secagg.SHAMIR_PRIME:
                 logger.error(st.addr, f"Out-of-range secagg_share from {source} — rejected")
                 return
             st.secagg_shares_held[(round, source)] = (x, y)

@@ -132,6 +132,9 @@ class LoRALearner(NodeLearner):
             raise ModelNotMatchingError("incoming params do not match LoRA structure")
         self.lora = params
         self.opt_state = self.tx.init(params)
+        # the payload cache keys encoded bytes on model_version: skipping
+        # the bump would replay the PREVIOUS adapters' bytes for these
+        self.bump_model_version()
 
     def get_parameters(self) -> Pytree:
         return self.lora
@@ -162,6 +165,8 @@ class LoRALearner(NodeLearner):
             )
             self._steps_done += xs.shape[0]
             logger.log_metric(self.addr, "train_loss", float(loss), step=self._steps_done)
+        # trained adapters are new payload content (encode-once cache key)
+        self.bump_model_version()
 
     def interrupt_fit(self) -> None:
         self._interrupt.set()

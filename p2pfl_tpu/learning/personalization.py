@@ -129,6 +129,10 @@ class PersonalizedLearner(JaxLearner):
         self.params = jax.tree_util.tree_unflatten(treedef, merged)
         if not self.keep_opt_state:
             self.opt_state = self.tx.init(self.params)
+        # this override bypasses JaxLearner.set_parameters: bump here too,
+        # or the payload cache would replay pre-merge bytes as the round's
+        # aggregated diffusion
+        self.bump_model_version()
 
     def materialize(self, update: ModelUpdate) -> ModelUpdate:
         if update.params is not None:

@@ -30,6 +30,7 @@ from jax.ad_checkpoint import checkpoint_name
 
 from p2pfl_tpu.models.base import FlaxModel
 from p2pfl_tpu.ops.attention import causal_attention
+from p2pfl_tpu.ops.flash_attention import FlashConfig
 
 
 _REMAT_SAVE_NAMES = {
@@ -103,6 +104,16 @@ class TransformerConfig:
     # Incompatible with n_experts>0 for now (sown MoE aux losses don't
     # thread through nn.scan broadcasts here).
     scan_layers: bool = False
+    # Static flash-kernel schedule (ops/flash_attention.FlashConfig): when
+    # set, any Block built from this config WITHOUT an explicit attn_fn
+    # (the pipeline stages, spmd train steps, tiny_transformer(attn="flash"))
+    # runs the Pallas flash kernel under exactly this schedule. Because the
+    # config is a frozen, hashable field of this (frozen, hashable) config,
+    # it participates in every jit cache key that treats the module/config
+    # as static — flipping block shapes or bwd_mode after a compiled step
+    # provably re-traces (the guarantee the old BWD_MODE global broke).
+    # None = dense XLA attention unless the caller overrides attn/attn_fn.
+    flash_config: Optional[FlashConfig] = None
 
     def __post_init__(self) -> None:
         if self.remat_policy is not None:
@@ -192,7 +203,22 @@ class Attention(nn.Module):
         rep = cfg.n_heads // cfg.n_kv_heads
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
-        attend = self.attn_fn or causal_attention
+        if self.attn_fn is not None:
+            attend = self.attn_fn
+        elif cfg.flash_config is not None:
+            # cfg-pinned flash schedule: every path that builds Blocks from
+            # the config alone (pipeline stages, spmd train steps) picks up
+            # the SAME statically-keyed kernel without threading a callable
+            from p2pfl_tpu.ops.flash_attention import flash_attention
+
+            attend = partial(
+                flash_attention,
+                causal=True,
+                config=cfg.flash_config,
+                interpret=jax.default_backend() != "tpu",
+            )
+        else:
+            attend = causal_attention
         out = attend(q, k, v).reshape(b, t, cfg.dim)
         return dense(cfg.dim, name="wo")(out)
 
@@ -407,20 +433,29 @@ def resolve_attention(
     attn: str,
     mesh: Any = None,
     axis_name: str = "model",
-    block: int = 128,
+    block: Optional[int] = None,
     seq_len: Optional[int] = None,
     block_bwd: Optional[int] = None,
+    config: Optional[FlashConfig] = None,
 ) -> Optional[Callable]:
     """Map an attention backend name to an ``(q, k, v) -> out`` callable.
 
-    ``block_bwd``: backward-pass-specific flash block size (the dQ/dKV
-    kernels prefer larger blocks than the forward — see
-    ``ops/flash_attention.flash_attention``); None = use ``block``.
+    ``config`` pins the full static kernel schedule
+    (:class:`~p2pfl_tpu.ops.flash_attention.FlashConfig`); the legacy
+    ``block``/``block_bwd`` square-block shorthands build one when no
+    config is given. With neither, the kernel resolves the tuned/default
+    config for its shape at trace time
+    (:func:`p2pfl_tpu.ops.autotune.get_flash_config`).
     """
     if attn == "auto":
         if seq_len is None:
             raise ValueError("attn='auto' needs seq_len to pick a backend")
         attn = pick_attention(seq_len)
+    if config is None and block is not None:
+        config = FlashConfig(
+            block_q=block, block_k=block,
+            block_q_bwd=block_bwd, block_k_bwd=block_bwd,
+        )
     if attn == "dense":
         return None  # Attention falls back to the fused causal path
     if attn == "flash":
@@ -429,8 +464,7 @@ def resolve_attention(
         # Pallas runs natively on TPU; anywhere else use interpret mode
         interpret = jax.default_backend() != "tpu"
         return partial(
-            flash_attention, causal=True, block_q=block, block_k=block,
-            interpret=interpret, block_q_bwd=block_bwd, block_k_bwd=block_bwd,
+            flash_attention, causal=True, config=config, interpret=interpret
         )
     if attn in ("ring", "ring_flash"):
         if mesh is None:
@@ -438,7 +472,10 @@ def resolve_attention(
         from p2pfl_tpu.ops.attention import ring_attention
 
         impl = "flash" if attn == "ring_flash" else "dense"
-        return partial(ring_attention, mesh=mesh, axis_name=axis_name, impl=impl, block=block)
+        return partial(
+            ring_attention, mesh=mesh, axis_name=axis_name, impl=impl,
+            block=block or 128, flash_config=config if attn == "ring_flash" else None,
+        )
     raise ValueError(f"unknown attention backend {attn!r} (dense|flash|ring|ring_flash)")
 
 
@@ -472,36 +509,37 @@ def tiny_transformer(
             from p2pfl_tpu.settings import Settings
 
             basis = seq_len // mesh.shape[Settings.MESH_MODEL_AXIS]
-        def largest_block(hi: int, lo: int):
-            # blocks must divide the basis and (on TPU Mosaic) be a
-            # multiple of 8 — the single place the tiling rule lives
-            return next(
-                (b for b in range(hi, lo, -1) if basis % b == 0 and b % 8 == 0),
-                None,
-            )
+        if attn in ("flash", "ring_flash"):
+            from p2pfl_tpu.ops.autotune import _fit
 
-        if basis <= 512:
-            block = basis  # block == T always satisfies the TPU tiling rule
-        else:
-            # Prefer the LARGEST block <= 512: bench config 7's sweep
-            # shows bigger blocks amortize the Pallas grid bookkeeping —
-            # block 512 beat 256 at every measured length (round 4: 112 ->
-            # 75 ms/train-step at T=4096)
-            block = largest_block(512, 7)
-            if block is None and attn in ("flash", "ring_flash"):
-                # the search goes down to 8, so this only fires when the
-                # attended length itself is not a multiple of 8
+            # the one tiling rule (autotune._fit): blocks must divide the
+            # basis and be a multiple of 8, with block == basis always
+            # acceptable. Lengths <= 512 therefore always work (one full
+            # block); longer lengths need SOME multiple-of-8 divisor or the
+            # whole sequence becomes one VMEM-hostile block — reject those.
+            if _fit(basis, 512) > 512:
                 raise ValueError(
-                    f"attn={attn!r} needs the attended length to be a "
-                    f"multiple of 8 (Mosaic tiling); got {basis} (seq_len "
-                    "per shard)"
+                    f"attn={attn!r} needs a flash block <= 512 dividing the "
+                    f"attended length: {basis} (seq_len per shard) has no "
+                    "multiple-of-8 divisor"
                 )
-        # backward block sizes are decided INSIDE flash_attention's vjp
-        # (ops/flash_attention._default_bwd_blocks) — fused sweep keeps the
-        # forward blocks, split two-pass upsizes at wide heads. block_bwd
-        # here is an explicit override only.
-        block_bwd = None
-        attn_fn = resolve_attention(attn, mesh=mesh, block=block, block_bwd=block_bwd)
+            # kernel schedule resolution: an explicit cfg.flash_config pin
+            # wins; otherwise Settings.FLASH_AUTOTUNE sweeps and caches the
+            # schedule for this (T, D, dtype) here — at model-build time,
+            # outside any trace — and get_flash_config serves it (pinned →
+            # tune cache → shipped per-device-kind defaults table)
+            from p2pfl_tpu.ops import autotune
+            from p2pfl_tpu.settings import Settings
+
+            head_dim = cfg.dim // cfg.n_heads
+            flash_cfg = cfg.flash_config
+            if flash_cfg is None and Settings.FLASH_AUTOTUNE:
+                flash_cfg = autotune.autotune_flash(basis, head_dim, dtype=cfg.dtype)
+            if flash_cfg is None:
+                flash_cfg = autotune.get_flash_config(basis, head_dim, dtype=cfg.dtype)
+            attn_fn = resolve_attention(attn, mesh=mesh, config=flash_cfg)
+        else:
+            attn_fn = resolve_attention(attn, mesh=mesh)
     module = CausalLM(cfg, attn_fn)
     rng = jax.random.PRNGKey(seed)
     dummy = jnp.zeros((1, seq_len), dtype=jnp.int32)

@@ -1,4 +1,5 @@
-"""Pure JAX ops: pytree math, aggregation kernels, codecs."""
+"""Pure JAX ops: pytree math, aggregation kernels, codecs, Pallas attention
+kernels and their autotuner."""
 
 from p2pfl_tpu.ops.tree import (
     tree_add,
@@ -10,7 +11,21 @@ from p2pfl_tpu.ops.tree import (
     tree_zeros_like,
 )
 
+def __getattr__(name):
+    # FlashConfig is exported lazily: an eager re-export would drag the
+    # jax.experimental.pallas import chain into every `p2pfl_tpu.ops`
+    # import (gossip/codec-only processes use only ops.tree). Exporting
+    # the flash_attention FUNCTION here is deliberately avoided entirely —
+    # it would shadow the p2pfl_tpu.ops.flash_attention SUBMODULE.
+    if name == "FlashConfig":
+        from p2pfl_tpu.ops.flash_attention import FlashConfig
+
+        return FlashConfig
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
 __all__ = [
+    "FlashConfig",
     "tree_add",
     "tree_scale",
     "tree_stack",

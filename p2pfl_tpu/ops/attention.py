@@ -99,11 +99,13 @@ def _ring_attention_sharded(q, k, v, *, axis_name: str, causal: bool = True):
     return out.astype(q.dtype)
 
 
-def _ring_flash_sharded(q, k, v, *, axis_name: str, block: int, interpret: bool):
+def _ring_flash_sharded(q, k, v, *, axis_name: str, config, interpret: bool):
     """Per-device ring body with Pallas flash blocks: each hop runs the
     offset-aware flash kernel on the local Q against the incoming K/V shard
     (O(T_local·D) memory instead of the dense body's O(T_local²) logits),
     then merges via log-sum-exp — the differentiable ring-flash composition.
+    ``config`` is the static :class:`~p2pfl_tpu.ops.flash_attention.FlashConfig`
+    kernel schedule for every hop's kernel.
     """
     from p2pfl_tpu.ops.flash_attention import flash_attention_block
 
@@ -113,7 +115,9 @@ def _ring_flash_sharded(q, k, v, *, axis_name: str, block: int, interpret: bool)
     perm = [(j, (j + 1) % ring) for j in range(ring)]
 
     out = jnp.zeros((b, tl, h, d), jnp.float32)
-    lse = jnp.full((b, h, tl // min(block, tl), min(block, tl)), NEG_INF, jnp.float32)
+    # lse rides the kernels' block-size-independent [B, H, 1, T_local] row
+    # layout, so hop merges never depend on the configured block shapes
+    lse = jnp.full((b, h, 1, tl), NEG_INF, jnp.float32)
     from p2pfl_tpu.parallel.compat import device_varying
 
     out, lse = device_varying((out, lse), axis_name)
@@ -122,7 +126,7 @@ def _ring_flash_sharded(q, k, v, *, axis_name: str, block: int, interpret: bool)
     for i in range(ring):  # ring size is static: plain python loop
         src = (my - i) % ring  # which shard this K/V block came from
         ob, lb = flash_attention_block(
-            q, kb, vb, my * tl, src * tl, block_q=block, block_k=block, interpret=interpret
+            q, kb, vb, my * tl, src * tl, config, interpret
         )
         new = jnp.logaddexp(lse, lb)
         # NEG_INF is a large finite sentinel (-1e30), so test against the
@@ -130,7 +134,7 @@ def _ring_flash_sharded(q, k, v, *, axis_name: str, block: int, interpret: bool)
         wo = jnp.where(lse <= NEG_INF / 2, 0.0, jnp.exp(lse - new))
         wn = jnp.where(lb <= NEG_INF / 2, 0.0, jnp.exp(lb - new))
 
-        def as_bthd(w):  # [B,H,nq,bq] -> [B,T,H,1]
+        def as_bthd(w):  # [B,H,1,T] -> [B,T,H,1]
             return w.reshape(b, h, tl).transpose(0, 2, 1)[..., None]
 
         out = out * as_bthd(wo) + ob.astype(jnp.float32) * as_bthd(wn)
@@ -142,14 +146,18 @@ def _ring_flash_sharded(q, k, v, *, axis_name: str, block: int, interpret: bool)
 
 
 def ring_attention(
-    q, k, v, mesh, axis_name: str, causal: bool = True, impl: str = "dense", block: int = 128
+    q, k, v, mesh, axis_name: str, causal: bool = True, impl: str = "dense",
+    block: int = 128, flash_config=None,
 ) -> jax.Array:
     """Full-sequence attention with T sharded over ``axis_name`` of ``mesh``.
 
     q,k,v: [B, T, H, D] global arrays (T divisible by the axis size).
     ``impl="flash"`` runs each ring hop through the offset-aware Pallas
     flash kernel — O(T_local·D) memory per device instead of the dense
-    body's O(T_local²) logits matrix (causal only).
+    body's O(T_local²) logits matrix (causal only). ``flash_config`` pins
+    the hops' full static kernel schedule
+    (:class:`~p2pfl_tpu.ops.flash_attention.FlashConfig`); ``block`` is the
+    square-block shorthand used when no config is given.
     """
     from jax.sharding import PartitionSpec as P
 
@@ -159,12 +167,17 @@ def ring_attention(
     if impl == "flash":
         if not causal:
             raise ValueError("impl='flash' supports causal attention only")
+        from p2pfl_tpu.ops.flash_attention import FlashConfig
+
         interpret = jax.default_backend() != "tpu"
         tl = q.shape[1] // mesh.shape[axis_name]
+        config = flash_config or FlashConfig(
+            block_q=min(block, tl), block_k=min(block, tl)
+        )
         body = partial(
             _ring_flash_sharded,
             axis_name=axis_name,
-            block=min(block, tl),
+            config=config,
             interpret=interpret,
         )
         # pallas_call's out_shape carries no vma typing — disable the check

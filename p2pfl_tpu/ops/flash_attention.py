@@ -1,27 +1,60 @@
-"""Pallas flash attention (single chip), forward AND backward.
+"""Pallas flash attention (single chip), forward AND backward, explicitly
+configured.
 
 Blockwise causal attention with online softmax: O(T·D) VMEM per program
-instead of the O(T²) logits matrix. Grid is (batch, heads, q-blocks); each
-program streams K/V blocks up to its causal frontier, keeping running
-(max, denom, accumulator) statistics in fp32 while the matmuls feed the MXU
-in the input dtype.
+instead of the O(T²) logits matrix. Grid is (batch, heads, q-groups); each
+program owns ``q_span`` consecutive q blocks (wider q ownership amortizes
+grid/bookkeeping overhead while each sub-tile keeps its OWN causal
+frontier — one big block would stream every k block up to the LAST row's
+frontier for all rows) and streams K/V blocks up to each sub-tile's
+frontier, keeping running (max, denom, accumulator) statistics in fp32
+while the matmuls feed the MXU in the input dtype (bf16 K/V loads in
+production; casting operands to f32 first runs the systolic array at its
+slow f32 rate — measured 5× at D=32).
 
-Training: the custom VJP is backed by two more Pallas kernels (the standard
+Every schedule knob lives in :class:`FlashConfig` — a frozen, hashable
+dataclass that rides jit/custom_vjp STATIC arguments, so flipping any knob
+(block shapes, q ownership, backward mode) after a step has compiled
+provably re-traces. There are no module-global kernel knobs (the old
+``BWD_MODE`` global was read at trace time with no cache-key participation
+— flipping it after compilation silently did nothing, ADVICE r5).
+``config=None`` resolves through :mod:`p2pfl_tpu.ops.autotune`: pinned
+config → autotune cache (in-process, then on-disk, keyed on device kind) →
+shipped defaults table for v4 / v5e / CPU-interpret.
+
+Training: the custom VJP is backed by Pallas kernels (the standard
 flash-attention backward split):
 
 - ``_dq_kernel``  — grid (B, H, q-blocks): recomputes P from the saved
   log-sum-exp and accumulates ``dQ_i += (P ∘ (dO V^T − Δ)) K · scale``;
 - ``_dkv_kernel`` — grid (B, H, k-blocks): streams the q blocks at or past
   the causal frontier and accumulates ``dV_j += P^T dO`` and
-  ``dK_j += (P ∘ (dO V^T − Δ))^T Q · scale``.
+  ``dK_j += (P ∘ (dO V^T − Δ))^T Q · scale``;
+- ``_dkvq_kernel`` — the fused single-pass alternative (see its docstring):
+  dK/dV per k-block AND dQ in one sweep via a persistent VMEM scratch,
+  5 block matmuls instead of the split pair's 7. Selected by
+  ``FlashConfig.bwd_mode`` (``"auto"`` picks fused whenever the fp32 dQ
+  scratch fits comfortably in VMEM).
 
 Residuals are just ``(q, k, v, o, lse)`` — the attention matrix is never
 materialized in either direction, so training long sequences stays O(T·D)
-memory end-to-end (the r1 version rematerialized the backward through dense
-XLA attention, which was O(T²)). The log-sum-exp is saved in a block-aligned
-``[B, H, nq, block_q]`` layout so every kernel ref stays 2D (this
-environment's Mosaic compiler rejects 1D/`.at[]` ref views). Δ = rowsum(dO∘O)
-is a cheap elementwise XLA op computed outside the kernels.
+memory end-to-end. The log-sum-exp is saved in a block-size-INDEPENDENT
+``[B, H, 1, T]`` row layout (always mapped as the full ``(1, T)`` block,
+which satisfies Mosaic's block==array tiling rule for any T): the backward
+can pick any block shape without the old per-block-layout reshuffle, and
+every kernel ref stays 2D (this environment's Mosaic compiler rejects
+1D/`.at[]` ref views). Δ = rowsum(dO∘O) is a cheap elementwise XLA op
+computed outside the kernels in the same row layout.
+
+Grid dimension semantics are pinned explicitly on every ``pallas_call``
+(``_compiler_params``): batch/head dims are ``parallel``; the forward's
+q-group dim is ``arbitrary`` (all programs of one (b, h) write rows of the
+SAME full lse block — a megacore split over that dim would race the block
+flush); the fused backward's k-block dim is ``arbitrary`` because the
+``dq_acc`` scratch accumulation REQUIRES sequential k blocks (this used to
+be an accident of the default semantics — advisor round-5); the split
+backward kernels write disjoint blocks and read shared blocks read-only,
+so their grid is fully ``parallel``.
 
 The reference has no attention anywhere (SURVEY §2.9) — this exists for the
 BASELINE config-5 model family and the long-context path.
@@ -32,7 +65,9 @@ softmax accumulation, broadcasted_iota masking, @pl.when).
 
 from __future__ import annotations
 
+import dataclasses
 from functools import partial
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -42,14 +77,69 @@ from jax.experimental import pallas as pl
 NEG_INF = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k, causal, scale):
-    qi = pl.program_id(2)
-    t = k_ref.shape[0]
-    dt = q_ref.dtype
-    # feed the MXU in the input dtype (bf16 in production) and accumulate in
-    # f32 via preferred_element_type — casting operands to f32 first runs
-    # the systolic array at its slow f32 rate (measured 5× at D=32)
-    q = q_ref[:]  # [BQ, D]
+@dataclasses.dataclass(frozen=True)
+class FlashConfig:
+    """Static schedule of the flash kernels — hashable, jit-cache-key safe.
+
+    Forward: ``block_q`` × ``block_k`` tiles, ``q_span`` q blocks owned per
+    program (wider ownership amortizes grid bookkeeping; each sub-tile
+    keeps its own causal frontier). Backward: ``block_q_bwd``/``block_k_bwd``
+    override the backward tile shapes (``None`` → :func:`_bwd_blocks`
+    decides: fused keeps the forward's, split upsizes at wide heads);
+    ``bwd_mode`` picks the kernel structure — ``"fused"`` = one sweep with
+    a persistent dQ scratch (5 block matmuls, the MFU-accounted minimum),
+    ``"split"`` = separate dq/dkv kernels (7 — recomputes S and dP twice),
+    ``"auto"`` = fused whenever the fp32 [T, D] dQ scratch fits comfortably
+    in VMEM next to resident q/do.
+
+    Pass it through ``flash_attention(config=...)``,
+    ``TransformerConfig(flash_config=...)`` or
+    ``resolve_attention(config=...)``; ``None`` anywhere resolves through
+    :func:`p2pfl_tpu.ops.autotune.get_flash_config` (pinned → tune cache →
+    defaults table). Because instances compare/hash by value, passing an
+    EQUAL config re-uses the compiled program and passing a DIFFERENT one
+    re-traces — the contract the old ``BWD_MODE`` module global broke.
+    """
+
+    block_q: int = 128
+    block_k: int = 128
+    q_span: int = 1
+    block_q_bwd: Optional[int] = None
+    block_k_bwd: Optional[int] = None
+    bwd_mode: str = "auto"  # auto | fused | split
+
+    def __post_init__(self) -> None:
+        if self.bwd_mode not in ("auto", "fused", "split"):
+            raise ValueError(f"bwd_mode {self.bwd_mode!r} (auto|fused|split)")
+        if self.block_q < 1 or self.block_k < 1 or self.q_span < 1:
+            raise ValueError("block_q/block_k/q_span must be >= 1")
+
+
+def _resolve(config: Optional[FlashConfig], t: int, d: int, dtype, causal: bool) -> FlashConfig:
+    """``config=None`` → the tuned/default config for this shape."""
+    if config is not None:
+        return config
+    from p2pfl_tpu.ops.autotune import get_flash_config
+
+    return get_flash_config(t, d, dtype=dtype, causal=causal)
+
+
+def _compiler_params(*dims: str):
+    """Pin grid ``dimension_semantics`` ('parallel' dims may be split across
+    megacore; 'arbitrary' dims MUST run sequentially on one core). Returns
+    None on non-TPU pallas builds (and is ignored in interpret mode)."""
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+
+        return pltpu.TPUCompilerParams(dimension_semantics=dims)
+    except (ImportError, AttributeError, TypeError):  # pragma: no cover
+        return None
+
+
+def _fwd_tile(q, k_ref, v_ref, qi, *, block_q, block_k, causal, scale, t):
+    """Online-softmax accumulation of ONE q sub-tile against its visible
+    K/V stream. Returns (acc [BQ, D] f32, m [BQ, 1] f32, l [BQ, 1] f32)."""
+    dt = q.dtype
 
     acc = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
     m = jnp.full((block_q, 1), NEG_INF, jnp.float32)
@@ -93,12 +183,34 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k, caus
         acc, m, l = lax.fori_loop(
             0, t // block_k, partial(body, masked=False), (acc, m, l)
         )
-    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
-    # log-sum-exp per row; fully-masked rows keep NEG_INF (exp underflows to 0).
-    # lse_ref holds ALL q-blocks' rows (full-array block — Mosaic's tiling
-    # rule rejects a (1, block_q) block when nq > 1); program qi owns row qi.
-    lse = jnp.where(m <= NEG_INF / 2, NEG_INF, m + jnp.log(jnp.maximum(l, 1e-30)))
-    lse_ref[pl.ds(qi, 1), :] = lse.reshape(1, block_q)
+    return acc, m, l
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k, q_span, causal, scale
+):
+    t = k_ref.shape[0]
+    for s in range(q_span):  # static unroll: q_span consecutive sub-tiles
+        qi = pl.program_id(2) * q_span + s
+        q = q_ref[pl.ds(s * block_q, block_q), :]  # [BQ, D]
+        acc, m, l = _fwd_tile(
+            q, k_ref, v_ref, qi, block_q=block_q, block_k=block_k,
+            causal=causal, scale=scale, t=t,
+        )
+        o_ref[pl.ds(s * block_q, block_q), :] = (
+            acc / jnp.maximum(l, 1e-30)
+        ).astype(o_ref.dtype)
+        # log-sum-exp per row; fully-masked rows keep NEG_INF (exp
+        # underflows to 0). lse_ref is the block-size-INDEPENDENT [1, T]
+        # row (full-array block — block == array dims satisfies Mosaic's
+        # tiling rule); each sub-tile owns its T-slice.
+        lse = jnp.where(m <= NEG_INF / 2, NEG_INF, m + jnp.log(jnp.maximum(l, 1e-30)))
+        lse_ref[pl.ds(0, 1), pl.ds(qi * block_q, block_q)] = lse.reshape(1, block_q)
+
+
+def _row(ref, i, block_q):
+    """Read rows [i·BQ, (i+1)·BQ) of a [1, T] row-layout ref as [BQ, 1]."""
+    return ref[pl.ds(0, 1), pl.ds(i * block_q, block_q)].reshape(block_q, 1)
 
 
 def _dq_kernel(
@@ -109,8 +221,8 @@ def _dq_kernel(
     dt = q_ref.dtype
     q = q_ref[:]  # [BQ, D]
     do = do_ref[:]  # [BQ, D]
-    lse = lse_ref[pl.ds(qi, 1), :].reshape(block_q, 1)  # [BQ, 1]
-    delta = delta_ref[pl.ds(qi, 1), :].reshape(block_q, 1)  # [BQ, 1]
+    lse = _row(lse_ref, qi, block_q)  # [BQ, 1]
+    delta = _row(delta_ref, qi, block_q)  # [BQ, 1]
 
     dq = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
 
@@ -152,8 +264,8 @@ def _dkv_step(
     the ``dq_acc`` accumulation on top of identical S/P/dP/ds math."""
     q = q_ref[pl.ds(i * block_q, block_q), :]
     do = do_ref[pl.ds(i * block_q, block_q), :]
-    lse = lse_ref[pl.ds(i, 1), :].reshape(block_q, 1)
-    delta = delta_ref[pl.ds(i, 1), :].reshape(block_q, 1)
+    lse = _row(lse_ref, i, block_q)
+    delta = _row(delta_ref, i, block_q)
     s = scale * jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )  # [BQ, BK]
@@ -222,12 +334,13 @@ def _dkvq_kernel(
     S = QK^T and dP = dO V^T in BOTH passes — 7 block matmuls executed for
     the 5 the MFU accounting counts (measured: bwd trailed fwd by exactly
     that ~1.4× on a v5e at D=128). Here the grid's k-block dimension runs
-    sequentially on the core, so dQ accumulates across grid steps in a
-    persistent fp32 VMEM scratch: S and dP are computed ONCE and all five
-    products (dV, dK, dQ + the two recomputes) come out of one sweep.
-    Scratch is zeroed at the first k-block and flushed to ``dq_ref`` at the
-    last; q/do stay VMEM-resident (same full-block residency the split
-    dkv kernel already required).
+    sequentially on the core (pinned via dimension_semantics — see the
+    pallas_call site), so dQ accumulates across grid steps in a persistent
+    fp32 VMEM scratch: S and dP are computed ONCE and all five products
+    (dV, dK, dQ + the two recomputes) come out of one sweep. Scratch is
+    zeroed at the first k-block and flushed to ``dq_ref`` at the last;
+    q/do stay VMEM-resident (same full-block residency the split dkv
+    kernel already required).
     """
     kj = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -266,52 +379,52 @@ def _dkvq_kernel(
         dq_ref[:] = dq_acc[...].astype(dq_ref.dtype)
 
 
-def _specs(block_q, block_k, t, d):
-    qspec = pl.BlockSpec((None, None, block_q, d), lambda bi, hi, i: (bi, hi, i, 0))
-    kvfull = pl.BlockSpec((None, None, t, d), lambda bi, hi, i: (bi, hi, 0, 0))
-    # lse/delta live in a block-aligned [B, H, nq, BQ] layout; always mapped
-    # as the FULL (nq, BQ) block — block == array dims satisfies Mosaic's
-    # tiling rule for any block_q, and programs index their own row
-    lse_full = pl.BlockSpec(
-        (None, None, t // block_q, block_q), lambda bi, hi, i: (bi, hi, 0, 0)
+def _specs(block_q, block_k, t, d, q_span: int = 1):
+    qspec = pl.BlockSpec(
+        (None, None, block_q * q_span, d), lambda bi, hi, i: (bi, hi, i, 0)
     )
-    return qspec, kvfull, lse_full
+    kvfull = pl.BlockSpec((None, None, t, d), lambda bi, hi, i: (bi, hi, 0, 0))
+    # lse/delta live in the block-size-independent [B, H, 1, T] row layout;
+    # always mapped as the FULL (1, T) block — block == array dims satisfies
+    # Mosaic's tiling rule for any T, and programs slice their own rows, so
+    # the backward re-blocks freely with NO relayout of the saved lse
+    lse_row = pl.BlockSpec((None, None, 1, t), lambda bi, hi, i: (bi, hi, 0, 0))
+    return qspec, kvfull, lse_row
 
 
-def _flash_fwd_bthd(q, k, v, *, block_q, block_k, causal, interpret):
-    """q,k,v: [B, H, T, D] → (out [B, H, T, D], lse [B, H, nq, BQ] f32)."""
+def _flash_fwd_bthd(q, k, v, *, block_q, block_k, q_span, causal, interpret):
+    """q,k,v: [B, H, T, D] → (out [B, H, T, D], lse [B, H, 1, T] f32)."""
     b, h, t, d = q.shape
     scale = d ** -0.5
-    grid = (b, h, t // block_q)
-    qspec, kvfull, lse_full = _specs(block_q, block_k, t, d)
+    grid = (b, h, t // (block_q * q_span))
+    qspec, kvfull, lse_row = _specs(block_q, block_k, t, d, q_span)
     kernel = partial(
-        _flash_kernel, block_q=block_q, block_k=block_k, causal=causal, scale=scale
+        _flash_kernel, block_q=block_q, block_k=block_k, q_span=q_span,
+        causal=causal, scale=scale,
     )
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[qspec, kvfull, kvfull],
-        out_specs=[qspec, lse_full],
+        out_specs=[qspec, lse_row],
         out_shape=[
             jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((b, h, t // block_q, block_q), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, 1, t), jnp.float32),
         ],
+        # every program of one (b, h) writes rows of the SAME full lse
+        # block: the q-group dim must not be megacore-split ('arbitrary')
+        compiler_params=_compiler_params("parallel", "parallel", "arbitrary"),
         interpret=interpret,
     )(q, k, v)
 
 
-# backward structure: "fused" = one sweep with a persistent dQ scratch
-# (5 block matmuls, the MFU-accounted minimum); "split" = separate dq/dkv
-# kernels (7 — recomputes S and dP twice); "auto" picks fused whenever the
-# fp32 dQ scratch fits comfortably in VMEM next to resident q/do.
-BWD_MODE = "auto"
 _FUSED_SCRATCH_LIMIT = 4 * 1024 * 1024  # bytes of fp32 [T, D] dQ scratch
 
 
-def _bwd_use_fused(t: int, d: int) -> bool:
-    if BWD_MODE == "fused":
+def _bwd_use_fused(t: int, d: int, mode: str) -> bool:
+    if mode == "fused":
         return True
-    if BWD_MODE == "split":
+    if mode == "split":
         return False
     return t * d * 4 <= _FUSED_SCRATCH_LIMIT
 
@@ -327,20 +440,20 @@ def _dq_scratch(t: int, d: int):
         return [pl.MemorySpace.ANY((t, d), jnp.float32)]
 
 
-def _flash_bwd_bthd(q, k, v, do, lse, delta, *, block_q, block_k, causal, interpret):
+def _flash_bwd_bthd(q, k, v, do, lse, delta, *, block_q, block_k, causal, interpret, bwd_mode):
     b, h, t, d = q.shape
     scale = d ** -0.5
-    qspec, kvfull, lse_full = _specs(block_q, block_k, t, d)
+    qspec, kvfull, lse_row = _specs(block_q, block_k, t, d)
     qfull = pl.BlockSpec((None, None, t, d), lambda bi, hi, i: (bi, hi, 0, 0))
     kvspec = pl.BlockSpec((None, None, block_k, d), lambda bi, hi, j: (bi, hi, j, 0))
 
-    if _bwd_use_fused(t, d):
+    if _bwd_use_fused(t, d, bwd_mode):
         dk, dv, dq = pl.pallas_call(
             partial(
                 _dkvq_kernel, block_q=block_q, block_k=block_k, causal=causal, scale=scale
             ),
             grid=(b, h, t // block_k),
-            in_specs=[qfull, kvspec, kvspec, qfull, lse_full, lse_full],
+            in_specs=[qfull, kvspec, kvspec, qfull, lse_row, lse_row],
             out_specs=[kvspec, kvspec, qfull],
             out_shape=[
                 jax.ShapeDtypeStruct(k.shape, k.dtype),
@@ -348,48 +461,66 @@ def _flash_bwd_bthd(q, k, v, do, lse, delta, *, block_q, block_k, causal, interp
                 jax.ShapeDtypeStruct(q.shape, q.dtype),
             ],
             scratch_shapes=_dq_scratch(t, d),
+            # the k-block dim MUST run sequentially: dq_acc accumulates
+            # across its grid steps (and dq_ref flushes at the last) — this
+            # encodes the requirement instead of relying on the default
+            # semantics happening to serialize (advisor round-5)
+            compiler_params=_compiler_params("parallel", "parallel", "arbitrary"),
             interpret=interpret,
         )(q, k, v, do, lse, delta)
         return dq, dk, dv
 
+    # split kernels write disjoint output blocks and only read the shared
+    # full blocks — every grid dim is safely parallel (megacore-splittable)
+    split_params = _compiler_params("parallel", "parallel", "parallel")
     dq = pl.pallas_call(
         partial(_dq_kernel, block_q=block_q, block_k=block_k, causal=causal, scale=scale),
         grid=(b, h, t // block_q),
-        in_specs=[qspec, kvfull, kvfull, qspec, lse_full, lse_full],
+        in_specs=[qspec, kvfull, kvfull, qspec, lse_row, lse_row],
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        compiler_params=split_params,
         interpret=interpret,
     )(q, k, v, do, lse, delta)
 
     dk, dv = pl.pallas_call(
         partial(_dkv_kernel, block_q=block_q, block_k=block_k, causal=causal, scale=scale),
         grid=(b, h, t // block_k),
-        in_specs=[qfull, kvspec, kvspec, qfull, lse_full, lse_full],
+        in_specs=[qfull, kvspec, kvspec, qfull, lse_row, lse_row],
         out_specs=[kvspec, kvspec],
         out_shape=[
             jax.ShapeDtypeStruct(k.shape, k.dtype),
             jax.ShapeDtypeStruct(v.shape, v.dtype),
         ],
+        compiler_params=split_params,
         interpret=interpret,
     )(q, k, v, do, lse, delta)
     return dq, dk, dv
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def flash_attention(
-    q, k, v, causal: bool = True, block_q: int = 128, block_k: int = 128,
-    interpret: bool = False, block_q_bwd=None, block_k_bwd=None,
+    q, k, v, causal: bool = True, config: Optional[FlashConfig] = None,
+    interpret: bool = False,
 ):
     """Flash attention. q,k,v: [B, T, H, D] (GQA heads pre-repeated).
 
-    ``block_q_bwd`` / ``block_k_bwd`` are explicit overrides; when None the
-    backward picks its own blocks (``_default_bwd_blocks``): the fused
-    single-pass kernel keeps the forward's, the split two-pass upsizes to
-    <=1024 at wide heads (both measured on a v5e at T=4096). The saved
-    log-sum-exp is stored in the forward's block layout and reshaped to the
-    backward's on the XLA side (a free relayout next to the kernel).
+    ``config`` is the STATIC kernel schedule (:class:`FlashConfig` —
+    forward/backward block shapes, q ownership, backward mode); it is a
+    ``custom_vjp`` nondiff argument, so it participates in every enclosing
+    jit's cache key and flipping any knob re-traces. ``None`` resolves the
+    tuned/default config for this (T, D, dtype, causal) through
+    :func:`p2pfl_tpu.ops.autotune.get_flash_config` — but note that this
+    resolution happens at TRACE time against the autotune caches, and the
+    enclosing jit's cache key then contains only ``None``: pinning or
+    autotuning AFTER such a step has compiled does not re-trace it. To
+    keep the schedule live-switchable, resolve the config BEFORE the jit
+    boundary and pass it explicitly (``tiny_transformer`` does exactly
+    this at model-build time). The saved log-sum-exp lives in a
+    block-size-independent ``[B, H, 1, T]`` row layout, so the backward
+    re-blocks freely without relayout.
     """
-    out, _ = _fwd(q, k, v, causal, block_q, block_k, interpret, block_q_bwd, block_k_bwd)
+    out, _ = _fwd(q, k, v, causal, config, interpret)
     return out
 
 
@@ -399,53 +530,61 @@ def _clamp_blocks(t, block_q, block_k):
     return block_q, block_k
 
 
-def _fwd(q, k, v, causal, block_q, block_k, interpret, block_q_bwd=None, block_k_bwd=None):
-    t = q.shape[1]
-    block_q, block_k = _clamp_blocks(t, block_q, block_k)
+def _fit_q_span(t: int, block_q: int, q_span: int) -> int:
+    """Largest span <= q_span that divides the q-block count (a schedule
+    knob degrades gracefully instead of asserting)."""
+    nq = t // block_q
+    return next(s for s in range(min(q_span, nq), 0, -1) if nq % s == 0)
+
+
+def _fwd(q, k, v, causal, config, interpret):
+    t, d = q.shape[1], q.shape[-1]
+    cfg = _resolve(config, t, d, q.dtype, causal)
+    block_q, block_k = _clamp_blocks(t, cfg.block_q, cfg.block_k)
+    q_span = _fit_q_span(t, block_q, cfg.q_span)
     qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
     out, lse = _flash_fwd_bthd(
-        qt, kt, vt, block_q=block_q, block_k=block_k, causal=causal, interpret=interpret
+        qt, kt, vt, block_q=block_q, block_k=block_k, q_span=q_span,
+        causal=causal, interpret=interpret,
     )
     return out.transpose(0, 2, 1, 3), (q, k, v, out, lse)
 
 
-def _default_bwd_blocks(t, d, block_q, block_k):
-    """The ONE place backward block sizes are decided (callers pass
-    ``block_q_bwd`` only to override). Fused single-pass: the forward's own
+def _bwd_blocks(t: int, d: int, cfg: FlashConfig) -> tuple[int, int]:
+    """The ONE place backward block sizes are decided (``block_q_bwd`` /
+    ``block_k_bwd`` only override). Fused single-pass: the forward's own
     blocks are fastest (measured D=128/T=4096: 66.7% MFU at 512 vs 57.5%
     at 1024). Split two-pass at wide heads: the largest block <= 1024
     (measured 56% vs 45% at 512)."""
-    if _bwd_use_fused(t, d):
-        return block_q, block_k
+    if cfg.block_q_bwd is not None or cfg.block_k_bwd is not None:
+        return _clamp_blocks(
+            t, cfg.block_q_bwd or cfg.block_q, cfg.block_k_bwd or cfg.block_k
+        )
+    bq, bk = _clamp_blocks(t, cfg.block_q, cfg.block_k)
+    if _bwd_use_fused(t, d, cfg.bwd_mode):
+        return bq, bk
     if d >= 128:
         big = next(
-            (b for b in range(min(1024, t), block_q, -1) if t % b == 0 and b % 8 == 0),
+            (b for b in range(min(1024, t), bq, -1) if t % b == 0 and b % 8 == 0),
             None,
         )
         if big:
             return big, big
-    return block_q, block_k
+    return bq, bk
 
 
-def _bwd(causal, block_q, block_k, interpret, block_q_bwd, block_k_bwd, res, g):
+def _bwd(causal, config, interpret, res, g):
     q, k, v, out_bhtd, lse = res
-    t = q.shape[1]
-    if block_q_bwd is None and block_k_bwd is None:
-        bq, bk = _default_bwd_blocks(t, q.shape[-1], block_q, block_k)
-    else:
-        bq, bk = block_q_bwd or block_q, block_k_bwd or block_k
-    bq, bk = _clamp_blocks(t, bq, bk)
+    t, d = q.shape[1], q.shape[-1]
+    cfg = _resolve(config, t, d, q.dtype, causal)
+    bq, bk = _bwd_blocks(t, d, cfg)
     b, h = out_bhtd.shape[:2]
     do = g.transpose(0, 2, 1, 3)  # [B, H, T, D]
-    # lse was saved in the FORWARD's [B, H, nq_f, bq_f] block layout;
-    # relayout to the backward's block size (pure reshape — row-major over
-    # the flattened T axis either way)
-    lse = lse.reshape(b, h, t // bq, bq)
-    # Δ_i = Σ_d dO_id · O_id, in the same block-aligned layout as lse
-    delta = (
-        jnp.sum(do.astype(jnp.float32) * out_bhtd.astype(jnp.float32), axis=-1)
-        .reshape(b, h, t // bq, bq)
-    )
+    # Δ_i = Σ_d dO_id · O_id, in the same [B, H, 1, T] row layout as lse
+    # (block-size independent — no relayout whatever blocks the bwd picks)
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out_bhtd.astype(jnp.float32), axis=-1
+    )[:, :, None, :]
     dq, dk, dv = _flash_bwd_bthd(
         q.transpose(0, 2, 1, 3),
         k.transpose(0, 2, 1, 3),
@@ -457,6 +596,7 @@ def _bwd(causal, block_q, block_k, interpret, block_q_bwd, block_k_bwd, res, g):
         block_k=bk,
         causal=causal,
         interpret=interpret,
+        bwd_mode=cfg.bwd_mode,
     )
     return tuple(x.transpose(0, 2, 1, 3) for x in (dq, dk, dv))
 
@@ -480,20 +620,17 @@ except ImportError:  # non-TPU pallas build
     _SMEM_SPEC = pl.BlockSpec(memory_space=None)
 
 
-def _flash_kernel_offs(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k, scale):
-    qi = pl.program_id(2)
-    t = k_ref.shape[0]
-    dt = q_ref.dtype
-    q_off, k_off = offs_ref[0], offs_ref[1]
-    q = q_ref[:]
-
+def _fwd_tile_offs(q, k_ref, v_ref, qi, q_off, k_off, *, block_q, block_k, scale, t):
+    """Offset-aware sibling of :func:`_fwd_tile`: the causal frontier is in
+    GLOBAL coordinates (traced offsets), so the loop bounds are traced."""
+    dt = q.dtype
     acc = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
     m = jnp.full((block_q, 1), NEG_INF, jnp.float32)
     l = jnp.zeros((block_q, 1), jnp.float32)
 
     # causal frontier in global coordinates: stream k blocks whose first
-    # column is <= this q block's last row; blocks whose last column is
-    # <= this q block's first row are fully visible and skip the mask
+    # column is <= this q sub-tile's last row; blocks whose last column is
+    # <= this sub-tile's first row are fully visible and skip the mask
     last_row = q_off + (qi + 1) * block_q - 1
     n_blocks = jnp.clip(lax.div(last_row - k_off, block_k) + 1, 0, t // block_k)
     n_full = jnp.clip(
@@ -526,9 +663,26 @@ def _flash_kernel_offs(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q
 
     acc, m, l = lax.fori_loop(0, n_full, partial(body, masked=False), (acc, m, l))
     acc, m, l = lax.fori_loop(n_full, n_blocks, partial(body, masked=True), (acc, m, l))
-    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
-    lse = jnp.where(m <= NEG_INF / 2, NEG_INF, m + jnp.log(jnp.maximum(l, 1e-30)))
-    lse_ref[pl.ds(qi, 1), :] = lse.reshape(1, block_q)
+    return acc, m, l
+
+
+def _flash_kernel_offs(
+    offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k, q_span, scale
+):
+    t = k_ref.shape[0]
+    q_off, k_off = offs_ref[0], offs_ref[1]
+    for s in range(q_span):
+        qi = pl.program_id(2) * q_span + s
+        q = q_ref[pl.ds(s * block_q, block_q), :]
+        acc, m, l = _fwd_tile_offs(
+            q, k_ref, v_ref, qi, q_off, k_off,
+            block_q=block_q, block_k=block_k, scale=scale, t=t,
+        )
+        o_ref[pl.ds(s * block_q, block_q), :] = (
+            acc / jnp.maximum(l, 1e-30)
+        ).astype(o_ref.dtype)
+        lse = jnp.where(m <= NEG_INF / 2, NEG_INF, m + jnp.log(jnp.maximum(l, 1e-30)))
+        lse_ref[pl.ds(0, 1), pl.ds(qi * block_q, block_q)] = lse.reshape(1, block_q)
 
 
 def _dq_kernel_offs(
@@ -541,10 +695,10 @@ def _dq_kernel_offs(
     q_off, k_off = offs_ref[0], offs_ref[1]
     q = q_ref[:]
     do = do_ref[:]
-    lse = lse_ref[pl.ds(qi, 1), :].reshape(block_q, 1)
-    delta = delta_ref[pl.ds(qi, 1), :].reshape(block_q, 1)
+    lse = _row(lse_ref, qi, block_q)
+    delta = _row(delta_ref, qi, block_q)
     # d lse / d s = softmax row, so the lse cotangent adds into ds
-    glse = glse_ref[pl.ds(qi, 1), :].reshape(block_q, 1)
+    glse = _row(glse_ref, qi, block_q)
 
     dq = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
     last_row = q_off + (qi + 1) * block_q - 1
@@ -587,9 +741,9 @@ def _dkv_step_offs(
     offset backward kernels."""
     q = q_ref[pl.ds(i * block_q, block_q), :]
     do = do_ref[pl.ds(i * block_q, block_q), :]
-    lse = lse_ref[pl.ds(i, 1), :].reshape(block_q, 1)
-    delta = delta_ref[pl.ds(i, 1), :].reshape(block_q, 1)
-    glse = glse_ref[pl.ds(i, 1), :].reshape(block_q, 1)
+    lse = _row(lse_ref, i, block_q)
+    delta = _row(delta_ref, i, block_q)
+    glse = _row(glse_ref, i, block_q)
     s = scale * jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     )
@@ -701,56 +855,68 @@ def _dkvq_kernel_offs(
         dq_ref[:] = dq_acc[...].astype(dq_ref.dtype)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
-def flash_attention_block(q, k, v, q_off, k_off, block_q=128, block_k=128, interpret=False):
+@partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def flash_attention_block(
+    q, k, v, q_off, k_off, config: Optional[FlashConfig] = None,
+    interpret: bool = False,
+):
     """One causal-by-global-offset attention block: q attends k/v where
     ``q_off + i >= k_off + j``. q,k,v: [B, T, H, D] (T = local shard).
     ``q_off``/``k_off`` are traced int32 scalars (e.g. ``axis_index * T``
-    under ``shard_map``). Returns ``(out, lse)`` — the log-sum-exp makes
-    results mergeable across blocks (ring attention hops)."""
-    out, lse, _ = _fab_fwd_impl(q, k, v, q_off, k_off, block_q, block_k, interpret)
+    under ``shard_map``). Returns ``(out, lse)`` — the ``[B, H, 1, T]``
+    log-sum-exp makes results mergeable across blocks (ring attention
+    hops). ``config`` is the same static :class:`FlashConfig` schedule as
+    :func:`flash_attention` (None resolves the tuned/default)."""
+    out, lse, _ = _fab_fwd_impl(q, k, v, q_off, k_off, config, interpret)
     return out, lse
 
 
-def _fab_fwd_impl(q, k, v, q_off, k_off, block_q, block_k, interpret):
+def _fab_fwd_impl(q, k, v, q_off, k_off, config, interpret):
     b, t, h, d = q.shape
-    block_q, block_k = _clamp_blocks(t, block_q, block_k)
+    cfg = _resolve(config, t, d, q.dtype, True)
+    block_q, block_k = _clamp_blocks(t, cfg.block_q, cfg.block_k)
+    q_span = _fit_q_span(t, block_q, cfg.q_span)
     scale = d ** -0.5
     offs = jnp.stack([q_off, k_off]).astype(jnp.int32)
     qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
-    qspec, kvfull, lse_full = _specs(block_q, block_k, t, d)
+    qspec, kvfull, lse_row = _specs(block_q, block_k, t, d, q_span)
     out, lse = pl.pallas_call(
-        partial(_flash_kernel_offs, block_q=block_q, block_k=block_k, scale=scale),
-        grid=(b, h, t // block_q),
+        partial(
+            _flash_kernel_offs, block_q=block_q, block_k=block_k,
+            q_span=q_span, scale=scale,
+        ),
+        grid=(b, h, t // (block_q * q_span)),
         in_specs=[_SMEM_SPEC, qspec, kvfull, kvfull],
-        out_specs=[qspec, lse_full],
+        out_specs=[qspec, lse_row],
         out_shape=[
             jax.ShapeDtypeStruct(qt.shape, q.dtype),
-            jax.ShapeDtypeStruct((b, h, t // block_q, block_q), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, 1, t), jnp.float32),
         ],
+        # shared-write lse row block — same reason as _flash_fwd_bthd
+        compiler_params=_compiler_params("parallel", "parallel", "arbitrary"),
         interpret=interpret,
     )(offs, qt, kt, vt)
     return out.transpose(0, 2, 1, 3), lse, out
 
 
-def _fab_fwd(q, k, v, q_off, k_off, block_q, block_k, interpret):
-    out, lse, out_bhtd = _fab_fwd_impl(q, k, v, q_off, k_off, block_q, block_k, interpret)
+def _fab_fwd(q, k, v, q_off, k_off, config, interpret):
+    out, lse, out_bhtd = _fab_fwd_impl(q, k, v, q_off, k_off, config, interpret)
     return (out, lse), (q, k, v, q_off, k_off, out_bhtd, lse)
 
 
-def _fab_bwd(block_q, block_k, interpret, res, cts):
+def _fab_bwd(config, interpret, res, cts):
     g, g_lse = cts  # the ring merge differentiates through lse too
     q, k, v, q_off, k_off, out_bhtd, lse = res
     b, t, h, d = q.shape
-    block_q, block_k = _clamp_blocks(t, block_q, block_k)
+    cfg = _resolve(config, t, d, q.dtype, True)
+    block_q, block_k = _bwd_blocks(t, d, cfg)
     scale = d ** -0.5
     offs = jnp.stack([q_off, k_off]).astype(jnp.int32)
     do = g.transpose(0, 2, 1, 3)
-    delta = (
-        jnp.sum(do.astype(jnp.float32) * out_bhtd.astype(jnp.float32), axis=-1)
-        .reshape(b, h, t // block_q, block_q)
-    )
-    qspec, kvfull, lse_full = _specs(block_q, block_k, t, d)
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out_bhtd.astype(jnp.float32), axis=-1
+    )[:, :, None, :]
+    qspec, kvfull, lse_row = _specs(block_q, block_k, t, d)
     qfull = pl.BlockSpec((None, None, t, d), lambda bi, hi, i: (bi, hi, 0, 0))
     kvspec = pl.BlockSpec((None, None, block_k, d), lambda bi, hi, j: (bi, hi, j, 0))
     qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
@@ -758,12 +924,12 @@ def _fab_bwd(block_q, block_k, interpret, res, cts):
     # rows invisible in this hop (lse at the -1e30 sentinel) carry no lse
     # gradient; NEG_INF is finite, so compare, don't isfinite
     g_lse = jnp.where(lse <= NEG_INF / 2, 0.0, g_lse.astype(jnp.float32))
-    if _bwd_use_fused(t, d):
+    if _bwd_use_fused(t, d, cfg.bwd_mode):
         dk, dv, dq = pl.pallas_call(
             partial(_dkvq_kernel_offs, block_q=block_q, block_k=block_k, scale=scale),
             grid=(b, h, t // block_k),
             in_specs=[
-                _SMEM_SPEC, qfull, kvspec, kvspec, qfull, lse_full, lse_full, lse_full,
+                _SMEM_SPEC, qfull, kvspec, kvspec, qfull, lse_row, lse_row, lse_row,
             ],
             out_specs=[kvspec, kvspec, qfull],
             out_shape=[
@@ -772,26 +938,31 @@ def _fab_bwd(block_q, block_k, interpret, res, cts):
                 jax.ShapeDtypeStruct(qt.shape, q.dtype),
             ],
             scratch_shapes=_dq_scratch(t, d),
+            # sequential k-block accumulation into dq_acc — see _dkvq_kernel
+            compiler_params=_compiler_params("parallel", "parallel", "arbitrary"),
             interpret=interpret,
         )(offs, qt, kt, vt, do, lse, delta, g_lse)
     else:
+        split_params = _compiler_params("parallel", "parallel", "parallel")
         dq = pl.pallas_call(
             partial(_dq_kernel_offs, block_q=block_q, block_k=block_k, scale=scale),
             grid=(b, h, t // block_q),
-            in_specs=[_SMEM_SPEC, qspec, kvfull, kvfull, qspec, lse_full, lse_full, lse_full],
+            in_specs=[_SMEM_SPEC, qspec, kvfull, kvfull, qspec, lse_row, lse_row, lse_row],
             out_specs=qspec,
             out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+            compiler_params=split_params,
             interpret=interpret,
         )(offs, qt, kt, vt, do, lse, delta, g_lse)
         dk, dv = pl.pallas_call(
             partial(_dkv_kernel_offs, block_q=block_q, block_k=block_k, scale=scale),
             grid=(b, h, t // block_k),
-            in_specs=[_SMEM_SPEC, qfull, kvspec, kvspec, qfull, lse_full, lse_full, lse_full],
+            in_specs=[_SMEM_SPEC, qfull, kvspec, kvspec, qfull, lse_row, lse_row, lse_row],
             out_specs=[kvspec, kvspec],
             out_shape=[
                 jax.ShapeDtypeStruct(kt.shape, k.dtype),
                 jax.ShapeDtypeStruct(vt.shape, v.dtype),
             ],
+            compiler_params=split_params,
             interpret=interpret,
         )(offs, qt, kt, vt, do, lse, delta, g_lse)
     dq, dk, dv = (x.transpose(0, 2, 1, 3) for x in (dq, dk, dv))

@@ -157,7 +157,10 @@ def pipelined_lm_apply(
     to the stage count (the minimum that fills the pipeline). The batch must
     divide into ``n_micro`` microbatches. Same modules and params as
     ``CausalLM.apply`` — forward ``attn_fn`` if the model was built with a
-    non-default attention backend.
+    non-default attention backend. A ``cfg.flash_config`` kernel schedule
+    needs NO threading: the stages build their Blocks from ``cfg``, so the
+    statically-keyed Pallas flash schedule rides into every stage program
+    (and into any enclosing jit's cache key) through the config itself.
 
     MoE blocks (``cfg.n_experts > 0``): sown router losses are collected
     per stage and returned when ``return_aux=True`` (sum over layers, mean

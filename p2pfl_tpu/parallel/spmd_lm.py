@@ -404,6 +404,12 @@ class PipelineFederation:
         self.last_profile: Optional[dict] = None
 
         mesh_, axis_, n_micro_, cfg_ = self.mesh, self.axis, self.n_micro, cfg
+        # thread the model's attention backend into the pipeline stages: a
+        # model built with attn="flash" (or a cfg-pinned FlashConfig) keeps
+        # its statically-keyed kernel schedule inside the pipelined jits —
+        # the closure captures cfg_ and attn_fn_, so a federation rebuilt
+        # with a different schedule compiles a different program
+        attn_fn_ = getattr(model.module, "attn_fn", None)
 
         def epoch(params, opt_state, xs, ys):
             """One pipelined epoch: scan of GPipe train steps over batches."""
@@ -414,7 +420,8 @@ class PipelineFederation:
 
                 def loss_of(pp):
                     logits, aux = pipelined_lm_apply(
-                        pp, bx, cfg_, mesh_, axis_, n_micro=n_micro_, return_aux=True
+                        pp, bx, cfg_, mesh_, axis_, n_micro=n_micro_,
+                        attn_fn=attn_fn_, return_aux=True
                     )
                     ce = optax.softmax_cross_entropy_with_integer_labels(
                         logits, by
@@ -432,7 +439,8 @@ class PipelineFederation:
 
         def eval_acc(params, x, y):
             logits, _aux = pipelined_lm_apply(
-                params, x, cfg_, mesh_, axis_, n_micro=n_micro_, return_aux=True
+                params, x, cfg_, mesh_, axis_, n_micro=n_micro_,
+                attn_fn=attn_fn_, return_aux=True
             )
             return jnp.mean((jnp.argmax(logits, axis=-1) == y).astype(jnp.float32))
 

@@ -120,6 +120,17 @@ class Settings:
     # and LOSES 0.55x at T=512 — the threshold stays 1024.
     # Re-tune with `python bench_suite.py 7` if the model shape changes.
     FLASH_MIN_SEQ_LEN: int = 1024
+    # Autotune the flash-attention kernel schedule at model-build time:
+    # tiny_transformer(attn="flash"|"ring_flash") sweeps (block_q, block_k,
+    # q_span) + backward mode for the model's (seq_len, head_dim, dtype)
+    # and caches the winner (ops/autotune.py — in-process + on-disk, keyed
+    # on device kind). False = pure lookup: pinned config → existing tune
+    # cache → shipped defaults table (no kernels run at build time).
+    FLASH_AUTOTUNE: bool = False
+    # Path of the on-disk autotune cache; "" = the default
+    # ~/.cache/p2pfl_tpu/flash_tune.json (P2PFL_FLASH_TUNE_CACHE env var
+    # also honored).
+    FLASH_TUNE_CACHE: str = ""
     # How long a train-set node waits for peers' secagg_recover seed
     # disclosures after an aggregation timeout with dropouts, before giving
     # the round up (keeping the previous global instead of applying noise).

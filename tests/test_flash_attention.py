@@ -1,4 +1,10 @@
-"""Flash-attention kernel tests (pallas interpret mode on CPU)."""
+"""Flash-attention kernel tests (pallas interpret mode on CPU).
+
+The kernel schedule is the static :class:`FlashConfig` — block shapes,
+q ownership and backward mode all ride explicit config objects here (the
+old module-global ``BWD_MODE`` is gone; see test_kernel_config.py for the
+jit cache-key / staleness coverage).
+"""
 
 import jax
 import jax.numpy as jnp
@@ -6,7 +12,7 @@ import numpy as np
 import pytest
 
 from p2pfl_tpu.ops.attention import causal_attention
-from p2pfl_tpu.ops.flash_attention import flash_attention
+from p2pfl_tpu.ops.flash_attention import FlashConfig, flash_attention
 
 
 def _qkv(b=2, t=128, h=4, d=32, seed=0, dtype=jnp.float32):
@@ -17,7 +23,7 @@ def _qkv(b=2, t=128, h=4, d=32, seed=0, dtype=jnp.float32):
 def test_flash_matches_dense_causal():
     q, k, v = _qkv()
     want = causal_attention(q, k, v)
-    got = flash_attention(q, k, v, True, 32, 32, True)  # interpret mode
+    got = flash_attention(q, k, v, True, FlashConfig(32, 32), True)  # interpret
     np.testing.assert_allclose(np.asarray(want), np.asarray(got), atol=2e-5)
 
 
@@ -27,7 +33,7 @@ def test_flash_non_causal():
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (d**-0.5)
     p = jax.nn.softmax(s, axis=-1)
     want = jnp.einsum("bhqk,bkhd->bqhd", p, v)
-    got = flash_attention(q, k, v, False, 32, 32, True)
+    got = flash_attention(q, k, v, False, FlashConfig(32, 32), True)
     np.testing.assert_allclose(np.asarray(want), np.asarray(got), atol=2e-5)
 
 
@@ -35,7 +41,25 @@ def test_flash_uneven_blocks():
     """block_q != block_k and T not equal to block sizes."""
     q, k, v = _qkv(t=96)
     want = causal_attention(q, k, v)
-    got = flash_attention(q, k, v, True, 32, 48, True)
+    got = flash_attention(q, k, v, True, FlashConfig(32, 48), True)
+    np.testing.assert_allclose(np.asarray(want), np.asarray(got), atol=2e-5)
+
+
+def test_flash_default_config_resolves():
+    """config=None resolves through the autotune lookup chain (defaults
+    table on this platform) and still matches dense."""
+    q, k, v = _qkv(t=64)
+    want = causal_attention(q, k, v)
+    got = flash_attention(q, k, v, True, None, True)
+    np.testing.assert_allclose(np.asarray(want), np.asarray(got), atol=2e-5)
+
+
+@pytest.mark.parametrize("q_span", [2, 4])
+def test_flash_q_span_matches_dense(q_span):
+    """Wider q ownership per program is a pure schedule change."""
+    q, k, v = _qkv(t=128)
+    want = causal_attention(q, k, v)
+    got = flash_attention(q, k, v, True, FlashConfig(16, 32, q_span=q_span), True)
     np.testing.assert_allclose(np.asarray(want), np.asarray(got), atol=2e-5)
 
 
@@ -44,7 +68,7 @@ def test_flash_gradient_matches_dense():
     q, k, v = _qkv(b=1, t=32, h=2, d=16)
 
     def loss_flash(q, k, v):
-        return jnp.sum(flash_attention(q, k, v, True, 16, 16, True) ** 2)
+        return jnp.sum(flash_attention(q, k, v, True, FlashConfig(16, 16), True) ** 2)
 
     def loss_dense(q, k, v):
         return jnp.sum(causal_attention(q, k, v) ** 2)
@@ -150,21 +174,19 @@ def test_flash_resolver_rejects_unknown():
 @pytest.mark.slow
 def test_bwd_specific_blocks_match_shared_blocks():
     """block_q_bwd/block_k_bwd change only the backward SCHEDULE: gradients
-    must match the shared-block configuration (the saved lse is relayouted
-    from the forward's block layout to the backward's)."""
+    must match the shared-block configuration (the saved lse's [B, H, 1, T]
+    row layout is block-size independent — no relayout either way)."""
     q, k, v = _qkv(t=256, h=2)
 
-    def loss(blocks_bwd):
+    def loss(config):
         def f(q_, k_, v_):
-            o = flash_attention(
-                q_, k_, v_, True, 64, 64, True, blocks_bwd, blocks_bwd
-            )
+            o = flash_attention(q_, k_, v_, True, config, True)
             return jnp.sum(o * o)
 
         return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
 
-    g_shared = loss(None)       # bwd uses the fwd's 64-blocks
-    g_bwd128 = loss(128)        # bwd re-blocks to 128
+    g_shared = loss(FlashConfig(64, 64))  # bwd uses the fwd's 64-blocks
+    g_bwd128 = loss(FlashConfig(64, 64, block_q_bwd=128, block_k_bwd=128))
     for a, bb in zip(g_shared, g_bwd128):
         np.testing.assert_allclose(np.asarray(a), np.asarray(bb), atol=1e-4)
 
@@ -173,47 +195,36 @@ def test_bwd_specific_blocks_match_shared_blocks():
 def test_fused_bwd_matches_split(causal):
     """The single-pass dkvq kernel (persistent dQ scratch across k-block
     grid steps) must produce the SAME gradients as the split dq/dkv pair —
-    it only removes the S/dP recompute, not any math."""
-    from p2pfl_tpu.ops import flash_attention as fa
-
+    it only removes the S/dP recompute, not any math. bwd_mode is now an
+    explicit static config knob, not a module global."""
     q, k, v = _qkv(b=2, t=128, h=2, d=16)
 
-    def grads():
+    def grads(mode):
         def f(q_, k_, v_):
-            o = fa.flash_attention(q_, k_, v_, causal, 32, 64, True)
+            o = flash_attention(
+                q_, k_, v_, causal, FlashConfig(32, 64, bwd_mode=mode), True
+            )
             return jnp.sum(o * o)
 
         return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
 
-    old = fa.BWD_MODE
-    try:
-        fa.BWD_MODE = "split"
-        g_split = grads()
-        fa.BWD_MODE = "fused"
-        g_fused = grads()
-    finally:
-        fa.BWD_MODE = old
+    g_split = grads("split")
+    g_fused = grads("fused")
     for a, b in zip(g_fused, g_split):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
 def test_fused_bwd_matches_dense_gradient():
-    from p2pfl_tpu.ops import flash_attention as fa
-
     q, k, v = _qkv(b=1, t=64, h=2, d=16)
 
     def loss_flash(q, k, v):
-        return jnp.sum(fa.flash_attention(q, k, v, True, 16, 32, True) ** 2)
+        o = flash_attention(q, k, v, True, FlashConfig(16, 32, bwd_mode="fused"), True)
+        return jnp.sum(o**2)
 
     def loss_dense(q, k, v):
         return jnp.sum(causal_attention(q, k, v) ** 2)
 
-    old = fa.BWD_MODE
-    try:
-        fa.BWD_MODE = "fused"
-        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
-    finally:
-        fa.BWD_MODE = old
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
     gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(gf, gd):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
@@ -226,27 +237,22 @@ def test_fused_bwd_offs_matches_split():
 
     q, k, v = _qkv(b=1, t=64, h=2, d=16)
 
-    def grads(q_off, k_off):
+    def grads(q_off, k_off, mode):
         def f(q_, k_, v_):
             o, lse = fa.flash_attention_block(
-                q_, k_, v_, jnp.int32(q_off), jnp.int32(k_off), 16, 32, True
+                q_, k_, v_, jnp.int32(q_off), jnp.int32(k_off),
+                FlashConfig(16, 32, bwd_mode=mode), True,
             )
             # touch BOTH outputs so the lse cotangent is non-trivial
             return jnp.sum(o * o) + jnp.sum(jnp.where(lse <= -5e29, 0.0, lse)) * 1e-3
 
         return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
 
-    old = fa.BWD_MODE
-    try:
-        for q_off, k_off in ((0, 0), (64, 0), (0, 64), (64, 64)):
-            fa.BWD_MODE = "split"
-            g_split = grads(q_off, k_off)
-            fa.BWD_MODE = "fused"
-            g_fused = grads(q_off, k_off)
-            for a, b in zip(g_fused, g_split):
-                np.testing.assert_allclose(
-                    np.asarray(a), np.asarray(b), atol=1e-5,
-                    err_msg=f"offsets ({q_off}, {k_off})",
-                )
-    finally:
-        fa.BWD_MODE = old
+    for q_off, k_off in ((0, 0), (64, 0), (0, 64), (64, 64)):
+        g_split = grads(q_off, k_off, "split")
+        g_fused = grads(q_off, k_off, "fused")
+        for a, b in zip(g_fused, g_split):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-5,
+                err_msg=f"offsets ({q_off}, {k_off})",
+            )

@@ -80,6 +80,41 @@ def test_cache_invalidated_on_set_parameters_and_fit():
     assert W.encode_call_count() - before == 1  # fresh encode, not a replay
 
 
+def test_subclass_learners_bump_model_version():
+    """Every learner whose fit/set_parameters override bypasses JaxLearner
+    must bump the model version itself — a missed bump makes the payload
+    cache replay STALE bytes (e.g. untrained adapters gossiped as the
+    round's trained contribution)."""
+    from p2pfl_tpu.learning.dataset import FederatedDataset
+    from p2pfl_tpu.learning.lora import LoRALearner
+    from p2pfl_tpu.learning.personalization import PersonalizedLearner
+    from p2pfl_tpu.models import mlp
+    from p2pfl_tpu.models.transformer import TransformerConfig, tiny_transformer
+
+    cfg = TransformerConfig(
+        vocab_size=64, dim=32, n_layers=1, n_heads=2, n_kv_heads=2,
+        ffn_hidden=64, lora_rank=4,
+    )
+    data = FederatedDataset.synthetic_lm(
+        vocab_size=64, seq_len=16, n_train=16, n_test=8
+    )
+    lora = LoRALearner(tiny_transformer(seq_len=16, cfg=cfg), data, batch_size=8, epochs=1)
+    v0 = lora.model_version
+    lora.fit()
+    assert lora.model_version > v0, "LoRALearner.fit must bump the version"
+    v1 = lora.model_version
+    lora.set_parameters(lora.get_parameters())
+    assert lora.model_version > v1, "LoRALearner.set_parameters must bump"
+
+    mnist = FederatedDataset.synthetic_mnist(n_train=64, n_test=16)
+    pers = PersonalizedLearner(
+        mlp(seed=0), mnist, batch_size=32, personal=("Dense_2",)
+    )
+    v0 = pers.model_version
+    pers.set_parameters(pers.params)
+    assert pers.model_version > v0, "PersonalizedLearner.set_parameters must bump"
+
+
 def test_cache_disabled_reencodes_per_send():
     Settings.GOSSIP_PAYLOAD_CACHE = False
     learner = DummyLearner()

@@ -1,0 +1,270 @@
+"""Kernel-config contract tests: FlashConfig numerics across schedules,
+jit cache-key participation (the staleness regression the old ``BWD_MODE``
+module global could not catch), and the autotune cache chain
+(pinned → in-process → on-disk → defaults table). All interpret-mode, CPU
+tier-1."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2pfl_tpu.ops import autotune
+from p2pfl_tpu.ops.attention import causal_attention
+from p2pfl_tpu.ops.flash_attention import FlashConfig, flash_attention
+
+
+def _qkv(b=1, t=64, h=2, d=64, seed=0):
+    keys = jax.random.split(jax.random.PRNGKey(seed), 3)
+    return tuple(jax.random.normal(k, (b, t, h, d), jnp.float32) for k in keys)
+
+
+def _dense(q, k, v, causal):
+    if causal:
+        return causal_attention(q, k, v)
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (d**-0.5)
+    return jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1), v)
+
+
+# two deliberately non-default schedules: uneven blocks, wide q ownership,
+# and both backward structures
+_CONFIGS = [
+    FlashConfig(block_q=16, block_k=32, q_span=2, bwd_mode="fused"),
+    FlashConfig(block_q=32, block_k=16, bwd_mode="split",
+                block_q_bwd=16, block_k_bwd=32),
+]
+
+
+@pytest.mark.parametrize("d", [64, 128])
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("cfg_i", [0, 1])
+def test_forward_parity_across_head_dims(d, causal, cfg_i):
+    """Tuned forward == dense reference at the production head widths."""
+    q, k, v = _qkv(t=64, d=d)
+    want = _dense(q, k, v, causal)
+    got = flash_attention(q, k, v, causal, _CONFIGS[cfg_i], True)
+    np.testing.assert_allclose(np.asarray(want), np.asarray(got), atol=3e-5)
+
+
+@pytest.mark.parametrize("t", [48, 96])
+def test_forward_parity_ragged_seq(t):
+    """Ragged sequence lengths (not a power of two, not a multiple of the
+    default blocks): explicit dividing configs still match dense."""
+    q, k, v = _qkv(t=t, d=64)
+    want = _dense(q, k, v, True)
+    got = flash_attention(q, k, v, True, FlashConfig(16, 24), True)
+    np.testing.assert_allclose(np.asarray(want), np.asarray(got), atol=3e-5)
+    # default-config path must also fit ragged lengths (divisor clamping)
+    got_def = flash_attention(q, k, v, True, None, True)
+    np.testing.assert_allclose(np.asarray(want), np.asarray(got_def), atol=3e-5)
+
+
+@pytest.mark.parametrize("d", [64, 128])
+@pytest.mark.parametrize("cfg_i", [0, 1])
+def test_gradient_parity_across_configs(d, cfg_i):
+    """Backward parity vs dense under both backward structures and
+    bwd-specific blocks."""
+    q, k, v = _qkv(t=32, d=d)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, True, _CONFIGS[cfg_i], True) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(causal_attention(q, k, v) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
+def test_config_participates_in_jit_cache_key():
+    """THE staleness regression (ADVICE r5): flipping any kernel knob after
+    a step has compiled must re-trace. FlashConfig is hashable and compares
+    by value, so equal configs hit the compiled program and different ones
+    (including a bwd_mode-only change — invisible to the old global) miss.
+    """
+    q, k, v = _qkv(t=32, d=16)
+    traces = []  # appended at TRACE time: its length counts compilations
+
+    step = jax.jit(
+        lambda q, k, v, config: (
+            traces.append(config),
+            flash_attention(q, k, v, True, config, True).sum(),
+        )[1],
+        static_argnames=("config",),
+    )
+
+    base = FlashConfig(block_q=16, block_k=16)
+    step(q, k, v, base)
+    assert len(traces) == 1
+    # an EQUAL but distinct instance: cache hit, no re-trace
+    step(q, k, v, FlashConfig(block_q=16, block_k=16))
+    assert len(traces) == 1
+    # block change: re-trace
+    step(q, k, v, FlashConfig(block_q=16, block_k=32))
+    assert len(traces) == 2
+    # bwd_mode-only change: re-trace (the old BWD_MODE global silently
+    # did NOT — the compiled fused/split choice went stale)
+    step(q, k, v, dataclasses.replace(base, bwd_mode="fused"))
+    assert len(traces) == 3
+    step(q, k, v, dataclasses.replace(base, bwd_mode="split"))
+    assert len(traces) == 4
+    # q_span-only change: re-trace
+    step(q, k, v, dataclasses.replace(base, q_span=2))
+    assert len(traces) == 5
+
+
+def test_bwd_mode_retrace_changes_gradients_not_values():
+    """jit(grad) keyed on config: both modes compile separately and agree
+    numerically — proving the re-trace actually switches kernel structure.
+    """
+    q, k, v = _qkv(t=32, d=16)
+
+    def grads(config):
+        def loss(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, True, config, True) ** 2)
+
+        fn = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        return fn(q, k, v)
+
+    gf = grads(FlashConfig(16, 16, bwd_mode="fused"))
+    gs = grads(FlashConfig(16, 16, bwd_mode="split"))
+    for a, b in zip(gf, gs):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_transformer_config_carries_flash_config():
+    """cfg.flash_config makes the schedule reachable from the model config:
+    it changes the (frozen, hashable) TransformerConfig identity — so any
+    jit that treats module/config as static re-traces — and the built model
+    actually runs the pinned kernel, matching dense numerics."""
+    from p2pfl_tpu.models.transformer import TransformerConfig, tiny_transformer
+
+    fc_a = FlashConfig(block_q=16, block_k=16)
+    fc_b = FlashConfig(block_q=16, block_k=16, bwd_mode="split")
+    base = dict(
+        vocab_size=64, dim=32, n_layers=1, n_heads=2, n_kv_heads=2,
+        ffn_hidden=64, dtype=jnp.float32,
+    )
+    cfg_a = TransformerConfig(**base, flash_config=fc_a)
+    cfg_b = TransformerConfig(**base, flash_config=fc_b)
+    assert cfg_a != cfg_b and hash(cfg_a) != hash(cfg_b)
+
+    m_flash = tiny_transformer(seq_len=32, cfg=cfg_a, seed=4)  # no attn= needed
+    m_dense = tiny_transformer(seq_len=32, cfg=TransformerConfig(**base), seed=4)
+    toks = (jnp.arange(32, dtype=jnp.int32) % 64)[None]
+    np.testing.assert_allclose(
+        np.asarray(m_flash.apply(m_flash.params, toks)),
+        np.asarray(m_dense.apply(m_dense.params, toks)),
+        atol=5e-2,
+    )
+
+
+def test_autotune_cache_roundtrip(tmp_path):
+    """autotune → disk cache → fresh process state → get_flash_config hit
+    (write → reload → hit, the CI smoke invariant)."""
+    from p2pfl_tpu.settings import Settings
+
+    cache = tmp_path / "tune.json"
+    old = Settings.FLASH_TUNE_CACHE
+    Settings.FLASH_TUNE_CACHE = str(cache)
+    try:
+        autotune.clear_memory_cache()
+        cands = [FlashConfig(16, 16), FlashConfig(32, 32)]
+        best = autotune.autotune_flash(
+            32, 16, dtype=jnp.float32, candidates=cands, repeats=1, tune_bwd=False
+        )
+        assert best in cands
+        assert cache.exists()
+        # wipe in-process state: the disk entry must serve the config
+        autotune.clear_memory_cache()
+        got = autotune.get_flash_config(32, 16, dtype=jnp.float32)
+        assert got == best
+        # a different shape misses the cache and falls to the defaults table
+        other = autotune.get_flash_config(64, 128, dtype=jnp.float32)
+        assert other == autotune.default_flash_config(64, 128, jnp.float32)
+    finally:
+        Settings.FLASH_TUNE_CACHE = old
+        autotune.clear_memory_cache()
+
+
+def test_autotune_cache_hit_skips_sweep(tmp_path):
+    """A second autotune for a tuned shape returns the cached winner
+    without re-sweeping (FLASH_AUTOTUNE model builds pay once per shape):
+    if the sweep ran again it would have to return the new candidate."""
+    from p2pfl_tpu.settings import Settings
+
+    old = Settings.FLASH_TUNE_CACHE
+    Settings.FLASH_TUNE_CACHE = str(tmp_path / "tune.json")
+    try:
+        autotune.clear_memory_cache()
+        first = autotune.autotune_flash(
+            32, 16, dtype=jnp.float32, candidates=[FlashConfig(16, 16)],
+            repeats=1, tune_bwd=False,
+        )
+        again = autotune.autotune_flash(
+            32, 16, dtype=jnp.float32, candidates=[FlashConfig(32, 32)],
+            repeats=1, tune_bwd=False,
+        )
+        assert again == first == FlashConfig(16, 16)
+        forced = autotune.autotune_flash(
+            32, 16, dtype=jnp.float32, candidates=[FlashConfig(32, 32)],
+            repeats=1, tune_bwd=False, force=True,
+        )
+        assert forced == FlashConfig(32, 32)
+    finally:
+        Settings.FLASH_TUNE_CACHE = old
+        autotune.clear_memory_cache()
+
+
+def test_pins_never_persisted_to_disk(tmp_path):
+    """pin_flash_config is a session-only override: a subsequent cache
+    write (autotune) must not leak the pin into the on-disk tuning data."""
+    import json
+
+    from p2pfl_tpu.settings import Settings
+
+    cache = tmp_path / "tune.json"
+    old = Settings.FLASH_TUNE_CACHE
+    Settings.FLASH_TUNE_CACHE = str(cache)
+    try:
+        autotune.clear_memory_cache()
+        pin = FlashConfig(block_q=8, block_k=8)
+        autotune.pin_flash_config(64, 32, pin, dtype=jnp.float32)
+        autotune.autotune_flash(
+            32, 16, dtype=jnp.float32, candidates=[FlashConfig(16, 16)],
+            repeats=1, tune_bwd=False,
+        )
+        raw = json.loads(cache.read_text())
+        assert not any("d=32|t=64" in k for k in raw), raw
+        # the pin still wins in-process
+        assert autotune.get_flash_config(64, 32, dtype=jnp.float32) == pin
+    finally:
+        Settings.FLASH_TUNE_CACHE = old
+        autotune.clear_memory_cache()
+
+
+def test_pinned_config_wins_over_defaults():
+    autotune.clear_memory_cache()
+    try:
+        pin = FlashConfig(block_q=8, block_k=8, q_span=2)
+        autotune.pin_flash_config(64, 32, pin, dtype=jnp.float32)
+        assert autotune.get_flash_config(64, 32, dtype=jnp.float32) == pin
+    finally:
+        autotune.clear_memory_cache()
+
+
+def test_defaults_table_fits_shape():
+    """Defaults always divide T, tile on Mosaic (multiple of 8 or T itself)
+    and keep q_span dividing the q-block count."""
+    for t in (8, 32, 96, 512, 2048):
+        for d in (32, 64, 128, 256):
+            for kind in ("TPU v4", "TPU v5 lite", "cpu"):
+                cfg = autotune.default_flash_config(t, d, kind=kind)
+                assert t % cfg.block_q == 0 and t % cfg.block_k == 0
+                nq = t // cfg.block_q
+                assert nq % cfg.q_span == 0

@@ -23,17 +23,20 @@ def _qkv(t=64, b=2, h=2, d=16, seed=0):
 @pytest.mark.slow
 def test_block_offsets_cover_visibility_cases():
     """Diagonal (causal), fully-visible, and fully-masked offset blocks."""
+    from p2pfl_tpu.ops.flash_attention import FlashConfig
+
+    cfg8 = FlashConfig(block_q=8, block_k=8)
     q, k, v = _qkv(t=16)
     # diagonal: q_off == k_off => plain causal over the block
-    out, lse = flash_attention_block(q, k, v, 0, 0, block_q=8, block_k=8, interpret=True)
+    out, lse = flash_attention_block(q, k, v, 0, 0, cfg8, True)
     np.testing.assert_allclose(
         np.asarray(out), np.asarray(causal_attention(q, k, v)), atol=2e-5, rtol=1e-4
     )
     # fully visible: q rows all AFTER k cols => no masking anywhere
-    out_full, lse_full = flash_attention_block(q, k, v, 100, 0, block_q=8, block_k=8, interpret=True)
+    out_full, lse_full = flash_attention_block(q, k, v, 100, 0, cfg8, True)
     assert bool(jnp.isfinite(out_full).all()) and bool(jnp.isfinite(lse_full).all())
     # fully masked: k cols all after q rows => zero output, -inf lse
-    out_none, lse_none = flash_attention_block(q, k, v, 0, 100, block_q=8, block_k=8, interpret=True)
+    out_none, lse_none = flash_attention_block(q, k, v, 0, 100, cfg8, True)
     np.testing.assert_allclose(np.asarray(out_none), 0.0)
     assert bool((lse_none < -1e29).all())
 

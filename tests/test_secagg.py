@@ -937,30 +937,59 @@ def test_secagg_mask_lone_member_direct_no_shamir_crash():
     np.testing.assert_array_equal(np.asarray(out.params["w"]), u.params["w"])
 
 
-def test_share_index_cap_scales_with_membership():
-    """ADVICE r4: the share/reveal index sanity cap derives from the live
-    train set (2x membership, 1024 floor) instead of a hard 1024 — a
-    >1025-member federation's high share indices must be stored, and junk
-    far beyond the cap still rejected."""
-    from p2pfl_tpu.commands.control import SecAggShareCommand
+def _share_state(round_no=1):
     from p2pfl_tpu.node_state import NodeState
 
     st = NodeState("me")
-    st.round = 1
+    st.round = round_no
     st.experiment_name = "exp"
     priv_o, pub_o = secagg.dh_keypair()
-    st.secagg_priv, _my_pub = secagg.dh_keypair()
+    st.secagg_priv, my_pub = secagg.dh_keypair()
     st.secagg_pubs["owner"] = (pub_o, 5)
-    st.train_set = {f"n{i}" for i in range(1500)} | {"me", "owner"}
+    key = secagg.dh_share_key(priv_o, my_pub, "exp")
+    return st, key
 
-    key = secagg.dh_share_key(priv_o, _my_pub, "exp")
+
+def test_share_index_cap_derives_from_message():
+    """ISSUE 2 satellite: the share-index sanity cap derives from the
+    MESSAGE (one triple per holder in the sender's broadcast — x runs
+    1..n_holders over its sorted holder list), not from our instantaneous
+    train set. A >1024-member federation's high indices must be stored, and
+    an index beyond the sender's own holder count rejected."""
+    from p2pfl_tpu.commands.control import SecAggShareCommand
+
+    st, key = _share_state()
+    st.train_set = {f"n{i}" for i in range(1500)} | {"me", "owner"}
     cmd = SecAggShareCommand(st)
-    # share index 1400 (> the old hard 1024 cap, <= 2x membership): stored
     ct = secagg.encrypt_share(12345, key, 1, "owner", "me").hex()
-    cmd.execute("owner", 1, "exp", "me", "1400", ct)
+    # a 1400-holder broadcast (only our triple is real — foreign holders'
+    # ciphertexts are never decrypted) with our index at 1400: stored
+    filler = [e for i in range(1399) for e in (f"n{i}", str(i + 1), "00")]
+    cmd.execute("owner", 1, "exp", *filler, "me", "1400", ct)
     assert st.secagg_shares_held.get((1, "owner")) == (1400, 12345)
-    # far beyond the cap: rejected (not stored)
+    # an index beyond the sender's own holder list: rejected (not stored) —
+    # a forged point at an unused x must not reach Lagrange reconstruction
     st.secagg_shares_held.clear()
-    cmd.execute("owner", 1, "exp", "me", str(2 * 1502 + 1), ct)
+    cmd.execute("owner", 1, "exp", *filler, "me", "1401", ct)
     assert (1, "owner") not in st.secagg_shares_held
+
+
+def test_share_for_next_round_accepted_before_train_set_latches():
+    """ISSUE 2 satellite regression: a share for round r+1 arriving from a
+    fast peer BEFORE our local train set latches (len(train_set)=0) must be
+    judged against the message's holder count, not our empty membership —
+    the old instantaneous len(train_set)-vs-1024 cap made acceptance depend
+    on arrival timing."""
+    from p2pfl_tpu.commands.control import SecAggShareCommand
+
+    st, key = _share_state(round_no=1)
+    st.train_set = set()  # round r+1 share lands before our vote resolves
+    cmd = SecAggShareCommand(st)
+    ct = secagg.encrypt_share(777, key, 2, "owner", "me").hex()
+    cmd.execute("owner", 2, "exp", "a", "1", "00", "me", "2", ct, "z", "3", "00")
+    assert st.secagg_shares_held.get((2, "owner")) == (2, 777)
+    # same early window, index past the 3-holder message: rejected
+    st.secagg_shares_held.clear()
+    cmd.execute("owner", 2, "exp", "a", "1", "00", "me", "4", ct, "z", "3", "00")
+    assert (2, "owner") not in st.secagg_shares_held
 
