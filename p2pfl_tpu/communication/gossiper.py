@@ -70,6 +70,13 @@ class Gossiper:
 
     def start(self) -> None:
         self._stop.clear()
+        with self._stalled_lock:
+            # a send that hung past stop() never runs its done-callback
+            # (shutdown can't cancel RUNNING tasks), so its _stalled entry
+            # would outlive the old pool and silently exclude that neighbor
+            # from every future tick; a fresh start gets a clean slate (the
+            # orphaned callback's identity check no-ops against new entries)
+            self._stalled.clear()
         self._pool = ThreadPoolExecutor(
             max_workers=max(1, Settings.GOSSIP_SEND_WORKERS),
             thread_name_prefix=f"gossip-send-{self.self_addr}",

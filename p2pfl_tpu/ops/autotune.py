@@ -385,5 +385,10 @@ def autotune_flash(
 
     if cache:
         _MEM_CACHE[_key(kind, d, t, dtype, causal)] = best
+        # merge existing on-disk entries before writing: a force=True tune
+        # skips the read path above, and saving bare _MEM_CACHE would clobber
+        # every other shape/device entry the file holds (_load_disk's
+        # setdefault keeps the fresh winner over the stale disk copy)
+        _load_disk(cache_path())
         _save_disk(cache_path())
     return best

@@ -15,6 +15,16 @@ applied to the federated-node axis:
 - FedAvg becomes a running (partial-sum, weight) accumulation across
   chunks, so the resident set is one aggregate + one chunk's workspace —
   nothing per-node ever leaves the device or lands in host RAM;
+- the running sums ride DONATED accumulator arguments through the chunk
+  program (``Settings.CHUNK_FUSED_REDUCE`` / ``CHUNK_DONATE_BUFFERS``):
+  chunk k's partial sum updates in place inside the same dispatch that
+  trains the chunk, instead of the host issuing 2×leaf-count eager adds
+  between chunks — the serialization the round-5 ``gap_attribution``
+  measured behind "broadcast + fp32 reduce";
+- chunk inputs are staged ``Settings.CHUNK_STAGING_DEPTH`` chunks ahead
+  (double buffering at the default 2): chunk k+1's host→device copies
+  (perm indices, and x/y when ``resident=False`` streams the dataset
+  from host RAM) overlap chunk k's compute instead of following it;
 - optimizer moments are AGGREGATED with the same weighted mean as the
   params ("federated moment averaging"). Per-node moments would need
   N × 2 × params of storage — exactly the state that doesn't fit — and
@@ -62,9 +72,8 @@ def _is_inexact(x) -> bool:
     return jnp.issubdtype(x.dtype, jnp.inexact)
 
 
-@partial(jax.jit, static_argnames=("module", "tx", "remat"))
-def _chunk_round(agg_params, agg_opt, x, y, perm, mask, weights, *, module, tx, remat):
-    """One chunk's round contribution.
+def _chunk_contrib(agg_params, agg_opt, x, y, perm, mask, weights, module, tx, remat):
+    """One chunk's round contribution (trace-time body).
 
     Broadcast the aggregate to C slots, run each slot's scan-epochs, and
     reduce to (weighted param sum, weighted opt sum, total weight, loss).
@@ -102,6 +111,85 @@ def _chunk_round(agg_params, agg_opt, x, y, perm, mask, weights, *, module, tx, 
     return psum, osum, jnp.sum(w), loss
 
 
+@partial(jax.jit, static_argnames=("module", "tx", "remat"))
+def _chunk_round(agg_params, agg_opt, x, y, perm, mask, weights, *, module, tx, remat):
+    """Serial-path chunk program: contribution only, reduce on host.
+
+    Kept verbatim as the reference semantics — the overlapped path's
+    bit-parity test (tests/test_chunked.py) compares against it.
+    """
+    return _chunk_contrib(agg_params, agg_opt, x, y, perm, mask, weights, module, tx, remat)
+
+
+def _chunk_round_acc_impl(
+    psum, osum, wsum, loss_sum, agg_params, agg_opt, x, y, perm, mask, weights,
+    *, module, tx, remat,
+):
+    """Fused-reduce chunk program: train the chunk AND fold its weighted
+    contribution into the running accumulators in the same dispatch.
+
+    fp32 zero-init + in-program adds keep the accumulation order identical
+    to the host-side serial reduce (0 + x ≡ x in fp32), so the overlapped
+    path stays numerically exact against it. Integer opt leaves (schedule
+    step counts) are identical across chunks; the chunk's own value passes
+    through.
+    """
+    p_c, o_c, w_c, l_c = _chunk_contrib(
+        agg_params, agg_opt, x, y, perm, mask, weights, module, tx, remat
+    )
+    psum = jax.tree.map(jnp.add, psum, p_c)
+    osum = jax.tree.map(
+        lambda a, b: jnp.add(a, b) if _is_inexact(b) else b, osum, o_c
+    )
+    return psum, osum, wsum + w_c, loss_sum + l_c * w_c
+
+
+# donated variant: XLA writes each chunk's updated sums into the same HBM
+# buffers (no fresh full-model allocation per chunk); the plain variant is
+# the CHUNK_DONATE_BUFFERS=False debugging path
+_chunk_round_acc_donated = partial(
+    jax.jit, static_argnames=("module", "tx", "remat"), donate_argnums=(0, 1, 2, 3)
+)(_chunk_round_acc_impl)
+_chunk_round_acc_plain = partial(
+    jax.jit, static_argnames=("module", "tx", "remat")
+)(_chunk_round_acc_impl)
+
+
+@jax.jit
+def _zero_acc(params, opt_state):
+    """Fresh on-device accumulators (fp32 sums, zero weight/loss)."""
+    psum = jax.tree.map(lambda a: jnp.zeros(a.shape, jnp.float32), params)
+    osum = jax.tree.map(
+        lambda a: jnp.zeros(a.shape, jnp.float32 if _is_inexact(a) else a.dtype),
+        opt_state,
+    )
+    return psum, osum, jnp.float32(0.0), jnp.float32(0.0)
+
+
+def _finalize_impl(psum, osum, wsum, params_ref, opt_ref, *, tx, keep_opt):
+    """Divide the accumulated sums into the new aggregate (one dispatch)."""
+    params = jax.tree.map(lambda s, ref: (s / wsum).astype(ref.dtype), psum, params_ref)
+    if keep_opt:
+        opt = jax.tree.map(
+            lambda s, ref: (s / wsum).astype(ref.dtype) if _is_inexact(ref) else s,
+            osum,
+            opt_ref,
+        )
+    else:
+        opt = tx.init(params)
+    return params, opt
+
+
+# keep_opt reads osum (donate both sums); the fresh-opt variant leaves osum
+# untouched, so donating it would only emit an unused-donation warning
+_finalize_keep = partial(
+    jax.jit, static_argnames=("tx", "keep_opt"), donate_argnums=(0, 1)
+)(_finalize_impl)
+_finalize_fresh = partial(
+    jax.jit, static_argnames=("tx", "keep_opt"), donate_argnums=(0,)
+)(_finalize_impl)
+
+
 @partial(jax.jit, static_argnames=("module",))
 def _chunk_eval(agg_params, x_t, y_t, *, module):
     def one(x, y):
@@ -130,9 +218,11 @@ class ChunkedFederation:
         vote: bool = False,
         seed: int = 0,
         tx: Optional[optax.GradientTransformation] = None,
+        resident: bool = True,
     ) -> None:
         self.model = model
         self.module = model.module
+        self._resident = resident
         self.n = len(datasets)
         if self.n % chunk_size != 0:
             raise ValueError(f"{self.n} nodes not divisible into chunks of {chunk_size}")
@@ -172,9 +262,12 @@ class ChunkedFederation:
         self._stage_state()
 
     def _stage_chunks(self) -> None:
-        # rebuilt from the datasets each time (only at init and on a
-        # chunk_size change) so no whole-federation numpy copy lives in
-        # host RAM for the object's lifetime
+        # resident: rebuilt from the datasets each time (only at init and on
+        # a chunk_size change) so no whole-federation numpy copy lives in
+        # host RAM for the object's lifetime. resident=False keeps the
+        # per-chunk numpy stacks IN host RAM instead — the mode for datasets
+        # that don't fit HBM next to the model workspace; the round loop
+        # streams them chunk-by-chunk, CHUNK_STAGING_DEPTH ahead of compute.
         c = self._chunk_size
 
         def wrap(a: np.ndarray) -> np.ndarray:
@@ -183,14 +276,21 @@ class ChunkedFederation:
             reps = -(-self._tr_max // len(a))
             return np.concatenate([a] * reps, axis=0)[: self._tr_max]
 
-        self.x_chunks = [
-            jax.device_put(np.stack([wrap(d.x_train) for d in self.datasets[c0 : c0 + c]]))
+        xs = [
+            np.stack([wrap(d.x_train) for d in self.datasets[c0 : c0 + c]])
             for c0 in range(0, self.n, c)
         ]
-        self.y_chunks = [
-            jax.device_put(np.stack([wrap(d.y_train) for d in self.datasets[c0 : c0 + c]]))
+        ys = [
+            np.stack([wrap(d.y_train) for d in self.datasets[c0 : c0 + c]])
             for c0 in range(0, self.n, c)
         ]
+        if self._resident:
+            self.x_chunks = [jax.device_put(x) for x in xs]
+            self.y_chunks = [jax.device_put(y) for y in ys]
+            self._x_np = self._y_np = None
+        else:
+            self._x_np, self._y_np = xs, ys
+            self.x_chunks = self.y_chunks = None
 
     @property
     def chunk_size(self) -> int:
@@ -246,6 +346,14 @@ class ChunkedFederation:
             ]
         ).astype(np.int32)
 
+    def _stage_chunk_inputs(self, ci: int, perm_np: np.ndarray):
+        """Start chunk ``ci``'s host→device transfers (async device_put)."""
+        c, c0 = self._chunk_size, ci * self._chunk_size
+        perm_d = jax.device_put(perm_np[c0 : c0 + c])
+        if self._resident:
+            return perm_d, self.x_chunks[ci], self.y_chunks[ci]
+        return perm_d, jax.device_put(self._x_np[ci]), jax.device_put(self._y_np[ci])
+
     def run_round(self, epochs: int = 1, eval: bool = False) -> dict:  # noqa: A002
         if self._vote and (self.round == 0 or Settings.VOTE_EVERY_ROUND):
             self.train_mask = self.elect_train_set()
@@ -255,50 +363,89 @@ class ChunkedFederation:
             raise RuntimeError("no active train-set nodes left")
 
         c = self.chunk_size
-        psum = osum = None
-        wsum = jnp.float32(0.0)
-        # loss accumulates ON DEVICE: a float() per chunk would block the
-        # host until that chunk's whole jitted program finishes, serializing
-        # chunk k+1's staging behind chunk k's compute and defeating the
-        # async dispatch pipeline this class exists for
-        loss_acc = jnp.float32(0.0)
-        for ci, c0 in enumerate(range(0, self.n, c)):
-            m = eff[c0 : c0 + c]
-            if m.sum() == 0:
-                continue  # fully-masked chunk: no contribution, skip dispatch
-            p_c, o_c, w_c, l_c = _chunk_round(
-                self.params,
-                self.opt_state,
-                self.x_chunks[ci],
-                self.y_chunks[ci],
-                jax.device_put(perm_np[c0 : c0 + c]),
-                jnp.asarray(m),
-                jnp.asarray(self._samples[c0 : c0 + c]),
-                module=self.module,
-                tx=self.tx,
-                remat=self.remat,
-            )
-            if psum is None:
-                psum, osum = p_c, o_c
-            else:
-                psum = jax.tree.map(jnp.add, psum, p_c)
-                osum = jax.tree.map(
-                    lambda a, b: jnp.add(a, b) if _is_inexact(a) else a, osum, o_c
-                )
-            wsum = wsum + w_c
-            loss_acc = loss_acc + l_c * w_c
+        # fully-masked chunks contribute nothing: never staged, never dispatched
+        live = [ci for ci in range(self.n // c) if eff[ci * c : ci * c + c].sum() > 0]
+        # overlapped staging: keep DEPTH chunks' inputs in flight so chunk
+        # k+1's host→device copies (perm indices; x/y when streaming
+        # non-resident data) run while chunk k's program computes. Depth 1
+        # reproduces the serial order (stage → dispatch → stage → ...).
+        depth = max(1, int(Settings.CHUNK_STAGING_DEPTH))
+        staged = {ci: self._stage_chunk_inputs(ci, perm_np) for ci in live[:depth]}
 
-        self.params = jax.tree.map(
-            lambda s, ref: (s / wsum).astype(ref.dtype), psum, self.params
-        )
-        if self.keep_opt_state:
-            self.opt_state = jax.tree.map(
-                lambda s, ref: (s / wsum).astype(ref.dtype) if _is_inexact(ref) else s,
-                osum,
-                self.opt_state,
+        def chunk_args(ci):
+            c0 = ci * c
+            perm_d, x_d, y_d = staged.pop(ci)
+            return (
+                x_d, y_d, perm_d,
+                jnp.asarray(eff[c0 : c0 + c]),
+                jnp.asarray(self._samples[c0 : c0 + c]),
+            )
+
+        # loss/weight accumulate ON DEVICE: a float() per chunk would block
+        # the host until that chunk's whole jitted program finishes,
+        # serializing chunk k+1's staging behind chunk k's compute and
+        # defeating the async dispatch pipeline this class exists for
+        if Settings.CHUNK_FUSED_REDUCE:
+            # overlapped path: partial sums ride donated accumulator args
+            # through the chunk program — one dispatch per chunk, no
+            # host-side per-leaf adds between chunks
+            step = (
+                _chunk_round_acc_donated
+                if Settings.CHUNK_DONATE_BUFFERS
+                else _chunk_round_acc_plain
+            )
+            acc = _zero_acc(self.params, self.opt_state)
+            for i, ci in enumerate(live):
+                acc = step(
+                    *acc, self.params, self.opt_state, *chunk_args(ci),
+                    module=self.module, tx=self.tx, remat=self.remat,
+                )
+                if i + depth < len(live):
+                    staged[live[i + depth]] = self._stage_chunk_inputs(
+                        live[i + depth], perm_np
+                    )
+            psum, osum, wsum, loss_acc = acc
+            fin = _finalize_keep if self.keep_opt_state else _finalize_fresh
+            self.params, self.opt_state = fin(
+                psum, osum, wsum, self.params, self.opt_state,
+                tx=self.tx, keep_opt=self.keep_opt_state,
             )
         else:
-            self.opt_state = jax.jit(self.tx.init)(self.params)
+            # serial reference path (CHUNK_FUSED_REDUCE=False): host-side
+            # tree adds after every chunk — the bit-parity baseline
+            psum = osum = None
+            wsum = jnp.float32(0.0)
+            loss_acc = jnp.float32(0.0)
+            for i, ci in enumerate(live):
+                p_c, o_c, w_c, l_c = _chunk_round(
+                    self.params, self.opt_state, *chunk_args(ci),
+                    module=self.module, tx=self.tx, remat=self.remat,
+                )
+                if i + depth < len(live):
+                    staged[live[i + depth]] = self._stage_chunk_inputs(
+                        live[i + depth], perm_np
+                    )
+                if psum is None:
+                    psum, osum = p_c, o_c
+                else:
+                    psum = jax.tree.map(jnp.add, psum, p_c)
+                    osum = jax.tree.map(
+                        lambda a, b: jnp.add(a, b) if _is_inexact(a) else a, osum, o_c
+                    )
+                wsum = wsum + w_c
+                loss_acc = loss_acc + l_c * w_c
+
+            self.params = jax.tree.map(
+                lambda s, ref: (s / wsum).astype(ref.dtype), psum, self.params
+            )
+            if self.keep_opt_state:
+                self.opt_state = jax.tree.map(
+                    lambda s, ref: (s / wsum).astype(ref.dtype) if _is_inexact(ref) else s,
+                    osum,
+                    self.opt_state,
+                )
+            else:
+                self.opt_state = jax.jit(self.tx.init)(self.params)
         self.round += 1
         entry: dict = {"round": self.round, "train_loss": float(loss_acc / wsum)}
         if eval:
@@ -343,8 +490,10 @@ class ChunkedFederation:
             updates, o = self.tx.update(grads, o, p)
             return optax.apply_updates(p, updates), o, loss
 
-        bx = self.x_chunks[0][0, : self.batch_size]
-        by = self.y_chunks[0][0, : self.batch_size]
+        x0 = self.x_chunks[0] if self._resident else self._x_np[0]
+        y0 = self.y_chunks[0] if self._resident else self._y_np[0]
+        bx = jnp.asarray(x0[0, : self.batch_size])
+        by = jnp.asarray(y0[0, : self.batch_size])
         step = compiled_flops(jax.jit(one_step), self.params, self.opt_state, bx, by)
         if step is None:
             return None

@@ -56,6 +56,7 @@ def _local_epoch(
     params, opt_state, xs, ys, module, tx, remat: bool = False,
     prox_mu: float = 0.0, anchor=None, corr=None,
     dp_clip: float = 0.0, dp_noise: float = 0.0, key=None,
+    accumulate_grads: bool = False,
 ):
     """One node's epoch: scan of SGD steps (identical math to JaxLearner).
 
@@ -66,10 +67,21 @@ def _local_epoch(
 
     ``prox_mu``/``anchor``: FedProx proximal pull toward the round's global
     model. ``corr``: SCAFFOLD control-variate correction ``c − c_i`` added
-    to every step's gradient. ``dp_clip > 0``: DP-SGD — per-example clipped
-    grads + Gaussian noise (multiplier ``dp_noise``, rng ``key``).
+    to every step's gradient (pre-cast to the param dtype by the caller —
+    the per-step ``astype`` is a no-op then). ``dp_clip > 0``: DP-SGD —
+    per-example clipped grads + Gaussian noise (multiplier ``dp_noise``,
+    rng ``key``). ``accumulate_grads=True`` additionally carries the fp32
+    sum of RAW step gradients (pre-correction) through the scan and returns
+    it as a fourth output — the SCAFFOLD fused-ci path derives each node's
+    new control variate from it without retaining the round-start params.
     """
     import optax
+
+    gsum0 = (
+        jax.tree.map(lambda p_: jnp.zeros(p_.shape, jnp.float32), params)
+        if accumulate_grads
+        else None
+    )
 
     if dp_clip > 0.0:
         from p2pfl_tpu.learning.privacy import dp_grads
@@ -81,23 +93,27 @@ def _local_epoch(
             return loss
 
         def dp_step(carry, batch):
-            p, o, k = carry
+            p, o, k, gs = carry
             x, y = batch
             k, sub = jax.random.split(k)
             grads, loss = dp_grads(loss_one, p, x, y, dp_clip, dp_noise, sub, remat=remat)
+            if accumulate_grads:
+                gs = jax.tree.map(lambda s, g: s + g.astype(jnp.float32), gs, grads)
             if corr is not None:
                 grads = jax.tree.map(lambda g, c: g + c.astype(g.dtype), grads, corr)
             updates, o = tx.update(grads, o, p)
             p = optax.apply_updates(p, updates)
-            return (p, o, k), loss
+            return (p, o, k, gs), loss
 
-        (params, opt_state, _), losses = jax.lax.scan(
-            dp_step, (params, opt_state, key), (xs, ys)
+        (params, opt_state, _, gsum), losses = jax.lax.scan(
+            dp_step, (params, opt_state, key, gsum0), (xs, ys)
         )
+        if accumulate_grads:
+            return params, opt_state, jnp.mean(losses), gsum
         return params, opt_state, jnp.mean(losses)
 
     def step(carry, batch):
-        p, o = carry
+        p, o, gs = carry
         x, y = batch
 
         def loss_fn(p_):
@@ -109,13 +125,19 @@ def _local_epoch(
         if remat:
             loss_fn = jax.checkpoint(loss_fn)
         loss, grads = jax.value_and_grad(loss_fn)(p)
+        if accumulate_grads:
+            gs = jax.tree.map(lambda s, g: s + g.astype(jnp.float32), gs, grads)
         if corr is not None:
             grads = jax.tree.map(lambda g, c: g + c.astype(g.dtype), grads, corr)
         updates, o = tx.update(grads, o, p)
         p = optax.apply_updates(p, updates)
-        return (p, o), loss
+        return (p, o, gs), loss
 
-    (params, opt_state), losses = jax.lax.scan(step, (params, opt_state), (xs, ys))
+    (params, opt_state, gsum), losses = jax.lax.scan(
+        step, (params, opt_state, gsum0), (xs, ys)
+    )
+    if accumulate_grads:
+        return params, opt_state, jnp.mean(losses), gsum
     return params, opt_state, jnp.mean(losses)
 
 
@@ -211,6 +233,7 @@ def _round_core(
     remat: bool = False,
     prox_mu: float = 0.0,
     scaffold: bool = False,
+    scaffold_fused_ci: bool = True,  # ci⁺ from the scan's grad mean (fast path)
     local_lr: float = 1e-3,
     c_global=None,  # SCAFFOLD server control variate (replicated pytree)
     c_local=None,  # SCAFFOLD per-node control variates [N, ...]
@@ -235,34 +258,67 @@ def _round_core(
     """
     n = mask.shape[0]
 
-    # gather per-epoch batches: idx [epochs, nb, bs] → x[idx] [epochs, nb, bs, ...]
-    def node_fn(params, opt_state, x, y, idx, ci, dp_key):
-        anchor = params if (prox_mu > 0.0 or scaffold) else None
-        corr = (
-            jax.tree.map(lambda c, cl: c - cl, c_global, ci) if scaffold else None
+    # SCAFFOLD correction c − c_i: materialized ONCE for all nodes outside
+    # ``node_fn``, pre-cast to the param compute dtype — under vmap the
+    # per-node closure re-derived it from the replicated fp32 ``c_global``
+    # inside the batched program (an N-way broadcast of the full variate
+    # plus a per-step astype); hoisted, it is one subtraction + cast whose
+    # result the epoch scans consume directly.
+    corr_all = (
+        jax.tree.map(
+            lambda c, cl, p: (c[None] - cl).astype(p.dtype),
+            c_global, c_local, stacked_params,
         )
+        if scaffold
+        else None
+    )
+
+    # gather per-epoch batches: idx [epochs, nb, bs] → x[idx] [epochs, nb, bs, ...]
+    def node_fn(params, opt_state, x, y, idx, ci, corr, dp_key):
+        fused_ci = scaffold and scaffold_fused_ci
+        # the anchor (round-start params) is retained across the epoch scan
+        # only when something still needs it afterwards — the fused-ci path
+        # doesn't, which releases two full-model fp32 buffers per node
+        anchor = params if (prox_mu > 0.0 or (scaffold and not fused_ci)) else None
 
         def epoch_body(carry, ep_idx):
-            p, o, k = carry
+            p, o, k, gs = carry
             xs = jnp.take(x, ep_idx, axis=0)  # [nb, bs, ...]
             ys = jnp.take(y, ep_idx, axis=0)
             sub = None
             if dp_clip > 0.0:
                 k, sub = jax.random.split(k)
-            p, o, loss = _local_epoch(
+            out = _local_epoch(
                 p, o, xs, ys, module, tx, remat,
                 prox_mu=prox_mu, anchor=anchor, corr=corr,
                 dp_clip=dp_clip, dp_noise=dp_noise, key=sub,
+                accumulate_grads=fused_ci,
             )
-            return (p, o, k), loss
+            if fused_ci:
+                p, o, loss, g_ep = out
+                gs = jax.tree.map(jnp.add, gs, g_ep)
+            else:
+                p, o, loss = out
+            return (p, o, k, gs), loss
 
         k0 = dp_key if dp_clip > 0.0 else jnp.zeros((2,), jnp.uint32)
-        (params, opt_state, _), losses = jax.lax.scan(
-            epoch_body, (params, opt_state, k0), idx
+        gs0 = (
+            jax.tree.map(lambda p_: jnp.zeros(p_.shape, jnp.float32), params)
+            if fused_ci
+            else None
         )
-        if scaffold:
+        (params, opt_state, _, gsum), losses = jax.lax.scan(
+            epoch_body, (params, opt_state, k0, gs0), idx
+        )
+        k_steps = idx.shape[0] * idx.shape[1]
+        if fused_ci:
+            # under plain SGD, y_i = x − η·Σ(g_t + c − c_i), so option II's
+            # c_i⁺ = c_i − c + (x − y_i)/(K·η) reduces EXACTLY to mean_t(g_t):
+            # the scan's fp32 grad mean IS the new variate — no round-start
+            # params retained, no large-magnitude cancellation
+            ci_new = jax.tree.map(lambda gs_: gs_ / k_steps, gsum)
+        elif scaffold:
             # c_i⁺ = c_i − c + (x_global − y_i)/(K·η)  (SCAFFOLD option II)
-            k_steps = idx.shape[0] * idx.shape[1]
             ci_new = jax.tree.map(
                 lambda cl, c, a, p: cl
                 - c
@@ -277,12 +333,12 @@ def _round_core(
     keys = dp_keys if dp_clip > 0.0 else None
     if scaffold:
         trained_p, trained_o, losses, ci_new = jax.vmap(
-            node_fn, in_axes=(0, 0, 0, 0, 0, 0, key_ax)
-        )(stacked_params, opt_states, x_all, y_all, perm, c_local, keys)
+            node_fn, in_axes=(0, 0, 0, 0, 0, 0, 0, key_ax)
+        )(stacked_params, opt_states, x_all, y_all, perm, c_local, corr_all, keys)
     else:
         trained_p, trained_o, losses, _ = jax.vmap(
-            node_fn, in_axes=(0, 0, 0, 0, 0, None, key_ax)
-        )(stacked_params, opt_states, x_all, y_all, perm, None, keys)
+            node_fn, in_axes=(0, 0, 0, 0, 0, None, None, key_ax)
+        )(stacked_params, opt_states, x_all, y_all, perm, None, None, keys)
 
     # non-train-set nodes contribute their previous params (they don't train)
     def sel(new, old):
@@ -387,15 +443,25 @@ _ROUND_STATICS = (
     # clip_tau is deliberately NOT static: it traces as a scalar operand
     # (ops.centered_clip takes tau traced), so tuning it never recompiles
     "module", "tx", "agg", "trim", "out_sharding", "keep_opt_state", "remat",
-    "prox_mu", "scaffold", "local_lr", "server_opt", "server_lr",
-    "dp_clip", "dp_noise",
+    "prox_mu", "scaffold", "scaffold_fused_ci", "local_lr", "server_opt",
+    "server_lr", "dp_clip", "dp_noise",
 )
 
+# SCAFFOLD variates and FedOpt moments are round-carried state exactly like
+# params/opt — donating them lets XLA write each round's new variates into
+# the old buffers (the fused span otherwise keeps two copies of the fp32
+# [N, model] c_local alive across the whole scan)
+_ROUND_DONATED_STATE = ("c_global", "c_local", "opt_m", "opt_v")
 
-@partial(jax.jit, static_argnames=_ROUND_STATICS, donate_argnums=(0, 1))
+
+@partial(
+    jax.jit, static_argnames=_ROUND_STATICS, donate_argnums=(0, 1),
+    donate_argnames=_ROUND_DONATED_STATE,
+)
 def spmd_round(
     stacked_params, opt_states, x_all, y_all, perm, mask, weights, sel_idx,
-    *, x_test=None, y_test=None, **kw,
+    *, c_global=None, c_local=None, opt_m=None, opt_v=None,
+    x_test=None, y_test=None, **kw,
 ):
     """One federated round for all N nodes.
 
@@ -406,7 +472,8 @@ def spmd_round(
     algorithm knobs.
     """
     out_params, out_opt, mean_loss, scaffold_state, fedopt_state, agg_params = _round_core(
-        stacked_params, opt_states, x_all, y_all, perm, mask, weights, sel_idx, **kw
+        stacked_params, opt_states, x_all, y_all, perm, mask, weights, sel_idx,
+        c_global=c_global, c_local=c_local, opt_m=opt_m, opt_v=opt_v, **kw,
     )
     if x_test is None:
         return (out_params, out_opt, mean_loss, *scaffold_state, *fedopt_state)
@@ -414,7 +481,10 @@ def spmd_round(
     return (out_params, out_opt, mean_loss, *scaffold_state, *fedopt_state, acc)
 
 
-@partial(jax.jit, static_argnames=_ROUND_STATICS, donate_argnums=(0, 1))
+@partial(
+    jax.jit, static_argnames=_ROUND_STATICS, donate_argnums=(0, 1),
+    donate_argnames=_ROUND_DONATED_STATE,
+)
 def spmd_rounds_fused(
     stacked_params, opt_states, x_all, y_all, perms, mask, weights, sel_idx,
     *,
@@ -536,6 +606,20 @@ class SpmdFederation:
         self.n = len(datasets)
         if self.n < 1:
             raise ValueError("need at least one dataset shard")
+        if Settings.SECURE_AGGREGATION:
+            # secagg is a gossip-plane protocol: pairwise masks exist to hide
+            # individual updates from the PEERS that relay them. An SPMD
+            # federation is one program on one mesh — a single trust domain
+            # where every "node" already shares an address space, so masking
+            # would add cost while protecting against nobody. Refuse loudly
+            # instead of silently training unmasked (docs/design.md,
+            # "Secure aggregation and the SPMD runtime").
+            raise ValueError(
+                "SECURE_AGGREGATION=True has no effect inside SpmdFederation: "
+                "the SPMD mesh is one trust domain (one program, one address "
+                "space). Use gossip Node mode for secure aggregation, or set "
+                "Settings.SECURE_AGGREGATION=False for mesh runs."
+            )
         self.datasets = datasets
         self.batch_size = batch_size
         if scaffold and (optimizer != "sgd" or tx is not None):
@@ -609,6 +693,7 @@ class SpmdFederation:
         self.active_mask = np.ones(self.n, dtype=np.float32)
         self.round = 0
         self.history: list[dict] = []
+        self.last_profile: Optional[dict] = None
 
     def reset(self, seed: int = 0) -> None:
         """Back to round 0 with fresh state, keeping mesh/data/executables.
@@ -770,6 +855,9 @@ class SpmdFederation:
         return dict(
             prox_mu=self.prox_mu,
             scaffold=self.scaffold,
+            # static (traced-program) knob: read per call so flipping the
+            # Setting reaches the next round's executable, never a stale one
+            scaffold_fused_ci=bool(Settings.SCAFFOLD_FUSED_CI),
             local_lr=self.learning_rate,
             server_opt=self.server_opt,
             server_lr=self.server_lr,
@@ -794,9 +882,13 @@ class SpmdFederation:
             )
         return jax.device_put(jax.random.split(root, self.n), self._shard)
 
-    def run_round(self, epochs: int = 1, eval: bool = False) -> dict:  # noqa: A002
+    def run_round(self, epochs: int = 1, eval: bool = False, profile: bool = False) -> dict:  # noqa: A002
         if self._vote and (self.round == 0 or Settings.VOTE_EVERY_ROUND):
             self.train_mask = self.elect_train_set()
+        if profile:
+            # per-phase breakdown of the round about to run (train /
+            # correction / aggregate) — stashed on self.last_profile
+            self.profile_round(epochs)
         perm = self._make_perm(epochs)
         eff = self._effective_mask()
         mask = jax.device_put(jnp.asarray(eff), self._shard)
@@ -843,6 +935,109 @@ class SpmdFederation:
             entry["test_acc"] = result[-1]  # acc is last (scaffold adds outputs)
         self.history.append(entry)
         return entry
+
+    def profile_round(self, epochs: int = 1, iters: int = 3) -> dict:
+        """Per-phase wall-clock attribution of one round (no state change).
+
+        Times three compiled programs on the federation's real inputs:
+
+        - ``train_s`` — the matched PLAIN round (scaffold math stripped,
+          same ``tx``/mask/perm shapes): local epochs + aggregate + diffuse;
+        - ``total_s`` — the round as configured (with SCAFFOLD correction
+          and variate updates when ``scaffold=True``);
+        - ``aggregate_s`` — the masked weighted reduce + diffusion alone;
+        - ``correction_s`` — the residual ``total − train``: what the
+          per-step correction adds + both variate updates cost together.
+
+        Donated inputs are re-copied per timed call (copies materialized
+        BEFORE the timer starts), so profiling consumes nothing the
+        federation still needs. Medians over ``iters`` calls. Sets
+        ``self.last_profile`` and returns it.
+        """
+        import time
+
+        from p2pfl_tpu.management.profiling import force_execution
+
+        rng_state = self._rng.bit_generator.state  # restored below: profiling
+        perm = self._make_perm(epochs)  # must not perturb the round stream
+        eff = self._effective_mask()
+        mask = jax.device_put(jnp.asarray(eff), self._shard)
+        sel_idx = jax.device_put(np.flatnonzero(eff).astype(np.int32), self._repl)
+        common = dict(
+            module=self.module, tx=self.tx, agg=self.aggregator, trim=self.trim,
+            clip_tau=self.clip_tau, out_sharding=self._shard,
+            keep_opt_state=self.keep_opt_state, remat=self.remat,
+        )
+
+        def timed(algo_kw: dict) -> float:
+            def stage_inputs():
+                copies = {
+                    k: jax.tree.map(jnp.copy, v)
+                    for k, v in algo_kw.items()
+                    if k in ("c_global", "c_local", "opt_m", "opt_v") and v is not None
+                }
+                p = jax.tree.map(jnp.copy, self.params)
+                o = jax.tree.map(jnp.copy, self.opt_state)
+                force_execution((p, o, copies))
+                return p, o, {**algo_kw, **copies}
+
+            def call(p, o, kw):
+                return spmd_round(
+                    p, o, self.x_all, self.y_all, perm, mask, self._samples,
+                    sel_idx, dp_keys=self._dp_round_keys(), **common, **kw,
+                )
+
+            force_execution(call(*stage_inputs()))  # compile + warm
+            ts = []
+            for _ in range(iters):
+                p, o, kw = stage_inputs()
+                t0 = time.monotonic()
+                force_execution(call(p, o, kw))
+                ts.append(time.monotonic() - t0)
+            return sorted(ts)[len(ts) // 2]
+
+        full_kw = self._algo_kwargs(self._server_t + 1 if self.server_opt else 0)
+        plain_kw = {
+            **full_kw,
+            "scaffold": False, "c_global": None, "c_local": None,
+            "server_opt": "", "opt_m": None, "opt_v": None, "opt_t": None,
+        }
+        t_total = timed(full_kw)
+        t_train = timed(plain_kw) if (self.scaffold or self.server_opt) else t_total
+
+        @partial(jax.jit, static_argnames=("agg", "trim"))
+        def agg_probe(stacked, mask_, weights, sel, *, agg, trim):
+            agg_p = _aggregate(stacked, mask_, weights, sel, agg, trim)
+            return jax.tree.map(
+                lambda a: jnp.broadcast_to(a[None], (mask_.shape[0], *a.shape)), agg_p
+            )
+
+        def agg_call():
+            # "clip" needs a center operand the probe doesn't carry; its
+            # reduce+diffuse cost is the fedavg probe's to first order
+            probe_agg = "fedavg" if self.aggregator == "clip" else self.aggregator
+            return agg_probe(
+                self.params, mask, self._samples, sel_idx,
+                agg=probe_agg, trim=self.trim,
+            )
+
+        force_execution(agg_call())
+        ts = []
+        for _ in range(iters):
+            t0 = time.monotonic()
+            force_execution(agg_call())
+            ts.append(time.monotonic() - t0)
+        t_agg = sorted(ts)[len(ts) // 2]
+
+        self._rng.bit_generator.state = rng_state
+        self.last_profile = {
+            "total_s": round(t_total, 4),
+            "train_s": round(t_train, 4),
+            "correction_s": round(max(t_total - t_train, 0.0), 4),
+            "aggregate_s": round(t_agg, 4),
+            "overhead_x": round(t_total / t_train, 2) if t_train > 0 else None,
+        }
+        return self.last_profile
 
     def run(self, rounds: int, epochs: int = 1, eval_every: int = 0) -> list[dict]:
         for r in range(rounds):

@@ -110,6 +110,35 @@ class Settings:
     # keys), so the mask drowns the parameters regardless of how large the
     # local datasets are. Requires WIRE_COMPRESSION="none".
     SECAGG_MASK_STD: float = 100.0
+    # --- federation round hot path (parallel/chunked.py, parallel/spmd.py) ---
+    # How many chunks ahead ChunkedFederation stages inputs (per-round perm
+    # indices, and x/y chunks when the dataset is not device-resident)
+    # while earlier chunks compute. 1 = stage each chunk immediately before
+    # its dispatch (the pre-overhaul serial behavior); 2 = classic double
+    # buffering (chunk k+1's host→device copies overlap chunk k's compute).
+    # Host-side knob — changing it never retraces or recompiles.
+    CHUNK_STAGING_DEPTH: int = 2
+    # Fold the per-chunk weighted reduce into the chunk program: partial
+    # sums ride donated accumulator arguments and update ON DEVICE (one
+    # dispatch per chunk). False restores the host-side
+    # ``jax.tree.map(jnp.add, ...)`` over full pytrees after every chunk —
+    # 2×leaf-count eager dispatches per chunk — kept as the reference
+    # semantics for the bit-parity test and for debugging.
+    CHUNK_FUSED_REDUCE: bool = True
+    # Donate the running accumulators (param/opt partial sums) into the
+    # chunk program so XLA writes each chunk's update into the same HBM
+    # buffers instead of allocating a fresh full-model set per chunk.
+    # False keeps every chunk's inputs alive (copy-safe debugging path).
+    CHUNK_DONATE_BUFFERS: bool = True
+    # SCAFFOLD fast path: derive each node's new control variate from the
+    # mean of its local raw gradients accumulated in the epoch scan carry
+    # (algebraically identical to Karimireddy et al. 2020 option II under
+    # plain SGD: (x − y_i)/(K·η) = mean_t(g_t) + (c − c_i)), instead of
+    # re-deriving it from the retained round-start params. Kills the fp32
+    # anchor round-trip after the scan; False restores the anchor-based
+    # formula (parity-tested — tests/test_round_pipeline.py). Participates
+    # in the jit cache key (traced-program knob).
+    SCAFFOLD_FUSED_CI: bool = True
     # Sequence length at/above which attn="auto" picks the Pallas flash
     # kernel over fused dense XLA attention (TPU backends only — anywhere
     # else the kernel runs in interpret mode and "auto" stays dense).
@@ -203,6 +232,10 @@ def set_test_settings() -> None:
     Settings.GOSSIP_SEND_TIMEOUT = 2.0
     Settings.GOSSIP_PAYLOAD_CACHE = True
     Settings.MEMORY_WIRE_CODEC = False
+    Settings.CHUNK_STAGING_DEPTH = 2
+    Settings.CHUNK_FUSED_REDUCE = True
+    Settings.CHUNK_DONATE_BUFFERS = True
+    Settings.SCAFFOLD_FUSED_CI = True
     Settings.TRAIN_SET_SIZE = 4
     Settings.VOTE_TIMEOUT = 10.0
     Settings.AGGREGATION_TIMEOUT = 10.0

@@ -1,0 +1,61 @@
+"""Regenerate the committed IDX fixture (tests/fixtures/idx/*.gz).
+
+MNIST-format IDX files, deterministically generated and tiny (8×8 uint8
+images, 128 train / 32 test, gzipped to a few KB total) so the
+``FederatedDataset.from_idx`` loader — the first code path a real-data
+user hits — has an executable witness in CI without any download egress.
+The images are class prototypes + noise (the synthetic_mnist recipe,
+quantized to uint8), so a federated round on them actually learns.
+
+Run from the repo root: ``python tests/fixtures/generate_idx.py``
+"""
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+OUT = os.path.join(os.path.dirname(__file__), "idx")
+SHAPE = (8, 8)
+N_TRAIN, N_TEST, CLASSES, SEED = 128, 32, 10, 31
+
+
+def _idx_bytes(a: np.ndarray) -> bytes:
+    dtype_code = {np.uint8: 8}[a.dtype.type]
+    header = struct.pack(">HBB", 0, dtype_code, a.ndim)
+    header += struct.pack(f">{a.ndim}I", *a.shape)
+    return header + a.tobytes()
+
+
+def _make(n: int, split_seed: int, protos: np.ndarray):
+    r = np.random.default_rng(SEED + split_seed)
+    y = r.integers(0, CLASSES, size=n)
+    x = protos[y] + r.normal(0.0, 0.35, size=(n, SHAPE[0] * SHAPE[1]))
+    x = 1.0 / (1.0 + np.exp(-x))
+    return (x.reshape((n, *SHAPE)) * 255).astype(np.uint8), y.astype(np.uint8)
+
+
+def main() -> None:
+    os.makedirs(OUT, exist_ok=True)
+    rng = np.random.default_rng(SEED)
+    protos = rng.normal(0.0, 1.5, size=(CLASSES, SHAPE[0] * SHAPE[1]))
+    x_tr, y_tr = _make(N_TRAIN, 1, protos)
+    x_te, y_te = _make(N_TEST, 2, protos)
+    for name, arr in (
+        ("train-images-idx3-ubyte", x_tr),
+        ("train-labels-idx1-ubyte", y_tr),
+        ("t10k-images-idx3-ubyte", x_te),
+        ("t10k-labels-idx1-ubyte", y_te),
+    ):
+        path = os.path.join(OUT, name + ".gz")
+        # fixed mtime/filename fields keep the gzip output byte-reproducible
+        with open(path, "wb") as raw, gzip.GzipFile(
+            fileobj=raw, mode="wb", filename="", mtime=0
+        ) as f:
+            f.write(_idx_bytes(arr))
+        print(f"{path}: {os.path.getsize(path)} bytes")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,70 @@
+"""Real-data ingress: ``FederatedDataset.from_idx`` through a federated
+round (ISSUE 3 satellite). The committed fixture (tests/fixtures/idx,
+~10 KB gzipped, regenerate with tests/fixtures/generate_idx.py) is the
+first code path a real-data user hits — previously never executed.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from p2pfl_tpu.learning.dataset import FederatedDataset
+from p2pfl_tpu.models import mlp
+from p2pfl_tpu.parallel import ChunkedFederation, SpmdFederation
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures", "idx")
+
+
+def test_from_idx_loads_gzipped_fixture():
+    data = FederatedDataset.from_idx(FIXTURE)
+    assert data.source == "idx"
+    assert data.x_train.shape == (128, 8, 8, 1) and data.x_train.dtype == np.float32
+    assert data.y_train.shape == (128,) and data.y_train.dtype == np.int32
+    assert data.x_test.shape == (32, 8, 8, 1)
+    assert float(data.x_train.max()) <= 1.0 and float(data.x_train.min()) >= 0.0
+    assert set(np.unique(data.y_train)) <= set(range(10))
+
+
+def test_mnist_dispatcher_prefers_idx_dir():
+    data = FederatedDataset.mnist(FIXTURE)
+    assert data.source == "idx"
+    # a directory without IDX files falls back to synthetic
+    assert FederatedDataset.mnist(os.path.dirname(FIXTURE), n_train=64, n_test=16).source == "synthetic"
+
+
+def test_from_idx_through_federated_round():
+    """One SPMD round + eval on the IDX data: partitioning, staging, and
+    the round program all consume the loader's dtypes/shapes."""
+    data = FederatedDataset.from_idx(FIXTURE)
+    fed = SpmdFederation.from_dataset(
+        mlp(input_shape=(8, 8, 1)), data, n_nodes=2, batch_size=16,
+        vote=False, seed=3,
+    )
+    entry = fed.run_round(epochs=1, eval=True)
+    assert np.isfinite(float(entry["train_loss"]))
+    assert 0.0 <= float(entry["test_acc"]) <= 1.0
+
+
+def test_from_idx_through_chunked_round():
+    """Same witness through the chunked (time-shared) executor's
+    overlapped staging path."""
+    data = FederatedDataset.from_idx(FIXTURE)
+    fed = ChunkedFederation.from_dataset(
+        mlp(input_shape=(8, 8, 1)), data, n_nodes=2, chunk_size=1,
+        batch_size=16, vote=False, seed=3,
+    )
+    entry = fed.run_round(epochs=1, eval=True)
+    assert np.isfinite(float(entry["train_loss"]))
+
+
+@pytest.mark.slow
+def test_idx_federation_learns():
+    """A few rounds on the fixture beat chance (10 classes → 0.1)."""
+    data = FederatedDataset.from_idx(FIXTURE)
+    fed = SpmdFederation.from_dataset(
+        mlp(input_shape=(8, 8, 1)), data, n_nodes=2, batch_size=16,
+        vote=False, seed=3,
+    )
+    fed.run(rounds=5, epochs=2)
+    assert fed.evaluate()["test_acc"] > 0.3
