@@ -19,10 +19,19 @@ and materialized, just without sockets):
    ``GOSSIP_SEND_WORKERS=1``, ``GOSSIP_PAYLOAD_CACHE=False``, huge
    ``GOSSIP_SEND_TIMEOUT``) against the shipped defaults (4 send workers,
    cache on, 0.5 s budget).
+4. **Compression split** — host (numpy argpartition + native quantize) vs
+   device (``ops/compression.py`` fused jit) producer per compression mode:
+   encode wall-clock, payload bytes, and the bytes that cross device→host
+   per encode (the host producer pulls the FULL fp32 model + anchor; the
+   device producer only the compressed ``(idx, q, scale)`` buffers), with a
+   decode-parity check between both producers' frames.
 
 ``--smoke`` runs a shrunken federation and asserts the encode-once
 invariant (encodes per node-round bounded by distinct contents, cache hits
-present) — the CI guard that keeps the cache from silently regressing.
+present) plus the compression-split invariants (host/device frames decode
+to the same tree within quantization tolerance; device topk8 D2H stays
+~payload-sized, not model-sized) — the CI guard that keeps the cache and
+the device codec from silently regressing.
 
 usage: JAX_PLATFORMS=cpu python bench_gossip.py [--smoke] [--out BENCH_GOSSIP.json]
 """
@@ -86,6 +95,106 @@ def _flatten(tree):
     from p2pfl_tpu.learning.weights import _flatten_named
 
     return _flatten_named(tree)
+
+
+def _wide_tree(n_params: int = 4_000_000, seed: int = 0):
+    """Synthetic multi-leaf fp32 tree (device-resident) for the compression
+    split — big enough that codec throughput, not dispatch overhead,
+    dominates."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    per = n_params // 4
+    return {
+        f"block{i}/w": jnp.asarray(rng.normal(size=per).astype(np.float32))
+        for i in range(4)
+    }
+
+
+def bench_compression(repeats: int = 5, smoke: bool = False) -> dict:
+    """Host vs device producer: encode wall-clock, payload bytes, D2H bytes.
+
+    Returns per-model entries like ``topk8_host`` / ``topk8_device`` plus
+    ``*_device_speedup``; parity between the two producers' frames is
+    asserted (decoded trees agree within the int8 quantization tolerance —
+    the wire-format invariance contract).
+
+    Backend caveat (recorded in the output): on the CPU backend "device"
+    IS the host CPU — the D2H pull the device producer eliminates is a
+    near-free memcpy here, and XLA:CPU's exact TopK (a partial sort) runs
+    5–10× slower than numpy's introselect, so ``topk8_device_speedup`` < 1
+    on CPU is expected. The structural numbers (``d2h_bytes_per_encode``
+    ~payload-sized vs the host's full fp32 model+anchor pull) are
+    backend-independent; on a TPU backend the selection is
+    hardware-parallel and the host path's per-leaf PCIe pulls dominate.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from p2pfl_tpu.learning import weights as W
+    from p2pfl_tpu.settings import Settings
+
+    configs = {"mlp": None} if smoke else {"mlp": None, "wide_4m": None}
+    out: dict = {"backend": jax.default_backend()}
+    prev_flag = Settings.WIRE_COMPRESSION_DEVICE
+    try:
+        for name in configs:
+            if name == "wide_4m":
+                params = _wide_tree()
+            else:
+                params = {k: jnp.asarray(v) for k, v in _flatten(_make_model(name).params).items()}
+            # proportional perturbation: distinct |delta| per coordinate, so
+            # top-k selection is deterministic (no argpartition/top_k
+            # tie-break divergence) and the workload is non-degenerate
+            anchor = {
+                k: (v * 0.99 if np.dtype(v.dtype).kind == "f" else v)
+                for k, v in params.items()
+            }
+            raw_bytes = int(
+                sum(v.size * np.dtype(v.dtype).itemsize for v in params.values())
+            )
+            entry: dict = {"param_bytes": raw_bytes}
+            for comp in ("int8", "topk8"):
+                kw = {"compression": comp}
+                if comp == "topk8":
+                    kw.update(anchor=anchor, anchor_tag="0:0")
+                payloads = {}
+                for mode, flag in (("host", False), ("device", True)):
+                    Settings.WIRE_COMPRESSION_DEVICE = flag
+                    payload = W.encode_params(params, **kw)  # warmup (jit compile)
+                    W.reset_wire_stats()
+                    t0 = time.perf_counter()
+                    for _ in range(repeats):
+                        payload = W.encode_params(params, **kw)
+                    ms = (time.perf_counter() - t0) / repeats * 1e3
+                    stats = W.wire_stats()
+                    payloads[mode] = payload
+                    entry[f"{comp}_{mode}"] = {
+                        "encode_ms": round(ms, 3),
+                        "payload_bytes": len(payload),
+                        "d2h_bytes_per_encode": stats["d2h_bytes"] // repeats,
+                    }
+                entry[f"{comp}_device_speedup"] = round(
+                    entry[f"{comp}_host"]["encode_ms"]
+                    / max(entry[f"{comp}_device"]["encode_ms"], 1e-9),
+                    2,
+                )
+                # wire-format invariance: both frames through the ONE decoder
+                Settings.WIRE_COMPRESSION_DEVICE = False
+                dkw = {"anchor": anchor, "anchor_tag": "0:0"} if comp == "topk8" else {}
+                ref = W.decode_params(payloads["host"], **dkw)
+                cross = W.decode_params(payloads["device"], **dkw)
+                for k in ref:
+                    np.testing.assert_allclose(
+                        np.asarray(ref[k], np.float32),
+                        np.asarray(cross[k], np.float32),
+                        atol=0.05,
+                        err_msg=f"host/device frame parity broke at {k} ({comp})",
+                    )
+            out[name] = entry
+    finally:
+        Settings.WIRE_COMPRESSION_DEVICE = prev_flag
+    return out
 
 
 def run_federation(
@@ -220,11 +329,25 @@ def main() -> int:
             f"per node-round (max {MAX_ENCODES_PER_NODE_ROUND}) — the cache is "
             "not being reused across candidates/ticks"
         )
+        # device-codec guard: parity is asserted inside bench_compression;
+        # on top of it, the device producer's D2H must be ~payload-sized
+        comp = bench_compression(repeats=2, smoke=True)
+        results["compression"] = comp
+        tk_dev = comp["mlp"]["topk8_device"]
+        assert tk_dev["d2h_bytes_per_encode"] < comp["mlp"]["param_bytes"] / 4, (
+            f"device topk8 encode pulled {tk_dev['d2h_bytes_per_encode']} bytes D2H "
+            f"for a {comp['mlp']['param_bytes']}-byte model — the fused encode is "
+            "no longer keeping the model on device"
+        )
+        assert tk_dev["d2h_bytes_per_encode"] < tk_dev["payload_bytes"] * 3, (
+            "device topk8 D2H should be on the order of the payload, not the model"
+        )
         print(json.dumps(results, indent=2))
-        print("SMOKE OK: encode-once invariant holds")
+        print("SMOKE OK: encode-once + device-codec invariants hold")
         return 0
 
     results["codec"] = bench_codec()
+    results["compression"] = bench_compression()
     # warm the jit/codec caches so neither timed variant pays first-compile
     run_federation(n_nodes=8, rounds=1)
     results["sequential_nocache"] = run_federation(

@@ -24,11 +24,15 @@ the budget expires. A send still in flight marks its neighbor busy — the
 next tick skips that neighbor instead of stacking a second worker behind the
 same stall — and results are collected in submission order so the caller's
 convergence accounting is deterministic. Payload construction (``model_fn``)
-stays on the calling thread: with the encode-once payload cache
-(``learning/weights.py``) it is a cheap lookup after the first candidate,
-and keeping it serial means aggregator/learner state is never read
-concurrently. Send outcomes are counted into the logger's communication
-metrics (``gossip_send_ok`` / ``_fail`` / ``_timeout`` / ``_inflight_skip``).
+stays on the calling thread — aggregator/learner state is never read
+concurrently — but it is LAZY: the model plane passes payload builders, and
+``_dispatch_sends`` resolves each one right before submitting its
+neighbor's task, so candidate ``i+1``'s encode (a fused device dispatch
+plus the compressed-bytes D2H under ``Settings.WIRE_COMPRESSION_DEVICE``,
+or an encode-once cache hit — ``learning/weights.py``) overlaps candidate
+``i``'s in-flight send. Send outcomes are counted into the logger's
+communication metrics (``gossip_send_ok`` / ``_fail`` / ``_timeout`` /
+``_inflight_skip``).
 """
 
 from __future__ import annotations
@@ -36,6 +40,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import OrderedDict, deque
+from functools import partial
 from concurrent.futures import CancelledError, Future, ThreadPoolExecutor
 from concurrent.futures import TimeoutError as FuturesTimeout  # builtin alias only on 3.11+
 from typing import Callable, Optional
@@ -120,13 +125,20 @@ class Gossiper:
         Sends are grouped per neighbor — one worker task per batch per
         neighbor runs that neighbor's envelopes in order (distinct
         neighbors proceed concurrently; ordering across batches is NOT
-        guaranteed). Returns ``(results, skipped)``: per-send outcomes in
-        submission order — True/False from the transport, or None when the
-        send outlived its ``GOSSIP_SEND_TIMEOUT`` budget (it keeps running
-        on its worker; the neighbor is marked stalled until that exact
-        task finishes) — plus the sends that were never submitted because
-        their neighbor was already stalled (the message plane requeues
-        those; the model plane rebuilds next tick anyway).
+        guaranteed). An envelope may be a zero-arg CALLABLE: it is resolved
+        on the calling thread immediately before its neighbor's task is
+        submitted, so payload construction (device encode, cache lookup)
+        for candidate ``i+1`` overlaps candidate ``i``'s in-flight send
+        instead of serializing ahead of the whole batch — while aggregator
+        and learner state are still only ever read from this one thread. A
+        callable resolving to ``None`` declines the send (its slot stays
+        ``None`` in the results). Returns ``(results, skipped)``: per-send
+        outcomes in submission order — True/False from the transport, or
+        None when the send outlived its ``GOSSIP_SEND_TIMEOUT`` budget (it
+        keeps running on its worker; the neighbor is marked stalled until
+        that exact task finishes) — plus the sends that were never
+        submitted because their neighbor was already stalled (the message
+        plane requeues those; the model plane rebuilds next tick anyway).
         """
         pool = self._pool
         if pool is None or Settings.GOSSIP_SEND_WORKERS <= 1:
@@ -135,6 +147,11 @@ class Gossiper:
             # the pre-overhaul behavior, each plane its own serial lane
             out: list[Optional[bool]] = []
             for nei, env in sends:
+                if callable(env):
+                    env = env()
+                if env is None:
+                    out.append(None)
+                    continue
                 ok = self._send(nei, env, create_connection=create_connection)
                 logger.log_comm_metric(
                     self.self_addr, "gossip_send_ok" if ok else "gossip_send_fail"
@@ -172,10 +189,23 @@ class Gossiper:
                         results[i] = False
                         skipped.append((nei, env))
                     continue
+            # resolve lazy payloads NOW, on the calling thread: the previous
+            # neighbor's task is already running on a worker, so this
+            # build (encode dispatch + D2H of the compressed buffers)
+            # hides under that in-flight send
+            resolved: list[tuple[int, object]] = []
+            for i, env in items:
+                if callable(env):
+                    env = env()
+                if env is None:
+                    continue  # payload declined — not a send, not a failure
+                resolved.append((i, env))
+            if not resolved:
+                continue
             try:
-                fut = pool.submit(send_all, nei, [env for _i, env in items])
+                fut = pool.submit(send_all, nei, [env for _i, env in resolved])
             except RuntimeError:  # stop() shut the pool down under us
-                for i, _env in items:
+                for i, _env in resolved:
                     results[i] = False
                 continue
 
@@ -188,7 +218,7 @@ class Gossiper:
                         del self._stalled[nei]
 
             fut.add_done_callback(_done)
-            futures.append((nei, [i for i, _env in items], fut))
+            futures.append((nei, [i for i, _env in resolved], fut))
         # everything-is-stuck backstop: enough budget for every task to get
         # a worker slot and its own timeout, then stop waiting regardless
         hard_deadline = time.monotonic() + timeout * (1 + len(futures) / workers)
@@ -311,13 +341,17 @@ class Gossiper:
             else:
                 equal_ticks = 0
                 last_status = status
-            # build payloads serially (cache-hit cheap), fan the sends out
-            sends: list[tuple[str, object]] = []
-            for nei in random_subset(candidates, Settings.GOSSIP_MODELS_PER_ROUND):
-                payload = model_fn(nei)
-                if payload is None:
-                    continue
-                sends.append((nei, payload))
+            # payloads stay lazily built ON the calling thread (learner /
+            # aggregator state is never read concurrently), but resolution
+            # happens per neighbor at submit time inside _dispatch_sends:
+            # candidate i+1's encode (a device dispatch + compressed-bytes
+            # D2H, or a payload-cache hit) overlaps candidate i's in-flight
+            # send instead of running before any byte hits the wire —
+            # compression hides under the fan-out
+            sends: list[tuple[str, object]] = [
+                (nei, partial(model_fn, nei))
+                for nei in random_subset(candidates, Settings.GOSSIP_MODELS_PER_ROUND)
+            ]
             if sends:
                 self._dispatch_sends(sends, create_connection=create_connection)
             time.sleep(period)

@@ -133,6 +133,17 @@ class NodeLearner(ABC):
         bytes instead of re-folding). Code that mutates the returned dict
         directly must call :meth:`bump_model_version` so cached payloads
         built from the old residual are never replayed.
+
+        Residual lifecycle: under ``Settings.WIRE_COMPRESSION_DEVICE`` the
+        entries are DEVICE arrays — the fused encode donates them into the
+        next dispatch and writes the new carry back without a host
+        round-trip, so the residual never crosses D2H between rounds. The
+        host encoder normalizes device entries with ``np.asarray`` (and
+        vice versa), so flipping the producer mid-experiment degrades to
+        one transfer, never a wrong delta; entries whose tensor changed
+        shape or left the topk path are dropped at encode time
+        (``weights._validate_residual``) instead of surfacing as a
+        broadcast error deep inside the codec.
         """
         if not hasattr(self, "_ef_residual"):
             self._ef_residual = {}

@@ -58,12 +58,34 @@ Pytree = Any
 # of the encode pipeline counts, cache hits don't
 _encode_lock = threading.Lock()
 _encode_calls = 0
+# wire-byte accounting (bench_gossip compression split + per-node comm
+# metrics): raw model bytes in, payload bytes out, bytes that actually
+# crossed device→host, and which producer ran
+_wire_stats = {
+    "raw_bytes": 0,
+    "payload_bytes": 0,
+    "d2h_bytes": 0,
+    "host_encodes": 0,
+    "device_encodes": 0,
+}
 
 
 def encode_call_count() -> int:
     """Total :func:`encode_params` invocations in this process."""
     with _encode_lock:
         return _encode_calls
+
+
+def wire_stats() -> dict:
+    """Process-wide wire-byte counters (see :func:`encode_params`)."""
+    with _encode_lock:
+        return dict(_wire_stats)
+
+
+def reset_wire_stats() -> None:
+    with _encode_lock:
+        for k in _wire_stats:
+            _wire_stats[k] = 0
 
 
 class PayloadCache:
@@ -150,74 +172,60 @@ def _path_part(p) -> str:
     return str(p)
 
 
-def encode_params(
-    tree: Pytree,
-    compression: Optional[str] = None,
-    anchor: Optional[Pytree] = None,
-    anchor_tag: Optional[str] = None,
-    residual: Optional[dict] = None,
-) -> bytes:
-    """Serialize a params pytree to the self-describing wire format.
+def _validate_residual(residual: Optional[dict], eligible_sizes: dict) -> None:
+    """Drop stale error-feedback entries IN PLACE before an encode.
 
-    ``compression="int8"`` quantizes float tensors symmetrically per-tensor
-    (4x smaller payloads; native C++ hot loop in ``p2pfl_tpu/native`` when
-    built). Every payload carries a CRC32C over the tensor bytes; decoding
-    verifies it.
+    Two staleness modes bit us in production shapes: (a) a tensor changed
+    shape between rounds (architecture hot-swap, LoRA merge) — the stored
+    flat residual no longer broadcasts against the new delta and the
+    encode dies deep inside with a shape error; (b) a key left the topk
+    path (compression-mode flip, anchor loss, tensor shrank under the
+    size floor) — its residual would sit in the store forever, and worse,
+    re-enter stale if the key ever came back. Validation is at use time:
+    keep a key only if it is eligible THIS encode and its stored size
+    matches the tensor's current size.
+    """
+    if residual is None:
+        return
+    for key in list(residual):
+        size = eligible_sizes.get(key)
+        if size is None or getattr(residual[key], "size", None) != size:
+            del residual[key]
 
-    ``compression="topk8"`` delta-codes against ``anchor`` (the round-start
-    global model): per float tensor, keep the top
-    ``Settings.TOPK_FRACTION`` coordinates of ``params − anchor`` by
-    magnitude, int8-quantized, shipped as (uint32 index, int8 value) pairs
-    — ~``0.05 × 5/4`` of the dense float32 bytes at the default fraction.
-    ``anchor_tag`` (the round identity ``"epoch:round"``, pinned by the
-    stages) rides in the header: the receiver accepts the delta only when
-    its own anchor carries the same tag. Anchors of the same round are NOT
-    bit-identical across nodes — each node folds its OWN params losslessly
-    but its peers' through the lossy wire — so reconstruction tolerates a
-    small anchor divergence (same order as the int8 quantization error);
-    the tag catches the catastrophic case, delta-coding against a
-    different round's model. With no anchor (e.g. the round-0 init model)
-    the tensor falls back to dense int8. ``residual`` (a mutable
-    {path: np.ndarray} dict) enables error feedback: the coordinates a
-    round drops are added back into the next round's delta instead of
-    being lost (Seide et al. 2014; Karimireddy et al. 2019).
+
+def _encode_host(
+    named: dict,
+    compression: Optional[str],
+    anchor_named: Optional[dict],
+    topk_plan: dict,
+    residual: Optional[dict],
+) -> tuple[list, int]:
+    """Host (numpy) producer — the bit-format-reference baseline.
+
+    Walks tensors serially: full D2H pull per leaf, ``argpartition`` top-k,
+    native C++ quantize. ``topk_plan`` (``{path: budget}``, computed once
+    in :func:`encode_params`) is the single source of which tensors are
+    delta-coded and at what k. Returns ``(plans, d2h_bytes)`` exactly like
+    :func:`p2pfl_tpu.ops.compression.encode_device`; the byte layout per
+    tensor is the format contract both producers implement.
     """
     from p2pfl_tpu import native
 
-    global _encode_calls
-    with _encode_lock:
-        _encode_calls += 1
-
-    if compression is None:
-        from p2pfl_tpu.settings import Settings
-
-        compression = Settings.WIRE_COMPRESSION
-    if compression == "topk8":
-        from p2pfl_tpu.settings import Settings as _S
-
-        topk_frac = _S.TOPK_FRACTION
-    anchor_flat = _flatten_named(anchor) if anchor is not None else None
-    flat = _flatten_named(tree)
-    entries = []
-    buffers = []
-    crc = 0
-    for key in sorted(flat):
-        arr = flat[key]
+    plans = []
+    d2h = 0
+    for key in sorted(named):
+        arr = np.asarray(named[key])
+        d2h += arr.nbytes
         entry = {"k": key, "shape": list(arr.shape), "dtype": arr.dtype.name}
-        use_topk = (
-            compression == "topk8"
-            and arr.dtype.kind == "f"
-            and anchor_flat is not None
-            and key in anchor_flat
-            and arr.size > 16  # tiny tensors: index overhead beats the savings
-        )
-        if use_topk:
-            delta = np.asarray(arr, np.float32).ravel() - np.asarray(
-                anchor_flat[key], np.float32
-            ).ravel()
+        if key in topk_plan:
+            anchor_arr = np.asarray(anchor_named[key], dtype=np.float32)
+            d2h += anchor_arr.nbytes
+            delta = np.asarray(arr, np.float32).ravel() - anchor_arr.ravel()
             if residual is not None and key in residual:
-                delta = delta + residual[key]
-            k = max(1, int(np.ceil(arr.size * topk_frac)))
+                # np.asarray: the store may hold a device-resident carry
+                # from a WIRE_COMPRESSION_DEVICE flip — normalize host-side
+                delta = delta + np.asarray(residual[key], dtype=np.float32)
+            k = topk_plan[key]
             idx = np.argpartition(np.abs(delta), -k)[-k:].astype(np.uint32)
             idx.sort()
             vals = delta[idx]
@@ -241,6 +249,19 @@ def encode_params(
             entry["scale"] = scale
         else:
             bufs = (np.ascontiguousarray(arr).tobytes(),)
+        plans.append((entry, bufs))
+    return plans, d2h
+
+
+def _frame(plans: list, anchor_tag: Optional[str]) -> bytes:
+    """Assemble per-tensor plans into the framed payload (shared by both
+    producers — one frame layout, one decoder)."""
+    from p2pfl_tpu import native
+
+    entries = []
+    buffers = []
+    crc = 0
+    for entry, bufs in plans:
         entry["n"] = sum(len(b) for b in bufs)
         for b in bufs:
             crc = native.crc32c(b, crc)
@@ -265,6 +286,124 @@ def encode_params(
     return bytes(out)
 
 
+def encode_params(
+    tree: Pytree,
+    compression: Optional[str] = None,
+    anchor: Optional[Pytree] = None,
+    anchor_tag: Optional[str] = None,
+    residual: Optional[dict] = None,
+    owner: Optional[str] = None,
+) -> bytes:
+    """Serialize a params pytree to the self-describing wire format.
+
+    ``compression="int8"`` quantizes float tensors symmetrically per-tensor
+    (4x smaller payloads; native C++ hot loop in ``p2pfl_tpu/native`` when
+    built). Every payload carries a CRC32C over the tensor bytes; decoding
+    verifies it.
+
+    ``compression="topk8"`` delta-codes against ``anchor`` (the round-start
+    global model): per float tensor, keep the top
+    ``Settings.TOPK_FRACTION`` coordinates of ``params − anchor`` by
+    magnitude, int8-quantized, shipped as (uint32 index, int8 value) pairs
+    — ~``0.05 × 5/4`` of the dense float32 bytes at the default fraction.
+    ``anchor_tag`` (the round identity ``"epoch:round"``, pinned by the
+    stages) rides in the header: the receiver accepts the delta only when
+    its own anchor carries the same tag. Anchors of the same round are NOT
+    bit-identical across nodes — each node folds its OWN params losslessly
+    but its peers' through the lossy wire — so reconstruction tolerates a
+    small anchor divergence (same order as the int8 quantization error);
+    the tag catches the catastrophic case, delta-coding against a
+    different round's model. With no anchor (e.g. the round-0 init model)
+    the tensor falls back to dense int8. ``residual`` (a mutable
+    {path: array} dict) enables error feedback: the coordinates a round
+    drops are added back into the next round's delta instead of being lost
+    (Seide et al. 2014; Karimireddy et al. 2019).
+
+    Producer selection: with ``Settings.WIRE_COMPRESSION_DEVICE`` on and
+    device-resident params, the delta/EF/top-k/int8 math runs as ONE fused
+    jit dispatch (``ops/compression.py``) and only the compressed buffers
+    cross device→host — the residual store then carries device arrays
+    between rounds. The host numpy path remains the bit-format-compatible
+    baseline: both producers emit the same frame layout, and the one
+    decoder (:func:`decode_params`) decodes either. Stale residual entries
+    (shape changes, keys off the topk path after a mode flip) are dropped
+    before every encode. ``owner`` (the node address, threaded through
+    :meth:`ModelUpdate.encode`) routes per-node wire-byte counters into
+    ``logger.get_comm_metrics``; process-wide totals are always kept
+    (:func:`wire_stats`).
+    """
+    from p2pfl_tpu.settings import Settings
+
+    global _encode_calls
+    with _encode_lock:
+        _encode_calls += 1
+
+    if compression is None:
+        compression = Settings.WIRE_COMPRESSION
+    topk_frac = Settings.TOPK_FRACTION if compression == "topk8" else 0.0
+
+    def _named(t: Pytree) -> dict:
+        # leaves keep their device residency, but non-array leaves (Python
+        # scalars in a params pytree) are normalized exactly like the old
+        # _flatten_named did — every leaf downstream has .dtype/.shape
+        return {
+            key: leaf if hasattr(leaf, "dtype") else np.asarray(leaf)
+            for key, leaf in named_leaves(t)[1]
+        }
+
+    named = _named(tree)
+    anchor_named = _named(anchor) if anchor is not None else None
+
+    def _size(leaf) -> int:
+        return int(np.prod(np.shape(leaf), dtype=np.int64)) if np.shape(leaf) else 1
+
+    # the ONE topk-eligibility predicate + budget, shared by both producers
+    # (and by residual validation — drift here would silently wipe valid
+    # error-feedback carries or diverge the producers' nnz)
+    from p2pfl_tpu.ops.compression import topk_budget
+
+    topk_plan = {
+        key: topk_budget(_size(leaf), topk_frac)
+        for key, leaf in named.items()
+        if compression == "topk8"
+        and np.dtype(leaf.dtype).kind == "f"
+        and anchor_named is not None
+        and key in anchor_named
+        and _size(leaf) > 16
+    }
+    _validate_residual(residual, {key: _size(named[key]) for key in topk_plan})
+
+    use_device = (
+        Settings.WIRE_COMPRESSION_DEVICE
+        and compression in ("int8", "topk8")
+        and any(isinstance(leaf, jax.Array) for leaf in named.values())
+    )
+    if use_device:
+        from p2pfl_tpu.ops import compression as device_codec
+
+        plans, d2h = device_codec.encode_device(named, anchor_named, topk_plan, residual)
+        producer = "device"
+    else:
+        plans, d2h = _encode_host(named, compression, anchor_named, topk_plan, residual)
+        producer = "host"
+    payload = _frame(plans, anchor_tag)
+
+    raw_bytes = sum(_size(leaf) * np.dtype(leaf.dtype).itemsize for leaf in named.values())
+    with _encode_lock:
+        _wire_stats["raw_bytes"] += raw_bytes
+        _wire_stats["payload_bytes"] += len(payload)
+        _wire_stats["d2h_bytes"] += d2h
+        _wire_stats[f"{producer}_encodes"] += 1
+    if owner:
+        from p2pfl_tpu.management.logger import logger
+
+        logger.log_comm_metric(owner, "wire_raw_bytes", raw_bytes)
+        logger.log_comm_metric(owner, "wire_payload_bytes", len(payload))
+        logger.log_comm_metric(owner, "wire_d2h_bytes", d2h)
+        logger.log_comm_metric(owner, f"wire_encode_{producer}")
+    return payload
+
+
 def decode_params(
     payload: bytes,
     anchor: Optional[Pytree] = None,
@@ -278,6 +417,15 @@ def decode_params(
     round's model would yield silently wrong parameters. Same-round
     anchors may differ slightly across nodes (see :func:`encode_params`);
     that divergence is part of the codec's loss budget.
+
+    One decoder decodes BOTH producers (host and device frames are
+    layout-identical). ``tk8`` indices are validated strictly ascending
+    and in range — both producers emit them sorted, so a duplicate or
+    unsorted index stream is a malformed payload, not a dialect. When
+    ``Settings.WIRE_COMPRESSION_DEVICE`` is on and the anchor is
+    device-resident, reconstruction runs as one fused scatter-add on
+    device (``ops/compression.py``) AFTER the CRC verifies, instead of
+    pulling every anchor tensor host-side into a ``.ravel().copy()``.
     """
     try:
         # memoryview slicing: header parse + per-tensor CRC walk the frame
@@ -303,9 +451,15 @@ def decode_params(
                     f"{header['anchor_tag']!r}) — sender delta-coded against a "
                     "different round's model"
                 )
-            anchor_flat = _flatten_named(anchor)
+            # raw leaves (no np.asarray): a device-resident anchor must
+            # reach the device consumer without a host round-trip
+            anchor_flat = dict(named_leaves(anchor)[1])
 
+        from p2pfl_tpu.settings import Settings
+
+        device_consume = Settings.WIRE_COMPRESSION_DEVICE
         flat = {}
+        deferred: list = []  # tk8 entries reconstructed on device post-CRC
         off = 8 + hlen
         crc = 0
         for e in header["t"]:
@@ -331,7 +485,36 @@ def decode_params(
                 q = np.frombuffer(payload, dtype=np.int8, count=nnz, offset=off + nnz * 4)
                 if nnz and int(idx.max()) >= count:
                     raise DecodingParamsError(f"index out of range in {e['k']}")
-                dense = np.asarray(anchor_flat[e["k"]], np.float32).ravel().copy()
+                # both producers emit strictly ascending indices per tensor;
+                # anything else (duplicates, unsorted, nnz > count) is a
+                # malformed payload — and the device scatter-ADD relies on
+                # uniqueness to match the host reconstruction
+                if nnz > 1 and np.any(np.diff(idx.astype(np.int64)) <= 0):
+                    raise DecodingParamsError(
+                        f"duplicate or unsorted indices in {e['k']}"
+                    )
+                anchor_leaf = anchor_flat[e["k"]]
+                # the device scatter indexes per tensor in int32; a tensor
+                # beyond int32 index space (>2^31−1 elements) falls back to
+                # the host consumer's uint32 path
+                if (
+                    device_consume
+                    and isinstance(anchor_leaf, jax.Array)
+                    and count <= np.iinfo(np.int32).max
+                ):
+                    deferred.append(
+                        (
+                            e["k"],
+                            anchor_leaf,
+                            idx,
+                            native.dequantize(q, float(e["scale"])),
+                            tuple(e["shape"]),
+                            dtype,
+                        )
+                    )
+                    off += e["n"]
+                    continue
+                dense = np.asarray(anchor_leaf, np.float32).ravel().copy()
                 dense[idx] = dense[idx] + native.dequantize(q, float(e["scale"]))
                 arr = dense.astype(dtype)
             elif e.get("enc") == "i8":
@@ -343,6 +526,10 @@ def decode_params(
             off += e["n"]
         if "crc" in header and header["crc"] != crc:
             raise DecodingParamsError(f"CRC mismatch: payload corrupted ({crc} != {header['crc']})")
+        if deferred:
+            from p2pfl_tpu.ops import compression as device_codec
+
+            flat.update(device_codec.decode_tk8_device(deferred))
         return flat
     except (DecodingParamsError, AnchorMismatchError):
         raise
@@ -450,6 +637,9 @@ class ModelUpdate:
                 self.cache_version,
                 self.cache_round,
                 Settings.WIRE_COMPRESSION,
+                # producer flag: device and host bytes decode identically but
+                # differ at quantization-tie level — never mix them in one key
+                Settings.WIRE_COMPRESSION_DEVICE,
                 self.anchor_tag,
                 self.ef_residual is not None,
             )
@@ -462,6 +652,7 @@ class ModelUpdate:
             anchor=self.anchor,
             anchor_tag=self.anchor_tag,
             residual=self.ef_residual,
+            owner=cache.owner if cache is not None else None,
         )
         if key is not None:
             cache.put(key, self.encoded)

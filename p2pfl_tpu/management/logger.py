@@ -87,9 +87,13 @@ class P2pflLogger:
         self.local_metrics = LocalMetricStorage()
         self.global_metrics = GlobalMetricStorage()
         # communication-plane counters (gossip data plane: payload-cache
-        # hits/misses, send outcomes/timeouts) — plain accumulators keyed
-        # (node, metric), incremented from gossip worker threads, so they
-        # need no experiment context unlike the two metric stores above
+        # hits/misses, send outcomes/timeouts, and the wire-codec byte
+        # accounting — wire_raw_bytes vs wire_payload_bytes per node gives
+        # the live compression ratio, wire_d2h_bytes the device→host
+        # traffic, wire_encode_device/host the producer split) — plain
+        # accumulators keyed (node, metric), incremented from gossip
+        # worker threads, so they need no experiment context unlike the
+        # two metric stores above
         self._comm_metrics: Dict[str, Dict[str, float]] = {}
         self._comm_lock = threading.Lock()
         # addr -> (node_state, simulation_flag)

@@ -28,18 +28,55 @@ _lib: Optional[ctypes.CDLL] = None
 
 
 def _try_build() -> None:
+    """Build the .so atomically, serialized across processes.
+
+    Two node processes importing concurrently must not both run ``g++ -o
+    libp2tw.so`` in place — one would ``CDLL`` a half-written library.
+    The compile targets a private temp file promoted with :func:`os.replace`
+    (atomic on POSIX), and an ``fcntl`` lockfile serializes builders: the
+    loser of the race wakes up, sees the finished .so, and skips its build.
+    """
     src = os.path.join(_DIR, "codec.cpp")
     if not os.path.exists(src):
         return
     try:
-        subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-o", _SO, src],
-            check=True,
-            capture_output=True,
-            timeout=120,
-        )
+        import fcntl
+    except ImportError:
+        # no fcntl (Windows): build without the inter-process lock — the
+        # temp-file + atomic os.replace promotion alone already prevents a
+        # concurrent importer from CDLLing a torn .so
+        fcntl = None
+    tmp = f"{_SO}.tmp.{os.getpid()}"
+    try:
+        if fcntl is None:
+            _compile(src, tmp)
+            return
+        with open(f"{_SO}.lock", "w") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
+            try:
+                if os.path.exists(_SO):
+                    return  # another process built it while we waited
+                _compile(src, tmp)
+            finally:
+                fcntl.flock(lockf, fcntl.LOCK_UN)
     except (OSError, subprocess.SubprocessError):
         pass
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
+def _compile(src: str, tmp: str) -> None:
+    subprocess.run(
+        ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, src],
+        check=True,
+        capture_output=True,
+        timeout=120,
+    )
+    os.replace(tmp, _SO)
 
 
 def _load() -> Optional[ctypes.CDLL]:

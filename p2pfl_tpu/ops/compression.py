@@ -1,0 +1,308 @@
+"""Device-side wire compression: fused delta / error-feedback / top-k / int8.
+
+The host codec (``learning/weights.py``) walks tensors serially through
+numpy: it pulls the FULL fp32 model device-to-host, argpartitions each
+tensor, and quantizes in a scalar loop — for topk8 that is ~16× more bytes
+over D2H than the payload ultimately carries, on the critical path of every
+gossip send. The math itself (params − anchor delta, residual add, top-k by
+magnitude, symmetric int8 — Seide et al. 2014; Karimireddy et al. 2019) is
+an embarrassingly parallel reduction a TPU finishes in microseconds.
+
+This module is the **device producer** behind
+``Settings.WIRE_COMPRESSION_DEVICE``: one jit-compiled program per model
+spec that
+
+- treats the model as a sequence of flat fp32 segments with *static*
+  sizes and budgets (the spec — leaf paths, sizes, per-tensor k — is a
+  static jit argument, so one compilation serves every round),
+- fuses ``(params − anchor) + residual`` into the same dispatch,
+- serves per-tensor budgets by segment-local selection: one
+  :func:`jax.lax.top_k` per segment, all inside the single fused program
+  (a padded ``[segments, max_len]`` batched top_k was tried first and
+  lost ~4× to padding waste — every row pays the largest tensor's length
+  and budget; per-segment selection costs exactly ``Σ topk(n_i, k_i)``),
+- quantizes symmetrically per segment (``scale = absmax/127``, absmax is
+  simply element 0 of the descending top-k magnitudes),
+- scatters the dequantized payload back onto the delta to produce the new
+  error-feedback residual, which **stays resident on device** as the carry
+  for the next round (the residual buffers are donated, so XLA can update
+  them in place),
+- concatenates exactly the coordinates the wire carries into ``[Σk_i]``
+  outputs — the ONLY device→host transfer is the compressed ``(int32 idx,
+  int8 q, fp32 scale)`` buffers, byte-for-byte what the frame ships.
+
+Dense-int8 segments (``compression="int8"``, or topk-ineligible float
+tensors under topk8) ride the same dispatch via a segment-max absmax.
+Non-float leaves (including bfloat16, which the wire ships raw — numpy
+dtype kind ``V``) fall back to host bytes, exactly like the host producer.
+
+The emitted per-tensor plans feed the SAME framing as the host producer,
+so payloads from either producer decode with the one shared decoder
+(wire-format invariance — asserted by tests/test_device_compression.py).
+:func:`decode_tk8_device` is the matching consumer: dequantized deltas are
+scatter-added onto the device-resident anchor in one fused program instead
+of pulling the anchor host-side and mutating a ``.ravel().copy()``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Pytree = Any
+
+def topk_budget(size: int, topk_frac: float) -> int:
+    """Per-tensor top-k budget — MUST match the host producer's formula."""
+    return max(1, int(np.ceil(size * topk_frac)))
+
+
+# ---- the fused encode program ----
+
+
+def _quantize_seg(vals):
+    """Symmetric int8 of one segment — same formula as ``native.quantize``
+    (``scale = absmax/127``, 1.0 when the segment is all-zero)."""
+    absmax = jnp.max(jnp.abs(vals))
+    scale = jnp.where(absmax > 0, absmax / jnp.float32(127.0), jnp.float32(1.0))
+    q = jnp.clip(jnp.rint(vals / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+@partial(jax.jit, static_argnums=(4, 5, 6, 7), donate_argnums=(2,))
+def _encode_jit(
+    tk_leaves, anchor_leaves, res_leaves, dense_leaves, tk_spec, dense_spec, res_mask, want_res
+):
+    """One dispatch: delta + residual + per-segment top-k + int8.
+
+    Every op here is segment-local on a static slice — XLA fuses the lot
+    into one program, and selection costs ``Σ topk(n_i, k_i)`` with no
+    padding waste. ``res_leaves`` (the error-feedback carry) is donated —
+    the new residual can reuse its buffers and never visits the host.
+    """
+    out = {}
+    if tk_spec:
+        idx_parts, q_parts, scales, new_res = [], [], [], []
+        ri = 0
+        for i, (_key, _size, budget) in enumerate(tk_spec):
+            d = tk_leaves[i].astype(jnp.float32).reshape(-1) - anchor_leaves[i].astype(
+                jnp.float32
+            ).reshape(-1)
+            if res_mask[i]:
+                d = d + res_leaves[ri]
+                ri += 1
+            mags, pos = jax.lax.top_k(jnp.abs(d), budget)  # descending
+            # without the barriers XLA:CPU duplicates the top_k/sort into
+            # every consumer fusion (q, residual, idx outputs) — measured
+            # ~10× wall-clock on the bench MLP; pinning the sorted results
+            # as materialized values keeps selection cost at Σ topk(n_i,k_i)
+            mags, pos = jax.lax.optimization_barrier((mags, pos))
+            scale = jnp.where(mags[0] > 0, mags[0] / jnp.float32(127.0), jnp.float32(1.0))
+            pos = jax.lax.optimization_barrier(jnp.sort(pos))  # wire ships ascending
+            vals = d[pos]
+            q = jnp.clip(jnp.rint(vals / scale), -127, 127).astype(jnp.int8)
+            if want_res:
+                # error feedback: residual = delta − dequantized(sent) at the
+                # selected coordinates, untouched delta everywhere else
+                new_res.append(d.at[pos].set(vals - q.astype(jnp.float32) * scale))
+            idx_parts.append(pos.astype(jnp.int32))
+            q_parts.append(q)
+            scales.append(scale)
+        out["tk"] = (
+            jnp.concatenate(idx_parts) if len(idx_parts) > 1 else idx_parts[0],
+            jnp.concatenate(q_parts) if len(q_parts) > 1 else q_parts[0],
+            jnp.stack(scales),
+            tuple(new_res),  # per-segment carries — stay on device
+        )
+    if dense_spec:
+        dq_parts, dscales = [], []
+        for i in range(len(dense_spec)):
+            q, scale = _quantize_seg(dense_leaves[i].astype(jnp.float32).reshape(-1))
+            dq_parts.append(q)
+            dscales.append(scale)
+        out["dense"] = (
+            jnp.concatenate(dq_parts) if len(dq_parts) > 1 else dq_parts[0],
+            jnp.stack(dscales),
+        )
+    return out
+
+
+def encode_device(
+    named: dict,
+    anchor_named: Optional[dict],
+    topk_plan: dict,
+    residual: Optional[dict],
+) -> tuple[list, int]:
+    """Device producer: per-tensor wire plans from one fused dispatch.
+
+    Only invoked for the ``int8``/``topk8`` modes: every float leaf off
+    the topk plan is dense-int8, never raw.
+
+    ``named``/``anchor_named`` map canonical leaf paths to leaves (device
+    arrays stay on device; stray numpy leaves are uploaded once);
+    ``topk_plan`` (``{path: budget}``) is the caller-computed single
+    source of which tensors are delta-coded and at what k — the same dict
+    the host producer consumes. Returns ``(plans, d2h_bytes)`` where
+    ``plans`` is ``[(entry_dict, buffers)]`` in sorted-key order, ready
+    for the shared framing in ``learning/weights.py`` — the entry/byte
+    layout is identical to the host producer's, so either side's decoder
+    accepts it. ``residual`` (when given) is updated IN PLACE with
+    device-resident slices of the new error-feedback carry; the caller
+    owns validation/pruning of stale entries. ``d2h_bytes`` counts every
+    byte materialized host-side — the compressed buffers plus any raw
+    (non-float) passthrough leaves.
+    """
+    keys = sorted(named)
+    tk_spec: list[tuple[str, int, int]] = []
+    dense_spec: list[tuple[str, int]] = []
+    for key in keys:
+        leaf = named[key]
+        size = int(np.prod(leaf.shape, dtype=np.int64)) if leaf.shape else 1
+        if np.dtype(leaf.dtype).kind != "f":
+            continue  # raw passthrough, handled below
+        if key in topk_plan:
+            tk_spec.append((key, size, topk_plan[key]))
+        else:
+            dense_spec.append((key, size))
+    tk_spec_t = tuple(tk_spec)
+    dense_spec_t = tuple(dense_spec)
+
+    tk_leaves = tuple(jnp.asarray(named[k]) for k, _s, _b in tk_spec)
+    anchor_leaves = tuple(jnp.asarray(anchor_named[k]) for k, _s, _b in tk_spec)
+    res_mask = tuple(
+        residual is not None and k in residual for k, _s, _b in tk_spec
+    )
+    res_leaves = tuple(
+        jnp.asarray(residual[k], jnp.float32).reshape(-1)
+        for (k, _s, _b), present in zip(tk_spec, res_mask)
+        if present
+    )
+    dense_leaves = tuple(jnp.asarray(named[k]) for k, _s in dense_spec)
+
+    try:
+        outs = _encode_jit(
+            tk_leaves,
+            anchor_leaves,
+            res_leaves,
+            dense_leaves,
+            tk_spec_t,
+            dense_spec_t,
+            res_mask,
+            residual is not None,
+        )
+    except Exception:
+        # res_leaves were DONATED: a dispatch that fails after handing
+        # buffers to the runtime (transient OOM) leaves the store's arrays
+        # deleted while still referenced — and .size metadata survives
+        # deletion, so _validate_residual would never notice. Drop the
+        # entries we donated: the next encode restarts their carry from
+        # zero instead of dying on 'Array has been deleted' forever.
+        if residual is not None:
+            for (key, _size, _b), present in zip(tk_spec, res_mask):
+                if present:
+                    residual.pop(key, None)
+        raise
+
+    d2h = 0
+    idx_np = q_np = scales_np = None
+    if tk_spec:
+        idx_dev, q_dev, scales_dev, new_res = outs["tk"]
+        # the ONLY model-sized D2H is these compressed buffers
+        idx_np = np.asarray(idx_dev)
+        q_np = np.asarray(q_dev)
+        scales_np = np.asarray(scales_dev)
+        d2h += idx_np.nbytes + q_np.nbytes + scales_np.nbytes
+        if residual is not None:
+            for (key, _size, _b), carry in zip(tk_spec, new_res):
+                residual[key] = carry
+    qd_np = scales_d_np = None
+    if dense_spec:
+        qd_dev, scales_d_dev = outs["dense"]
+        qd_np = np.asarray(qd_dev)
+        scales_d_np = np.asarray(scales_d_dev)
+        d2h += qd_np.nbytes + scales_d_np.nbytes
+
+    plans = []
+    tk_i = dense_i = 0
+    tk_off = dense_off = 0
+    tk_lookup = {k: i for i, (k, _s, _b) in enumerate(tk_spec)}
+    for key in keys:
+        leaf = named[key]
+        entry = {
+            "k": key,
+            "shape": list(leaf.shape),
+            "dtype": np.dtype(leaf.dtype).name,
+        }
+        if key in tk_lookup and tk_i < len(tk_spec) and tk_spec[tk_i][0] == key:
+            _key, size, budget = tk_spec[tk_i]
+            idx = idx_np[tk_off : tk_off + budget].view(np.uint32)
+            q = q_np[tk_off : tk_off + budget]
+            entry["enc"] = "tk8"
+            entry["scale"] = float(scales_np[tk_i])
+            entry["nnz"] = int(budget)
+            plans.append((entry, (idx.tobytes(), q.tobytes())))
+            tk_off += budget
+            tk_i += 1
+        elif dense_i < len(dense_spec) and dense_spec[dense_i][0] == key:
+            _key, size = dense_spec[dense_i]
+            q = qd_np[dense_off : dense_off + size]
+            entry["enc"] = "i8"
+            entry["scale"] = float(scales_d_np[dense_i])
+            plans.append((entry, (q.tobytes(),)))
+            dense_off += size
+            dense_i += 1
+        else:
+            raw = np.ascontiguousarray(np.asarray(leaf)).tobytes()
+            d2h += len(raw)
+            plans.append((entry, (raw,)))
+    return plans, d2h
+
+
+# ---- the fused decode (consumer) program ----
+
+
+@jax.jit
+def _scatter_jit(anchor_leaves, idx_leaves, val_leaves):
+    """Dequantized deltas scatter-added onto each device anchor leaf.
+
+    Per-leaf scatters (not one concatenated flat buffer): indices stay
+    per-tensor — never summed into global offsets that could overflow
+    int32 on multi-billion-parameter models — and no transient full-model
+    fp32 copy is allocated for the concat. Still one fused dispatch.
+    """
+    return tuple(
+        leaf.astype(jnp.float32).reshape(-1).at[idx].add(vals)
+        for leaf, idx, vals in zip(anchor_leaves, idx_leaves, val_leaves)
+    )
+
+
+def decode_tk8_device(items: list) -> dict:
+    """Device consumer for the ``tk8`` entries of one payload.
+
+    ``items`` is ``[(key, anchor_leaf, idx_u32, vals_f32, shape, dtype)]``
+    in wire order, where ``vals`` are the already-dequantized delta values
+    (dequantization is ``q * scale`` — negligible host work on payload-
+    sized data) and ``anchor_leaf`` is the device-resident anchor tensor.
+    Reconstructs ``anchor + scatter(delta)`` in ONE fused dispatch instead
+    of pulling each anchor tensor host-side and mutating a ravel-copy; the
+    returned leaves are device arrays ready for ``restore_like``.
+
+    The caller has already validated indices (strictly ascending, in
+    range, tensor size inside int32 index space), so each scatter-add
+    touches each coordinate at most once.
+    """
+    anchor_leaves = tuple(leaf for _k, leaf, _i, _v, _sh, _dt in items)
+    idx_leaves = tuple(
+        jnp.asarray(idx.astype(np.int32)) for _k, _l, idx, _v, _sh, _dt in items
+    )
+    val_leaves = tuple(
+        jnp.asarray(np.asarray(v, np.float32)) for _k, _l, _i, v, _sh, _dt in items
+    )
+    dense = _scatter_jit(anchor_leaves, idx_leaves, val_leaves)
+    return {
+        key: flat.reshape(shape).astype(dtype)
+        for (key, _leaf, _idx, _vals, shape, dtype), flat in zip(items, dense)
+    }

@@ -97,6 +97,16 @@ class Settings:
     WIRE_COMPRESSION: str = "none"
     # Fraction of delta coordinates kept per tensor by topk8.
     TOPK_FRACTION: float = 0.05
+    # Run the int8/topk8 encode as one fused device program
+    # (ops/compression.py): (params − anchor) delta, error-feedback add,
+    # batched top-k and int8 quantization in a single jit dispatch, with
+    # only the compressed (idx, q, scale) buffers crossing device→host and
+    # the EF residual staying device-resident between rounds. Engages only
+    # when the params are already jax Arrays; False forces the host numpy
+    # path (bit-format-compatible baseline — one decoder decodes both).
+    # The decode side mirrors it: a device-resident anchor is updated by a
+    # fused scatter-add instead of a host ravel-copy.
+    WIRE_COMPRESSION_DEVICE: bool = True
     # Error feedback for topk8: dropped coordinates accumulate locally and
     # re-enter the next round's delta (Seide et al. 2014).
     TOPK_ERROR_FEEDBACK: bool = True
@@ -232,6 +242,7 @@ def set_test_settings() -> None:
     Settings.GOSSIP_SEND_TIMEOUT = 2.0
     Settings.GOSSIP_PAYLOAD_CACHE = True
     Settings.MEMORY_WIRE_CODEC = False
+    Settings.WIRE_COMPRESSION_DEVICE = True
     Settings.CHUNK_STAGING_DEPTH = 2
     Settings.CHUNK_FUSED_REDUCE = True
     Settings.CHUNK_DONATE_BUFFERS = True

@@ -1,0 +1,459 @@
+"""Device-side wire compression (``Settings.WIRE_COMPRESSION_DEVICE``).
+
+The fused device producer/consumer (``ops/compression.py``) against the
+host numpy baseline: wire-format invariance (one decoder decodes both
+producers, host frames stay bit-identical to the pre-device format),
+host/device decode parity within the int8 quantization tolerance, the
+error-feedback residual living on device across rounds, staleness
+pruning, and malformed-payload fuzz for the tk8 path.
+"""
+
+import json
+import struct
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from p2pfl_tpu import native
+from p2pfl_tpu.exceptions import AnchorMismatchError, DecodingParamsError
+from p2pfl_tpu.learning.weights import (
+    ModelUpdate,
+    PayloadCache,
+    _frame,
+    decode_params,
+    encode_params,
+    reset_wire_stats,
+    wire_stats,
+)
+from p2pfl_tpu.settings import Settings
+
+
+@pytest.fixture(autouse=True)
+def _settings():
+    yield
+    Settings.WIRE_COMPRESSION = "none"
+    Settings.WIRE_COMPRESSION_DEVICE = True
+    Settings.TOPK_FRACTION = 0.05
+    Settings.TOPK_ERROR_FEEDBACK = True
+
+
+def _tree(seed=0):
+    """Mixed tree: big/medium float leaves (topk path), a tiny float leaf
+    (dense-i8 under topk8), and an int leaf (raw passthrough)."""
+    rng = np.random.default_rng(seed)
+    return {
+        "layer0/w": rng.normal(size=(64, 32)).astype(np.float32),
+        "layer1/w": rng.normal(size=(300,)).astype(np.float32),
+        "tiny/b": rng.normal(size=(10,)).astype(np.float32),
+        "steps": np.arange(5, dtype=np.int32),
+    }
+
+
+def _to_device(tree):
+    return {k: jnp.asarray(v) for k, v in tree.items()}
+
+
+def _anchor_of(tree):
+    return {
+        k: (v - 0.01 if np.dtype(v.dtype).kind == "f" else v) for k, v in tree.items()
+    }
+
+
+def _assert_trees_close(a, b, atol):
+    assert set(a) == set(b)
+    for k in a:
+        np.testing.assert_allclose(
+            np.asarray(a[k], np.float32), np.asarray(b[k], np.float32), atol=atol
+        )
+
+
+# ---- wire-format invariance ----
+
+
+@pytest.mark.parametrize("comp", ["int8", "topk8"])
+def test_cross_producer_frames_decode_with_one_decoder(comp):
+    """Device-encoded payloads decode with the unchanged host consumer and
+    host-encoded payloads with the device consumer — same decoder function,
+    same tolerances, no producer dialect."""
+    params = _tree(0)
+    anchor = _anchor_of(params)
+    kw = {"compression": comp}
+    if comp == "topk8":
+        kw.update(anchor=anchor, anchor_tag="1:1")
+
+    Settings.WIRE_COMPRESSION_DEVICE = False
+    host_payload = encode_params(params, **kw)
+    kw_dev = dict(kw)
+    if comp == "topk8":
+        kw_dev["anchor"] = _to_device(anchor)
+    Settings.WIRE_COMPRESSION_DEVICE = True
+    device_payload = encode_params(_to_device(params), **kw_dev)
+
+    dkw = {"anchor": anchor, "anchor_tag": "1:1"} if comp == "topk8" else {}
+    dkw_dev = (
+        {"anchor": _to_device(anchor), "anchor_tag": "1:1"} if comp == "topk8" else {}
+    )
+    # host consumer × both producers
+    Settings.WIRE_COMPRESSION_DEVICE = False
+    ref = decode_params(host_payload, **dkw)
+    cross = decode_params(device_payload, **dkw)
+    _assert_trees_close(ref, cross, atol=0.05)
+    _assert_trees_close(ref, params, atol=0.05)
+    # device consumer × both producers (anchor device-resident)
+    Settings.WIRE_COMPRESSION_DEVICE = True
+    dev_ref = decode_params(host_payload, **dkw_dev)
+    dev_cross = decode_params(device_payload, **dkw_dev)
+    _assert_trees_close(ref, dev_ref, atol=0.05)
+    _assert_trees_close(ref, dev_cross, atol=0.05)
+    if comp == "topk8":
+        # the device consumer's reconstruction never left the device
+        assert isinstance(dev_ref["layer0/w"], jax.Array)
+    # raw (non-float) leaves are bit-preserved by both producers
+    np.testing.assert_array_equal(np.asarray(cross["steps"]), params["steps"])
+
+
+def test_host_path_bit_identical_to_reference_frames():
+    """``WIRE_COMPRESSION_DEVICE=False`` must emit byte-for-byte the frames
+    the pre-device codec produced. The reference encoder below is a frozen
+    copy of that algorithm — any framing/ordering/scale drift in the host
+    producer fails this, on any backend (it reuses the same native
+    quantize/CRC the production path uses)."""
+
+    def reference_encode(tree, compression, anchor=None, anchor_tag=None, residual=None):
+        flat = {k: np.asarray(v) for k, v in tree.items()}
+        anchor_flat = (
+            {k: np.asarray(v) for k, v in anchor.items()} if anchor is not None else None
+        )
+        entries, buffers, crc = [], [], 0
+        for key in sorted(flat):
+            arr = flat[key]
+            entry = {"k": key, "shape": list(arr.shape), "dtype": arr.dtype.name}
+            use_topk = (
+                compression == "topk8"
+                and arr.dtype.kind == "f"
+                and anchor_flat is not None
+                and key in anchor_flat
+                and arr.size > 16
+            )
+            if use_topk:
+                delta = np.asarray(arr, np.float32).ravel() - np.asarray(
+                    anchor_flat[key], np.float32
+                ).ravel()
+                if residual is not None and key in residual:
+                    delta = delta + residual[key]
+                k = max(1, int(np.ceil(arr.size * Settings.TOPK_FRACTION)))
+                idx = np.argpartition(np.abs(delta), -k)[-k:].astype(np.uint32)
+                idx.sort()
+                q, scale = native.quantize(delta[idx])
+                if residual is not None:
+                    sent = np.zeros_like(delta)
+                    sent[idx] = native.dequantize(q, scale)
+                    residual[key] = delta - sent
+                bufs = (idx.tobytes(), q.tobytes())
+                entry.update(enc="tk8", scale=scale, nnz=int(k))
+            elif compression in ("int8", "topk8") and arr.dtype.kind == "f":
+                q, scale = native.quantize(np.asarray(arr, dtype=np.float32))
+                bufs = (q.tobytes(),)
+                entry.update(enc="i8", scale=scale)
+            else:
+                bufs = (np.ascontiguousarray(arr).tobytes(),)
+            entry["n"] = sum(len(b) for b in bufs)
+            for b in bufs:
+                crc = native.crc32c(b, crc)
+                buffers.append(b)
+            entries.append(entry)
+        head = {"v": 1, "t": entries, "crc": crc}
+        if any(e.get("enc") == "tk8" for e in entries):
+            head["anchor_tag"] = anchor_tag if anchor_tag is not None else ""
+        header = json.dumps(head).encode("utf-8")
+        return b"P2TW" + struct.pack("<I", len(header)) + header + b"".join(buffers)
+
+    Settings.WIRE_COMPRESSION_DEVICE = False
+    params = _tree(3)
+    anchor = _anchor_of(params)
+    assert encode_params(params, compression="none") == reference_encode(params, "none")
+    assert encode_params(params, compression="int8") == reference_encode(params, "int8")
+    res_now, res_ref = {}, {}
+    got = encode_params(
+        params, compression="topk8", anchor=anchor, anchor_tag="2:7", residual=res_now
+    )
+    want = reference_encode(params, "topk8", anchor=anchor, anchor_tag="2:7", residual=res_ref)
+    assert got == want
+    for k in res_ref:
+        np.testing.assert_array_equal(res_now[k], res_ref[k])
+
+
+# ---- error feedback on device ----
+
+
+def test_error_feedback_device_residual_across_rounds():
+    """≥3 rounds of device encode: the residual store carries DEVICE arrays
+    between rounds, and error feedback telescopes exactly like the host
+    path (mean transmitted delta converges to the true delta)."""
+    Settings.WIRE_COMPRESSION_DEVICE = True
+    Settings.TOPK_FRACTION = 0.3
+    anchor_np = _tree(0)
+    rng = np.random.default_rng(3)
+    delta = rng.normal(size=anchor_np["layer0/w"].shape).astype(np.float32)
+    params_np = dict(anchor_np)
+    params_np["layer0/w"] = anchor_np["layer0/w"] + delta
+    params, anchor = _to_device(params_np), _to_device(anchor_np)
+
+    residual = {}
+    sent = []
+    for _ in range(4):
+        payload = encode_params(
+            params, compression="topk8", anchor=anchor, anchor_tag="0:0", residual=residual
+        )
+        # device-resident carry: no np.ndarray ever enters the store
+        assert all(isinstance(v, jax.Array) for v in residual.values())
+        flat = decode_params(payload, anchor=anchor, anchor_tag="0:0")
+        sent.append(np.asarray(flat["layer0/w"], np.float32) - anchor_np["layer0/w"])
+    one_shot = np.linalg.norm(delta - sent[0])
+    mean_err = np.linalg.norm(delta - np.mean(sent, axis=0))
+    assert mean_err < one_shot * 0.6, (one_shot, mean_err)
+    # exact bookkeeping: residual_T = T·delta − Σ sent_t up to fp rounding
+    np.testing.assert_allclose(
+        np.asarray(residual["layer0/w"]).reshape(delta.shape),
+        4 * delta - np.sum(sent, axis=0),
+        atol=1e-3,
+    )
+
+
+def test_host_device_error_feedback_parity():
+    """Host and device EF runs from identical state transmit statistically
+    identical mass (same telescoping sum, within quantization-tie noise)."""
+    Settings.TOPK_FRACTION = 0.25
+    anchor_np = _tree(1)
+    rng = np.random.default_rng(7)
+    # distinct |delta| everywhere: tie-breaking between argpartition and
+    # top_k is the one legitimate divergence, so keep ties out of the test
+    params_np = {
+        k: (v + rng.normal(scale=0.05, size=v.shape).astype(np.float32)
+            if np.dtype(v.dtype).kind == "f" else v)
+        for k, v in anchor_np.items()
+    }
+    totals = {}
+    for mode, flag in (("host", False), ("device", True)):
+        Settings.WIRE_COMPRESSION_DEVICE = flag
+        tree = _to_device(params_np) if flag else params_np
+        anc = _to_device(anchor_np) if flag else anchor_np
+        residual = {}
+        acc = np.zeros_like(anchor_np["layer0/w"])
+        for _ in range(3):
+            payload = encode_params(
+                tree, compression="topk8", anchor=anc, anchor_tag="0:0", residual=residual
+            )
+            Settings.WIRE_COMPRESSION_DEVICE = False  # decode via host consumer
+            flat = decode_params(payload, anchor=anchor_np, anchor_tag="0:0")
+            Settings.WIRE_COMPRESSION_DEVICE = flag
+            acc += np.asarray(flat["layer0/w"], np.float32) - anchor_np["layer0/w"]
+        totals[mode] = acc
+    np.testing.assert_allclose(totals["host"], totals["device"], atol=0.01)
+
+
+# ---- residual staleness (satellite) ----
+
+
+def test_stale_residual_entries_dropped_not_crashed():
+    Settings.WIRE_COMPRESSION_DEVICE = False
+    params = _tree(2)
+    anchor = _anchor_of(params)
+    residual = {
+        "layer0/w": np.zeros(999, np.float32),  # wrong size: tensor reshaped
+        "ghost/w": np.zeros(64, np.float32),  # key no longer exists
+        "tiny/b": np.zeros(10, np.float32),  # off the topk path (too small)
+        "layer1/w": np.full(300, 0.5, np.float32),  # valid — must survive
+    }
+    payload = encode_params(
+        params, compression="topk8", anchor=anchor, anchor_tag="0:0", residual=residual
+    )
+    decode_params(payload, anchor=anchor, anchor_tag="0:0")
+    assert set(residual) == {"layer0/w", "layer1/w"}  # stale entries pruned
+    # the valid entry was folded (residual got rewritten by the encode)
+    assert not np.allclose(np.asarray(residual["layer1/w"]), 0.5)
+
+
+def test_residual_survives_producer_flips():
+    """host → device → host encodes share one residual store: each producer
+    normalizes the other's arrays instead of crashing or dropping them."""
+    params_np = _tree(4)
+    anchor_np = _anchor_of(params_np)
+    params, anchor = _to_device(params_np), _to_device(anchor_np)
+    residual = {}
+    for flag, tree, anc in (
+        (False, params_np, anchor_np),
+        (True, params, anchor),
+        (False, params_np, anchor_np),
+    ):
+        Settings.WIRE_COMPRESSION_DEVICE = flag
+        payload = encode_params(
+            tree, compression="topk8", anchor=anc, anchor_tag="0:0", residual=residual
+        )
+        flat = decode_params(payload, anchor=anchor_np, anchor_tag="0:0")
+        _assert_trees_close(flat, params_np, atol=0.05)
+    # a compression-mode flip prunes the whole store (keys left the topk path)
+    encode_params(params_np, compression="int8", anchor=None, residual=residual)
+    assert residual == {}
+
+
+# ---- malformed tk8 payload fuzz (satellite) ----
+
+
+def _tk8_frame(key, shape, idx, q, scale, nnz, anchor_tag="0:0"):
+    """Hand-build a tk8 frame with a VALID CRC so decode exercises the
+    structural validators, not the checksum."""
+    idx = np.asarray(idx, np.uint32)
+    q = np.asarray(q, np.int8)
+    entry = {
+        "k": key,
+        "shape": list(shape),
+        "dtype": "float32",
+        "enc": "tk8",
+        "scale": float(scale),
+        "nnz": int(nnz),
+    }
+    return _frame([(entry, (idx.tobytes(), q.tobytes()))], anchor_tag)
+
+
+@pytest.mark.parametrize("device", [False, True])
+def test_malformed_tk8_payloads_rejected(device):
+    Settings.WIRE_COMPRESSION_DEVICE = device
+    anchor_np = {"w": np.zeros((8, 8), np.float32)}
+    anchor = _to_device(anchor_np) if device else anchor_np
+    dk = {"anchor": anchor, "anchor_tag": "0:0"}
+
+    ok = _tk8_frame("w", (8, 8), [1, 5, 9], [10, -20, 30], 0.01, 3)
+    np.testing.assert_allclose(
+        np.asarray(decode_params(ok, **dk)["w"]).ravel()[[1, 5, 9]],
+        [0.1, -0.2, 0.3],
+        atol=1e-6,
+    )
+    # duplicate indices: the device scatter-ADD would double-apply where the
+    # host write-wins — must be rejected, not silently divergent
+    with pytest.raises(DecodingParamsError, match="duplicate or unsorted"):
+        decode_params(_tk8_frame("w", (8, 8), [1, 5, 5], [1, 2, 3], 0.01, 3), **dk)
+    with pytest.raises(DecodingParamsError, match="duplicate or unsorted"):
+        decode_params(_tk8_frame("w", (8, 8), [9, 5, 1], [1, 2, 3], 0.01, 3), **dk)
+    with pytest.raises(DecodingParamsError, match="out of range"):
+        decode_params(_tk8_frame("w", (8, 8), [1, 5, 64], [1, 2, 3], 0.01, 3), **dk)
+    # nnz lies about the buffer length
+    with pytest.raises(DecodingParamsError, match="inconsistent header"):
+        decode_params(_tk8_frame("w", (8, 8), [1, 5, 9], [1, 2, 3], 0.01, 7), **dk)
+    # nnz > tensor size cannot carry strictly-ascending in-range indices
+    with pytest.raises(DecodingParamsError):
+        decode_params(
+            _tk8_frame("w", (2,), [0, 1, 1], [1, 2, 3], 0.01, 3),
+            anchor={"w": (jnp.zeros(2) if device else np.zeros(2, np.float32))},
+            anchor_tag="0:0",
+        )
+    # missing anchor tensor for a delta-coded key
+    with pytest.raises(AnchorMismatchError, match="no anchor tensor"):
+        decode_params(
+            _tk8_frame("nope", (8, 8), [1], [5], 0.01, 1), **dk
+        )
+
+
+# ---- observability (satellite) ----
+
+
+def test_wire_byte_counters_per_node_and_process():
+    from p2pfl_tpu.management.logger import logger
+
+    logger.reset_comm_metrics()
+    reset_wire_stats()
+    Settings.WIRE_COMPRESSION = "topk8"
+    Settings.WIRE_COMPRESSION_DEVICE = True
+    params = _to_device(_tree(0))
+    cache = PayloadCache(owner="nodeA:1")
+    upd = ModelUpdate(
+        params,
+        ["nodeA:1"],
+        1,
+        anchor=_to_device(_anchor_of(_tree(0))),
+        anchor_tag="0:0",
+        payload_cache=cache,
+        cache_version=1,
+    )
+    upd.cache_round = 0
+    payload = upd.encode()
+    assert upd.encode() is payload  # second call: cache, no new counters
+
+    m = logger.get_comm_metrics("nodeA:1")
+    assert m["wire_encode_device"] == 1 and "wire_encode_host" not in m
+    assert m["wire_payload_bytes"] == len(payload)
+    assert m["wire_raw_bytes"] > m["wire_payload_bytes"] > m["wire_d2h_bytes"] * 0.2
+    # D2H carried ~the compressed bytes, not the raw model
+    assert m["wire_d2h_bytes"] < m["wire_raw_bytes"] / 4
+    s = wire_stats()
+    assert s["device_encodes"] >= 1 and s["payload_bytes"] >= len(payload)
+    Settings.WIRE_COMPRESSION = "none"
+
+
+def test_payload_cache_key_includes_producer_flag():
+    Settings.WIRE_COMPRESSION = "int8"
+    params = _to_device(_tree(0))
+    cache = PayloadCache(owner="n")
+
+    def fresh():
+        u = ModelUpdate(params, ["n"], 1, payload_cache=cache, cache_version=7)
+        u.cache_round = 0
+        return u
+
+    Settings.WIRE_COMPRESSION_DEVICE = True
+    a = fresh().encode()
+    Settings.WIRE_COMPRESSION_DEVICE = False
+    b = fresh().encode()
+    # flipping the producer may NOT replay the other producer's bytes
+    assert cache.misses == 2, (cache.hits, cache.misses)
+    decode_ref = decode_params(a)
+    _assert_trees_close(decode_ref, decode_params(b), atol=0.05)
+    Settings.WIRE_COMPRESSION = "none"
+
+
+def test_scalar_pytree_leaves_still_encode():
+    """Python-scalar leaves (no .dtype) are normalized like the old
+    ``_flatten_named`` path did — both producers, all modes."""
+    tree = {"w": np.ones(32, np.float32), "lr": 0.1, "step": 3}
+    anchor = {"w": np.ones(32, np.float32) * 0.99, "lr": 0.1, "step": 3}
+    for flag in (False, True):
+        Settings.WIRE_COMPRESSION_DEVICE = flag
+        for comp, kw in (
+            ("none", {}),
+            ("int8", {}),
+            ("topk8", {"anchor": anchor, "anchor_tag": "0:0"}),
+        ):
+            payload = encode_params(tree, compression=comp, **kw)
+            dk = {"anchor": anchor, "anchor_tag": "0:0"} if comp == "topk8" else {}
+            flat = decode_params(payload, **dk)
+            assert float(np.asarray(flat["lr"])) == pytest.approx(0.1, abs=1e-3)
+            assert int(np.asarray(flat["step"])) == 3
+
+
+# ---- gossiper lazy payload resolution ----
+
+
+def test_gossiper_resolves_lazy_payloads_on_calling_thread():
+    from p2pfl_tpu.communication.gossiper import Gossiper
+
+    sent = []
+    g = Gossiper("me", lambda nei, env, create_connection=False: sent.append((nei, env)) or True)
+    built = []
+
+    def make(nei, value):
+        def build():
+            built.append(nei)
+            return value
+
+        return build
+
+    # pool not started → sequential path; callables resolve, None declines
+    results, skipped = g._dispatch_sends(
+        [("a", make("a", "payload-a")), ("b", make("b", None)), ("c", "eager")]
+    )
+    assert built == ["a", "b"]
+    assert sent == [("a", "payload-a"), ("c", "eager")]
+    assert results == [True, None, True]
+    assert skipped == []
