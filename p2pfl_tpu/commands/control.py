@@ -17,7 +17,12 @@ if TYPE_CHECKING:
 
 
 class ModelInitializedCommand(Command):
-    """Peer announced its model is initialized → ``nei_status[source] = -1``."""
+    """Peer announced its model is initialized → ``nei_status[source] = -1``.
+
+    Monotone: a stale redelivery (TTL relay that outlived the dedup ring)
+    must not regress a peer that already reported finishing a round back
+    to "merely initialized" — peer status only ever moves forward.
+    """
 
     def __init__(self, state: "NodeState") -> None:
         self._state = state
@@ -27,7 +32,9 @@ class ModelInitializedCommand(Command):
         return "model_initialized"
 
     def execute(self, source: str, round: int, *args, **kwargs) -> None:  # noqa: A002
-        self._state.nei_status[source] = -1
+        # -1 is the floor of the status lattice: only record it for a peer
+        # with no status yet (nei_status is reset at experiment boundaries)
+        self._state.nei_status.setdefault(source, -1)
 
 
 class SecAggPubCommand(Command):
@@ -432,9 +439,38 @@ class ModelsAggregatedCommand(Command):
     def execute(self, source: str, round: int, *args, **kwargs) -> None:  # noqa: A002
         node = self._node
         st = node.state
+        # capture the coverage dict BEFORE the round check: increase_round()
+        # bumps st.round and THEN replaces st.models_aggregated with a fresh
+        # dict, so under this ordering every interleaving is safe — if we
+        # captured the NEW dict the bump already happened and the round
+        # check below rejects; if the swap lands after our check, we write
+        # into the discarded OLD dict (harmless). Re-reading
+        # st.models_aggregated at write time instead would let a round-N
+        # entry race into round N+1's dict, where the union-merge would pin
+        # it as a stale full-coverage superset into the next round.
+        coverage = st.models_aggregated
         if st.round is None or round != st.round:
             return
-        st.models_aggregated[source] = list(args)
+        # UNION-merge, never overwrite: within a round a peer's real
+        # coverage only grows (aggregator.add_model returns monotonically
+        # growing contributor sets), but its broadcasts can be re-delivered
+        # out of order — TTL relays and stalled-peer requeues keep old
+        # copies alive long past the bounded dedup ring
+        # (AMOUNT_LAST_MESSAGES_SAVED), and a stale copy re-accepted after
+        # ring overflow used to OVERWRITE the newer view. That regression
+        # re-opened the partial-gossip loop's convergence detector (status
+        # kept changing, phantom "incomplete" candidates reappeared) and is
+        # the root cause of the 8-node slow-peer round-0 wedge: one storm
+        # of stale redeliveries could hold six nodes in TrainStage
+        # indefinitely. Coverage views form a lattice; merges must be
+        # monotone. Regression-tested in tests/test_chaos.py. The lock
+        # makes the read-merge-write atomic — handlers run on whatever
+        # thread delivered the message, and two unlocked merges for the
+        # same source could clobber each other (losing a sender's FINAL
+        # announcement, which its exited push loop never repeats).
+        with st.status_merge_lock:
+            prev = coverage.get(source)
+            coverage[source] = sorted(set(prev) | set(args)) if prev else list(args)
         from p2pfl_tpu.settings import Settings
 
         if not (Settings.SECURE_AGGREGATION and Settings.SECAGG_DOUBLE_MASK):
@@ -458,7 +494,12 @@ class ModelsReadyCommand(Command):
     def execute(self, source: str, round: int, *args, **kwargs) -> None:  # noqa: A002
         st = self._state
         if st.round is not None and round in (st.round - 1, st.round):
-            st.nei_status[source] = round
+            # max-merge: a stale redelivery of an older round's announcement
+            # must not regress the peer's status (same lattice discipline —
+            # and the same merge lock, the read-max-write must be atomic —
+            # as models_aggregated: the round-0 wedge fix)
+            with st.status_merge_lock:
+                st.nei_status[source] = max(st.nei_status.get(source, -1), round)
         else:
             logger.debug(st.addr, f"models_ready from {source} for round {round} (at {st.round}) — ignored")
 

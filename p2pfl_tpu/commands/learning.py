@@ -69,6 +69,34 @@ class InitModelCommand(Command):
     def execute(self, source: str, round: int, *args, update: ModelUpdate = None, **kwargs) -> None:  # noqa: A002
         node = self._node
         state = node.state
+        if not node.learning_active() or state.round is None:
+            # no experiment running on this node: a late init_model (e.g.
+            # delivered after StartLearningStage's graceful timeout abort)
+            # must not latch the initialized event, or the NEXT experiment
+            # would train from the aborted experiment's init and discard
+            # its real one. The round check closes the teardown window the
+            # thread-liveness check alone leaves open: state.clear() runs
+            # WHILE the learning thread is still unwinding (the graceful
+            # abort clears before the workflow loop returns, stop_learning
+            # clears on the command thread mid-stage), and a straggler
+            # latching the event after that clear() would poison the next
+            # experiment, whose set_experiment cannot re-clear the event
+            # (the initiator legitimately pre-sets it before its thread
+            # starts). An experiment that IS waiting for init always has
+            # round == 0 (set_experiment runs at stage entry, before the
+            # wait). But an init_model racing AHEAD of this node's
+            # start_learning (weights plane vs TTL-flooded control
+            # broadcast) cannot simply be dropped either — the initiator's
+            # push loop exits once its status view stops changing, so a
+            # redelivery may never come. Stash it unlatched;
+            # StartLearningStage consumes the stash iff the experiment
+            # starts within Settings.EARLY_INIT_TTL.
+            node.stash_early_init(update)
+            logger.debug(
+                state.addr,
+                f"init_model from {source} stashed — no experiment running yet",
+            )
+            return
         if state.model_initialized_event.is_set():
             logger.debug(state.addr, f"init_model from {source} ignored — already initialized")
             return
@@ -138,7 +166,13 @@ class AddModelCommand(Command):
             # own contributor checks (waiting mode requires an exact
             # train-set match) would reject it anyway, and the behind node
             # recovers via its normal timeout path.
-            if not state.train_set or set(update.contributors) != set(state.train_set):
+            # same acceptance interval as the aggregator's waiting mode:
+            # anything from the survivors' partial up to the full elected
+            # set counts as "full" after mid-round repair (the sender's
+            # eviction view may differ from ours)
+            full = set(state.train_set)
+            survivors = full - state.train_set_evicted
+            if not survivors or not (survivors <= set(update.contributors) <= full):
                 logger.debug(
                     state.addr,
                     f"add_model from {source} for future round {round} (at "

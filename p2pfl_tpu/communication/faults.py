@@ -1,0 +1,303 @@
+"""Seeded, deterministic fault injection for the overlay.
+
+Production FL systems treat device dropout and stragglers as the common
+case, not the exception (Bonawitz et al., *Towards Federated Learning at
+Scale*, MLSys 2019) — but until this module the only way to exercise this
+repo's safety nets (vote timeout, ``AGGREGATION_TIMEOUT``, stalled-peer
+skip, secagg dropout recovery, the retry/breaker control plane) was ad-hoc
+monkeypatching per test. A :class:`FaultPlan` describes the chaos to
+inject declaratively:
+
+- per-edge **drop** / **delay** / **duplicate** probabilities
+  (:class:`EdgeFault`, directed ``src -> dst``),
+- **one-way partitions** (``src`` cannot reach ``dst``; the reverse
+  direction is untouched unless also listed),
+- **slow peers** (every inbound weights delivery to that node pays a fixed
+  latency — the straggler the gossip send budget exists for),
+- **crash-at-stage** hooks (:class:`CrashSpec`): a node hard-crashes — no
+  goodbye messages, exactly like a killed process — when its learning
+  thread enters a named stage at a given round.
+
+Determinism: every directed edge draws from its own
+``random.Random(f"{seed}:{src}->{dst}")`` stream, so the k-th send on an
+edge sees the same drop/duplicate verdict on every run regardless of how
+the OS interleaves the other edges' threads. Replaying a chaos run is
+``FaultPlan(seed=...)`` with the same topology and workload.
+
+The plan wraps a transport at the :class:`CommunicationProtocol` send seam
+(``protocol.fault_injector``) — every plane (heartbeat, control gossip,
+model gossip) passes through it, and any transport (in-memory, gRPC) can
+be wrapped. ``install_fault_plan(nodes, plan)`` wires a whole in-process
+federation; chaos federations then run under plain pytest
+(``tests/test_chaos.py``).
+
+Duplicate semantics: a duplicated *control* message is re-delivered after
+``EdgeFault.duplicate_delay`` seconds **with a fresh message id** — it
+models a relayed copy that comes back after the receiver's bounded dedup
+ring (``Settings.AMOUNT_LAST_MESSAGES_SAVED``) has forgotten the
+original, which is exactly the stale-redelivery storm behind the round-0
+wedge this layer was built to reproduce (the redelivered copy carries
+``ttl=1`` so it cannot re-amplify through relays). Duplicated weights
+envelopes are re-sent as-is — the data plane has no dedup and must
+tolerate replays through the aggregator's contributor checks.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass
+from typing import TYPE_CHECKING, Callable, Iterable, Optional
+
+from p2pfl_tpu.communication.message import Message, WeightsEnvelope
+from p2pfl_tpu.management.logger import logger
+
+if TYPE_CHECKING:
+    from p2pfl_tpu.node import Node
+
+
+@dataclass(frozen=True)
+class EdgeFault:
+    """Faults applied to one directed edge (or as the plan-wide default).
+
+    ``scope`` limits which plane the fault touches: ``"both"`` (default),
+    ``"weights"`` (model payloads only — a fat-pipe straggler whose
+    control plane is healthy), or ``"control"`` (lossy signaling over a
+    healthy bulk path).
+    """
+
+    drop: float = 0.0            # P(send fails; transport reports False)
+    delay: float = 0.0           # fixed seconds added before delivery
+    jitter: float = 0.0          # + U(0, jitter) drawn from the edge RNG
+    duplicate: float = 0.0       # P(a second copy is delivered later)
+    duplicate_delay: float = 0.2  # how much later the copy lands
+    scope: str = "both"          # "both" | "weights" | "control"
+
+    def applies_to(self, env: object) -> bool:
+        if self.scope == "both":
+            return True
+        is_weights = isinstance(env, WeightsEnvelope)
+        return is_weights if self.scope == "weights" else not is_weights
+
+
+@dataclass(frozen=True)
+class CrashSpec:
+    """Hard-crash a node when its learning thread enters ``stage``.
+
+    ``round_no=None`` matches any round. ``after_s > 0`` arms a timer at
+    stage entry instead of crashing synchronously — the node dies
+    mid-stage (mid-fit, mid-gossip), which is the interesting case for
+    train-set repair.
+    """
+
+    stage: str
+    round_no: Optional[int] = 0
+    after_s: float = 0.0
+
+
+class FaultCrash(Exception):
+    """Raised on the learning thread of a node crashed by a CrashSpec —
+    unwinds the stage workflow the way a killed process stops executing."""
+
+
+class FaultPlan:
+    """A replayable description of everything that goes wrong.
+
+    ``edges`` maps directed ``(src, dst)`` pairs to :class:`EdgeFault`
+    overrides; ``default`` applies to every other edge. ``partitions`` is
+    an iterable of one-way ``(src, dst)`` blocks. ``slow_nodes`` maps a
+    receiver address to the latency (seconds) every inbound *weights*
+    delivery pays. ``crashes`` maps a node address to a
+    :class:`CrashSpec`.
+    """
+
+    def __init__(
+        self,
+        seed: int,
+        default: EdgeFault = EdgeFault(),
+        edges: Optional[dict[tuple[str, str], EdgeFault]] = None,
+        partitions: Iterable[tuple[str, str]] = (),
+        slow_nodes: Optional[dict[str, float]] = None,
+        crashes: Optional[dict[str, CrashSpec]] = None,
+    ) -> None:
+        self.seed = seed
+        self.default = default
+        self.edges = dict(edges or {})
+        self.partitions = set(partitions)
+        self.slow_nodes = dict(slow_nodes or {})
+        self.crashes = dict(crashes or {})
+        self._rngs: dict[tuple[str, str], random.Random] = {}
+        self._rng_lock = threading.Lock()
+        #: crash specs already fired (addr) — a spec fires exactly once
+        self._crashed: set[str] = set()
+
+    # ---- per-edge state ----
+
+    def rng(self, src: str, dst: str) -> random.Random:
+        """The directed edge's own deterministic stream."""
+        key = (src, dst)
+        with self._rng_lock:
+            r = self._rngs.get(key)
+            if r is None:
+                r = self._rngs[key] = random.Random(f"{self.seed}:{src}->{dst}")
+            return r
+
+    def edge_fault(self, src: str, dst: str) -> EdgeFault:
+        return self.edges.get((src, dst), self.default)
+
+    def partitioned(self, src: str, dst: str) -> bool:
+        return (src, dst) in self.partitions
+
+
+class FaultInjector:
+    """Wraps one protocol's transport send with a plan's edge faults.
+
+    Installed as ``protocol.fault_injector``; the protocol routes every
+    send through :meth:`__call__` with the real transport send as the
+    continuation.
+    """
+
+    def __init__(self, plan: FaultPlan, src: str) -> None:
+        self.plan = plan
+        self.src = src
+
+    def __call__(
+        self,
+        nei: str,
+        env: object,
+        create_connection: bool,
+        transport_send: Callable[..., bool],
+    ) -> bool:
+        plan = self.plan
+        if plan.partitioned(self.src, nei):
+            logger.log_comm_metric(self.src, "fault_partition_drop")
+            return False
+        # straggler latency: every inbound WEIGHTS delivery to a slow node
+        # pays it (its control plane stays healthy — that asymmetry, a fat
+        # pipe stalling while signaling flows, is the hard case the gossip
+        # send budget and the stall machinery exist for)
+        slow = plan.slow_nodes.get(nei, 0.0)
+        if slow and isinstance(env, WeightsEnvelope):
+            time.sleep(slow)
+        fault = plan.edge_fault(self.src, nei)
+        if not fault.applies_to(env):
+            return transport_send(nei, env, create_connection=create_connection)
+        rng = plan.rng(self.src, nei)
+        # draw the full verdict tuple up front so the edge's stream
+        # advances identically whether or not earlier faults short-circuit
+        # (keeps the k-th send's verdict stable across fault combinations)
+        drop_u, dup_u, jitter_u = rng.random(), rng.random(), rng.random()
+        if fault.drop and drop_u < fault.drop:
+            logger.log_comm_metric(self.src, "fault_drop")
+            return False
+        d = fault.delay + jitter_u * fault.jitter
+        if d > 0:
+            time.sleep(d)
+        ok = transport_send(nei, env, create_connection=create_connection)
+        if ok and fault.duplicate and dup_u < fault.duplicate:
+            logger.log_comm_metric(self.src, "fault_duplicate")
+            copy = _stale_copy(env)
+            t = threading.Timer(
+                max(fault.duplicate_delay, 0.001),
+                _deliver_copy,
+                args=(transport_send, nei, copy, create_connection),
+            )
+            t.daemon = True
+            t.start()
+        return ok
+
+
+def _stale_copy(env: object) -> object:
+    """A re-delivery of ``env`` as the overlay would actually produce it.
+
+    Control messages come back with a fresh id and ttl=1 — a relay the
+    dedup ring has already forgotten (the id rotation models ring
+    overflow, Settings.AMOUNT_LAST_MESSAGES_SAVED being finite), which
+    must not re-amplify. Weights envelopes replay verbatim.
+    """
+    if isinstance(env, Message):
+        return Message(env.source, env.cmd, env.args, env.round, ttl=1)
+    return env
+
+
+def _deliver_copy(transport_send, nei, env, create_connection) -> None:
+    try:
+        transport_send(nei, env, create_connection=create_connection)
+    except Exception:  # noqa: BLE001 — the node may have stopped meanwhile
+        pass
+
+
+# ---- crash machinery ----
+
+
+def hard_crash(node: "Node") -> None:
+    """Kill a node the way a dead process dies: no goodbyes.
+
+    The server unregisters (subsequent sends to it fail), heartbeats and
+    gossip stop, the learner is interrupted — but neighbors are NOT
+    notified and no disconnect messages go out. Peers find out through
+    heartbeat silence / send failures, which is the code path chaos tests
+    exist to exercise.
+    """
+    logger.warning(node.addr, "FAULT: hard crash injected")
+    logger.log_comm_metric(node.addr, "fault_crash")
+    node._interrupt.set()
+    if node.learner is not None:
+        try:
+            node.learner.interrupt_fit()
+        except Exception:  # noqa: BLE001 — learner may not be fitted yet
+            pass
+    proto = node.protocol
+    try:
+        crash = getattr(proto, "crash", proto._server_stop)
+        crash()  # unregister only — no peer notifications
+    except Exception:  # noqa: BLE001
+        pass
+    proto.heartbeater.stop()
+    proto.gossiper.stop()
+    node._running = False
+    # take the corpse out of the StallWatchdog's scope: its state stays
+    # registered (the harness holds the Node) with status "Learning" and a
+    # frozen last_transition, so any chaos run outlasting STALL_WATCHDOG_S
+    # would count a phantom stall_detected for a node that is *dead*, not
+    # stalled — a real killed process takes its watchdog down with it
+    node.state.status = "Idle"
+
+
+def make_stage_hook(plan: FaultPlan) -> Callable[["Node", str], None]:
+    """A ``Node.stage_hooks`` entry firing the plan's crash specs."""
+
+    def hook(node: "Node", stage_name: str) -> None:
+        spec = plan.crashes.get(node.addr)
+        if spec is None or node.addr in plan._crashed:
+            return
+        if spec.stage != stage_name:
+            return
+        if spec.round_no is not None and node.state.round != spec.round_no:
+            return
+        plan._crashed.add(node.addr)
+        if spec.after_s > 0:
+            t = threading.Timer(spec.after_s, hard_crash, args=(node,))
+            t.daemon = True
+            t.start()
+            return
+        hard_crash(node)
+        raise FaultCrash(f"{node.addr} crashed entering {stage_name}")
+
+    return hook
+
+
+def install_fault_plan(nodes: Iterable["Node"], plan: FaultPlan) -> None:
+    """Wire a plan into an in-process federation (or any node set)."""
+    hook = make_stage_hook(plan) if plan.crashes else None
+    for node in nodes:
+        node.protocol.fault_injector = FaultInjector(plan, node.addr)
+        if hook is not None:
+            node.stage_hooks.append(hook)
+
+
+def remove_fault_plan(nodes: Iterable["Node"]) -> None:
+    for node in nodes:
+        node.protocol.fault_injector = None
+        node.stage_hooks.clear()

@@ -23,7 +23,17 @@ payloads are already on the wire while it hangs, and the tick moves on once
 the budget expires. A send still in flight marks its neighbor busy — the
 next tick skips that neighbor instead of stacking a second worker behind the
 same stall — and results are collected in submission order so the caller's
-convergence accounting is deterministic. Payload construction (``model_fn``)
+convergence accounting is deterministic.
+
+Control-plane reliability (departure from the reference, where a failed
+send simply loses the message): a message-plane send that returns a
+definitive False is retried with exponential backoff + jitter
+(``communication/reliability.py``) up to ``Settings.MESSAGE_RETRY_MAX``
+attempts before being dropped loudly (``msg_retry_exhausted`` metric);
+``CommunicationProtocol.send`` routes its broadcast failures into the same
+queue. Every definitive outcome also feeds the protocol's per-neighbor
+circuit breaker via ``on_result``, which is what accelerates heartbeat
+eviction of genuinely dead peers. Payload construction (``model_fn``)
 stays on the calling thread — aggregator/learner state is never read
 concurrently — but it is LAZY: the model plane passes payload builders, and
 ``_dispatch_sends`` resolves each one right before submitting its
@@ -37,6 +47,8 @@ communication metrics (``gossip_send_ok`` / ``_fail`` / ``_timeout`` /
 
 from __future__ import annotations
 
+import heapq
+import itertools
 import threading
 import time
 from collections import OrderedDict, deque
@@ -45,17 +57,34 @@ from concurrent.futures import CancelledError, Future, ThreadPoolExecutor
 from concurrent.futures import TimeoutError as FuturesTimeout  # builtin alias only on 3.11+
 from typing import Callable, Optional
 
+from p2pfl_tpu.communication.heartbeater import BEAT_CMD
 from p2pfl_tpu.communication.message import Message
+from p2pfl_tpu.communication.reliability import retry_delay
 from p2pfl_tpu.management.logger import logger
 from p2pfl_tpu.settings import Settings
 
 
 class Gossiper:
-    def __init__(self, self_addr: str, send_fn: Callable[..., bool]) -> None:
+    def __init__(
+        self,
+        self_addr: str,
+        send_fn: Callable[..., bool],
+        on_result: Optional[Callable[[str, bool], None]] = None,
+    ) -> None:
         self.self_addr = self_addr
         self._send = send_fn  # (nei, env, create_connection=False) -> bool
-        self._queue: deque[tuple[Message, list[str]]] = deque()
+        # definitive per-neighbor send outcomes (True/False, never
+        # timeouts — a stalled-but-running send is not evidence of death)
+        # are reported here; the protocol feeds its circuit breaker
+        self._on_result = on_result
+        # message-plane queue entries: (message, pending_neighbors, attempt)
+        self._queue: deque[tuple[Message, list[str], int]] = deque()
         self._queue_cv = threading.Condition()
+        # failed control sends wait out their backoff here:
+        # (due_monotonic, seq, attempt, neighbor, message) — guarded by
+        # _queue_cv's lock; the gossip thread drains due entries each tick
+        self._retries: list[tuple[float, int, int, str, Message]] = []
+        self._retry_seq = itertools.count()
         self._processed: OrderedDict[str, None] = OrderedDict()
         self._processed_lock = threading.Lock()
         self._stop = threading.Event()
@@ -75,6 +104,10 @@ class Gossiper:
 
     def start(self) -> None:
         self._stop.clear()
+        with self._queue_cv:
+            # backoff entries scheduled against the previous run's overlay
+            # state must not fire into a fresh start
+            self._retries.clear()
         with self._stalled_lock:
             # a send that hung past stop() never runs its done-callback
             # (shutdown can't cancel RUNNING tasks), so its _stalled entry
@@ -115,10 +148,20 @@ class Gossiper:
                 self._processed.popitem(last=False)
             return True
 
+    def _report(self, nei: str, ok: bool) -> None:
+        if self._on_result is not None:
+            try:
+                self._on_result(nei, ok)
+            except Exception:  # noqa: BLE001 — observers must not break sends
+                pass
+
     # ---- concurrent send dispatch (both planes) ----
 
     def _dispatch_sends(
-        self, sends: list[tuple[str, object]], create_connection: bool = False
+        self,
+        sends: list[tuple[str, object]],
+        create_connection: bool = False,
+        on_late_failure: Optional[Callable[[str, object], None]] = None,
     ) -> tuple[list[Optional[bool]], list[tuple[str, object]]]:
         """Fan ``(neighbor, envelope)`` sends out across the worker pool.
 
@@ -139,6 +182,14 @@ class Gossiper:
         that exact task finishes) — plus the sends that were never
         submitted because their neighbor was already stalled (the message
         plane requeues those; the model plane rebuilds next tick anyway).
+
+        A timed-out send's LATE outcome is not discarded: when the worker
+        eventually finishes, the result still feeds metrics and the
+        breaker, and each envelope that ultimately FAILED is handed to
+        ``on_late_failure`` (the message plane schedules a retry there —
+        without this, a send that hung past its budget and then failed
+        would be silently lost, the exact hole the retry queue closes for
+        prompt failures).
         """
         pool = self._pool
         if pool is None or Settings.GOSSIP_SEND_WORKERS <= 1:
@@ -156,6 +207,7 @@ class Gossiper:
                 logger.log_comm_metric(
                     self.self_addr, "gossip_send_ok" if ok else "gossip_send_fail"
                 )
+                self._report(nei, bool(ok))
                 out.append(ok)
             return out, []
         timeout = Settings.GOSSIP_SEND_TIMEOUT
@@ -175,7 +227,7 @@ class Gossiper:
             return [self._send(nei, env, create_connection=create_connection) for env in envs]
 
         skipped: list[tuple[str, object]] = []
-        futures: list[tuple[str, list[int], Future]] = []
+        futures: list[tuple[str, list[int], list[object], Future]] = []
         for nei, items in grouped.items():
             with self._stalled_lock:
                 if nei in self._stalled:
@@ -218,11 +270,13 @@ class Gossiper:
                         del self._stalled[nei]
 
             fut.add_done_callback(_done)
-            futures.append((nei, [i for i, _env in resolved], fut))
+            futures.append(
+                (nei, [i for i, _env in resolved], [env for _i, env in resolved], fut)
+            )
         # everything-is-stuck backstop: enough budget for every task to get
         # a worker slot and its own timeout, then stop waiting regardless
         hard_deadline = time.monotonic() + timeout * (1 + len(futures) / workers)
-        for nei, idxs, fut in futures:
+        for nei, idxs, envs, fut in futures:
             timed_out = False
             while True:
                 now = time.monotonic()
@@ -249,12 +303,14 @@ class Gossiper:
                     for i in idxs:
                         results[i] = False
                     logger.log_comm_metric(self.self_addr, "gossip_send_fail", len(idxs))
+                    self._report(nei, False)
                 else:
                     for i, ok in zip(idxs, oks):
                         results[i] = bool(ok)
                         logger.log_comm_metric(
                             self.self_addr, "gossip_send_ok" if ok else "gossip_send_fail"
                         )
+                        self._report(nei, bool(ok))
                 break
             if timed_out:
                 with self._stalled_lock:
@@ -263,6 +319,32 @@ class Gossiper:
                     # neighbor behind a congested pool, not a stall
                     if not fut.done() and starts.get(nei) is not None:
                         self._stalled[nei] = fut
+
+                # the late outcome still matters: when the hung worker
+                # finally finishes, feed metrics + breaker and hand each
+                # envelope that FAILED to the caller (message plane retries
+                # it) — otherwise a send that overran its budget and then
+                # returned False would be silently lost
+                def _late(f, nei=nei, envs=envs):
+                    try:
+                        oks = f.result()
+                    except Exception:  # noqa: BLE001 — cancelled or transport raised
+                        oks = None
+                    if oks is None:
+                        oks = [False] * len(envs)
+                    for env, ok in zip(envs, oks):
+                        logger.log_comm_metric(
+                            self.self_addr,
+                            "gossip_send_ok" if ok else "gossip_send_fail",
+                        )
+                        self._report(nei, bool(ok))
+                        if not ok and on_late_failure is not None:
+                            try:
+                                on_late_failure(nei, env)
+                            except Exception:  # noqa: BLE001 — observer must not kill the worker
+                                pass
+
+                fut.add_done_callback(_late)
                 logger.log_comm_metric(self.self_addr, "gossip_send_timeout")
                 logger.debug(
                     self.self_addr,
@@ -273,38 +355,117 @@ class Gossiper:
 
     # ---- message plane ----
 
-    def add_message(self, msg: Message, pending_neis: list[str]) -> None:
+    def add_message(self, msg: Message, pending_neis: list[str], attempt: int = 0) -> None:
         if not pending_neis:
             return
         with self._queue_cv:
-            self._queue.append((msg, list(pending_neis)))
+            self._queue.append((msg, list(pending_neis), attempt))
             self._queue_cv.notify()
+
+    def schedule_retry(self, nei: str, msg: Message, attempt: int) -> None:
+        """Queue retry ``attempt`` (1-based) of a failed control send.
+
+        The entry waits out an exponential backoff (``reliability.
+        retry_delay``) on the gossip thread, then rides a normal dispatch
+        batch. Beyond ``Settings.MESSAGE_RETRY_MAX`` the message is
+        dropped loudly (``msg_retry_exhausted``) — by then the breaker
+        has marked the neighbor suspect and eviction owns the rest.
+
+        Beats are exempt, HERE, for every path that funnels into the
+        retry queue (direct sends, the queue's failure loop, late
+        failures of budget-overrunning sends): a beat is superseded by
+        the next one every HEARTBEAT_PERIOD, so a retry would only
+        deliver stale liveness info while its backoff entries crowd the
+        per-tick budget out from under genuine control messages during
+        exactly the failure windows that matter (the failed send still
+        fed the breaker).
+        """
+        if msg.cmd == BEAT_CMD:
+            return
+        if attempt > Settings.MESSAGE_RETRY_MAX:
+            logger.log_comm_metric(self.self_addr, "msg_retry_exhausted")
+            logger.debug(
+                self.self_addr,
+                f"Dropping '{msg.cmd}' for {nei} after "
+                f"{Settings.MESSAGE_RETRY_MAX} retries",
+            )
+            return
+        due = time.monotonic() + retry_delay(attempt)
+        logger.log_comm_metric(self.self_addr, "msg_retry_scheduled")
+        with self._queue_cv:
+            heapq.heappush(self._retries, (due, next(self._retry_seq), attempt, nei, msg))
+            self._queue_cv.notify()
+
+    def _pop_due_retries_locked(self) -> tuple[list[tuple[str, Message, int]], Optional[float]]:
+        """(due retries as (nei, msg, attempt), next due time). Caller
+        holds ``_queue_cv``."""
+        now = time.monotonic()
+        due: list[tuple[str, Message, int]] = []
+        while self._retries and self._retries[0][0] <= now:
+            _due, _seq, attempt, nei, msg = heapq.heappop(self._retries)
+            due.append((nei, msg, attempt))
+        return due, (self._retries[0][0] if self._retries else None)
 
     def _run(self) -> None:
         while not self._stop.is_set():
             with self._queue_cv:
-                if not self._queue:
-                    self._queue_cv.wait(timeout=Settings.GOSSIP_PERIOD)
+                due, next_due = self._pop_due_retries_locked()
+                if not self._queue and not due:
+                    wait = Settings.GOSSIP_PERIOD
+                    if next_due is not None:
+                        wait = min(wait, max(next_due - time.monotonic(), 0.01))
+                    self._queue_cv.wait(timeout=wait)
                     continue
-                batch: list[tuple[str, Message]] = []
-                budget = Settings.GOSSIP_MESSAGES_PER_PERIOD
+                # (neighbor, message, attempt) — attempt 0 is a first
+                # delivery, >= 1 a backoff retry re-entering the batch
+                batch: list[tuple[str, Message, int]] = list(due)
+                budget = Settings.GOSSIP_MESSAGES_PER_PERIOD - len(batch)
                 while self._queue and budget > 0:
-                    msg, neis = self._queue.popleft()
+                    msg, neis, attempt = self._queue.popleft()
                     take, rest = neis[:budget], neis[budget:]
-                    batch.extend((n, msg) for n in take)
+                    batch.extend((n, msg, attempt) for n in take)
                     budget -= len(take)
                     if rest:
-                        self._queue.appendleft((msg, rest))
+                        self._queue.appendleft((msg, rest, attempt))
                         break
             if self._stop.is_set():
                 return
-            _results, skipped = self._dispatch_sends(batch)
+            attempts = {(n, id(m)): a for n, m, a in batch}
+
+            def _late_failure(nei: str, env: object, attempts=attempts) -> None:
+                # a send that overran its budget and THEN failed on its
+                # worker is still a definitive failure — retry it like a
+                # prompt one (schedule_retry exempts beats)
+                if isinstance(env, Message):
+                    self.schedule_retry(nei, env, attempts.get((nei, id(env)), 0) + 1)
+
+            results, skipped = self._dispatch_sends(
+                [(n, m) for n, m, _a in batch], on_late_failure=_late_failure
+            )
+            # a send skipped for a stalled neighbor was never attempted —
+            # requeued below at the same attempt, not counted as a failure
+            skipset = {(nei, id(msg)) for nei, msg in skipped}
+            for (nei, msg, attempt), ok in zip(batch, results):
+                if (nei, id(msg)) in skipset:
+                    continue
+                if ok is False:
+                    # definitive transport failure: back off and retry —
+                    # a plain False must never silently lose a broadcast
+                    # (relayed beats ride this queue too; schedule_retry
+                    # exempts them)
+                    self.schedule_retry(nei, msg, attempt + 1)
+                elif ok and attempt > 0:
+                    logger.log_comm_metric(self.self_addr, "msg_retry_ok")
+                # ok is None: the send outlived its budget and is still
+                # running on its worker — _dispatch_sends' late-result
+                # callback will report it (and retry via _late_failure if
+                # it ultimately fails)
             for nei, msg in skipped:
                 # control messages must not be lost to a transient stall —
                 # requeue for the stalled neighbor (the pre-overhaul serial
                 # plane eventually delivered them); delivery resumes once
                 # the stuck task completes or the neighbor is evicted
-                self.add_message(msg, [nei])
+                self.add_message(msg, [nei], attempt=attempts.get((nei, id(msg)), 0))
             time.sleep(Settings.GOSSIP_PERIOD)
 
     # ---- model plane ----

@@ -99,6 +99,13 @@ class InMemoryProtocol(CommunicationProtocol):
         self._running = False
         MemoryRegistry.unregister(self._address)
 
+    def crash(self) -> None:
+        """Hard-crash simulation (``communication/faults.py:hard_crash``):
+        vanish from the registry with NO disconnect notifications — unlike
+        ``stop()``, peers only find out through send failures and
+        heartbeat silence, which is what chaos tests exercise."""
+        self._server_stop()
+
     def _send_to_neighbor(self, nei: str, env, create_connection: bool = False) -> bool:
         info = self.neighbors.get(nei)
         if info is None or not info.direct:

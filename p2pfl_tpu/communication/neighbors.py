@@ -15,6 +15,7 @@ from dataclasses import dataclass, field
 from typing import Any, Optional
 
 from p2pfl_tpu.management.logger import logger
+from p2pfl_tpu.settings import Settings
 
 
 @dataclass
@@ -31,6 +32,20 @@ class Neighbors:
         self.self_addr = self_addr
         self._lock = threading.Lock()
         self._neis: dict[str, NeighborInfo] = {}
+        #: addr → monotonic deadline: peers evicted DESPITE arriving beats
+        #: (one-way partition: alive but unreachable) are quarantined for a
+        #: HEARTBEAT_TIMEOUT so the very next beat cannot immediately
+        #: re-add them — without this, evict/re-add flaps once per beat
+        #: period and the unreachable-eviction guarantee is hollow.
+        #: Silence-based evictions do NOT quarantine: a briefly-paused node
+        #: that resumes beating should rejoin on its next beat, not sit out
+        #: an extra timeout. A deliberate direct connect overrides the
+        #: quarantine.
+        self._quarantine: dict[str, float] = {}
+        #: fired with each heartbeat-evicted address (NOT on deliberate
+        #: removes) — the protocol fans it out to its evict listeners
+        #: (mid-round train-set repair, breaker cleanup)
+        self.on_evict: Optional[Any] = None
 
     # ---- transport hooks ----
 
@@ -53,6 +68,14 @@ class Neighbors:
         if addr == self.self_addr:
             return False
         with self._lock:
+            if non_direct:
+                if self._quarantined_locked(addr):
+                    return False
+            # a DELIBERATE direct connect overrides quarantine — but only
+            # once it SUCCEEDS (pop below, after _connect): popping here
+            # would let a failed connect attempt clear the entry, and the
+            # unreachable peer's very next beat would re-admit it — the
+            # exact evict/re-add flap quarantine exists to prevent
             existing = self._neis.get(addr)
             if existing is not None:
                 if non_direct:
@@ -73,6 +96,7 @@ class Neighbors:
             logger.info(self.self_addr, f"Cannot connect to {addr}: {exc}")
             return False
         with self._lock:
+            self._quarantine.pop(addr, None)
             self._neis[addr] = NeighborInfo(direct=True, conn=conn)
         return True
 
@@ -85,25 +109,67 @@ class Neighbors:
             except Exception:  # noqa: BLE001
                 pass
 
+    def _quarantined_locked(self, addr: str) -> bool:
+        """Caller holds ``_lock``. Expired entries are dropped lazily."""
+        until = self._quarantine.get(addr)
+        if until is None:
+            return False
+        if time.monotonic() >= until:
+            del self._quarantine[addr]
+            return False
+        return True
+
     def heartbeat(self, addr: str, t: Optional[float] = None) -> None:
-        """Record a beat; unknown senders become non-direct neighbors."""
+        """Record a beat; unknown senders become non-direct neighbors —
+        unless quarantined (recently evicted: beats alone must not re-admit
+        a peer the overlay just decided it cannot reach)."""
         with self._lock:
             info = self._neis.get(addr)
             if info is None:
-                if addr != self.self_addr:
+                if addr != self.self_addr and not self._quarantined_locked(addr):
                     self._neis[addr] = NeighborInfo(direct=False)
                 return
             info.last_beat = time.monotonic() if t is None else t
 
-    def evict_stale(self, timeout: float) -> list[str]:
-        """Drop neighbors whose last beat is older than ``timeout`` seconds."""
+    def evict_stale(self, timeout: float, only: Optional[set] = None) -> list[str]:
+        """Drop neighbors whose last beat is older than ``timeout`` seconds.
+
+        ``only`` restricts the sweep to a subset — the heartbeater uses it
+        to evict breaker-suspect neighbors on a shorter clock than the
+        full ``HEARTBEAT_TIMEOUT``. Each eviction fires ``on_evict``.
+        """
         now = time.monotonic()
         with self._lock:
-            stale = [a for a, i in self._neis.items() if now - i.last_beat > timeout]
+            stale = [
+                a
+                for a, i in self._neis.items()
+                if now - i.last_beat > timeout and (only is None or a in only)
+            ]
         for addr in stale:
             logger.info(self.self_addr, f"Heartbeat timeout — evicting {addr}")
-            self.remove(addr)
+            self.evict(addr)
         return stale
+
+    def evict(self, addr: str, quarantine: bool = False) -> None:
+        """Remove ``addr`` and fire ``on_evict`` regardless of last_beat.
+
+        ``quarantine=True`` is the heartbeater's unreachable-despite-beats
+        (one-way partition) path: the peer's beats keep arriving, so without
+        a quarantine window the next one would re-add it immediately.
+        Silence-based evictions leave it False — no beats are arriving, and
+        a node that resumes beating should rejoin right away.
+        """
+        with self._lock:
+            if addr not in self._neis:
+                return
+            if quarantine:
+                self._quarantine[addr] = time.monotonic() + Settings.HEARTBEAT_TIMEOUT
+        self.remove(addr)
+        if self.on_evict is not None:
+            try:
+                self.on_evict(addr)
+            except Exception:  # noqa: BLE001 — observers must not break the sweep
+                pass
 
     def get(self, addr: str) -> Optional[NeighborInfo]:
         with self._lock:
@@ -120,3 +186,4 @@ class Neighbors:
             self.remove(addr, disconnect_msg=disconnect)
         with self._lock:
             self._neis.clear()
+            self._quarantine.clear()

@@ -21,6 +21,7 @@ from p2pfl_tpu.communication.gossiper import Gossiper
 from p2pfl_tpu.communication.heartbeater import Heartbeater
 from p2pfl_tpu.communication.message import CommandResult, Message, WeightsEnvelope
 from p2pfl_tpu.communication.neighbors import Neighbors
+from p2pfl_tpu.communication.reliability import CircuitBreaker
 from p2pfl_tpu.learning.weights import ModelUpdate
 from p2pfl_tpu.management.logger import logger
 
@@ -32,8 +33,21 @@ class CommunicationProtocol(ABC):
         self._address = address
         self._commands: dict[str, "Command"] = {}  # noqa: F821 — commands registered by Node
         self._terminated = threading.Event()
+        #: per-neighbor consecutive-failure detector; every plane's send
+        #: outcome feeds it, suspects are evicted early by the heartbeater
+        self.breaker = CircuitBreaker(address)
+        #: optional chaos seam (communication/faults.py FaultInjector):
+        #: when set, every outgoing send routes through it with the real
+        #: transport send as the continuation
+        self.fault_injector: Optional[Callable] = None
+        #: callbacks fired with the address of every heartbeat-evicted
+        #: neighbor (Node hooks mid-round train-set repair here)
+        self._evict_listeners: list[Callable[[str], None]] = []
         self.neighbors: Neighbors = self._make_neighbors()
-        self.gossiper = Gossiper(address, send_fn=self._send_to_neighbor)
+        self.neighbors.on_evict = self._neighbor_evicted
+        self.gossiper = Gossiper(
+            address, send_fn=self._do_send, on_result=self._record_send_outcome
+        )
         self.heartbeater = Heartbeater(address, self)
 
     # ---- transport-specific pieces ----
@@ -67,6 +81,7 @@ class CommunicationProtocol(ABC):
         self.gossiper.stop()
         self._server_stop()
         self.neighbors.clear(disconnect=True)
+        self.breaker.reset()
         self._terminated.set()
 
     def wait_for_termination(self) -> None:
@@ -95,13 +110,36 @@ class CommunicationProtocol(ABC):
 
     # ---- sending ----
 
+    def _do_send(self, nei: str, env, create_connection: bool = False) -> bool:
+        """Transport send behind the fault-injection seam — EVERY outgoing
+        envelope (both gossip planes, direct sends, broadcasts) passes
+        through here, so a chaos plan sees all of them."""
+        fi = self.fault_injector
+        if fi is not None:
+            return fi(nei, env, create_connection, self._send_to_neighbor)
+        return self._send_to_neighbor(nei, env, create_connection=create_connection)
+
     def send(self, nei: str, env, create_connection: bool = False) -> bool:
-        ok = self._send_to_neighbor(nei, env, create_connection=create_connection)
-        if not ok and not create_connection:
-            # the reference evicts a neighbor on any send failure
-            # (grpc_client.py:173-179); keeps membership honest
-            logger.debug(self._address, f"Send to {nei} failed — removing neighbor")
-            self.neighbors.remove(nei)
+        ok = self._do_send(nei, env, create_connection=create_connection)
+        if not create_connection:
+            self._record_send_outcome(nei, ok)
+            if not ok and isinstance(env, Message):
+                # counted separately from the gossiper's gossip_send_fail:
+                # direct sends (command broadcasts, coverage re-announcements)
+                # fail outside the dispatch path — without this metric a
+                # retry scheduled here has no matching failure counter and
+                # the chaos suite's "retries are 1:1-backed by failures"
+                # budget would be unsound (e.g. sends to a crashed peer in
+                # the window before its eviction)
+                logger.log_comm_metric(self._address, "send_fail_direct")
+                # The reference evicts a neighbor on ANY send failure
+                # (grpc_client.py:173-179) — and the message is simply gone.
+                # One transient failure is not death: the message is retried
+                # with backoff on the gossip thread (schedule_retry exempts
+                # beats), while the breaker's consecutive-failure count
+                # decides suspicion and the heartbeater owns the
+                # (accelerated) eviction.
+                self.gossiper.schedule_retry(nei, env, attempt=1)
         return ok
 
     def broadcast(self, env, exclude: tuple[str, ...] = ()) -> None:
@@ -109,12 +147,36 @@ class CommunicationProtocol(ABC):
             if nei not in exclude:
                 self.send(nei, env)
 
+    def _record_send_outcome(self, nei: str, ok: bool) -> None:
+        """Feed the breaker — but never for failures to NON-members: an
+        in-flight backoff retry to an already-evicted neighbor would
+        otherwise repopulate the state ``forget()`` just cleared, leaving a
+        permanent suspect entry no eviction sweep ever forgets (the sweeps
+        only touch current members)."""
+        if ok or self.neighbors.get(nei) is not None:
+            self.breaker.record(nei, ok)
+
+    # ---- eviction notifications ----
+
+    def add_evict_listener(self, fn: Callable[[str], None]) -> None:
+        self._evict_listeners.append(fn)
+
+    def _neighbor_evicted(self, addr: str) -> None:
+        logger.log_comm_metric(self._address, "neighbor_evicted")
+        self.breaker.forget(addr)
+        for fn in self._evict_listeners:
+            try:
+                fn(addr)
+            except Exception as exc:  # noqa: BLE001 — listeners must not kill the heartbeater
+                logger.error(self._address, f"Evict listener failed for {addr}: {exc!r}")
+
     # ---- membership ----
 
     def connect(self, addr: str, non_direct: bool = False) -> bool:
         return self.neighbors.add(addr, non_direct=non_direct)
 
     def disconnect(self, addr: str, disconnect_msg: bool = True) -> None:
+        self.breaker.forget(addr)  # deliberate disconnect is not a failure
         self.neighbors.remove(addr, disconnect_msg=disconnect_msg)
 
     def get_neighbors(self, only_direct: bool = False) -> dict:

@@ -16,6 +16,10 @@ pattern replaced by a real :class:`threading.Event`:
   ``Settings.AGGREGATION_TIMEOUT``, then aggregates whatever arrived.
 - ``get_partial_aggregation(except_nodes)`` pre-aggregates everything a peer
   has not seen (249-281) — the payload of train-set gossip.
+- ``discard_member(addr)`` — mid-round train-set repair (no reference
+  equivalent): an evicted member that never contributed is dropped from the
+  round's coverage target, so the window closes on the survivors instead of
+  waiting out the full timeout for a model that is never coming.
 
 Subclasses implement one pure function, :meth:`aggregate`, over a list of
 :class:`ModelUpdate` — typically a single jitted op from ``ops/aggregation``.
@@ -54,6 +58,12 @@ class Aggregator:
         self._complete.set()  # no aggregation in progress
         self._train_set: list[str] = []
         self._waiting: bool = False
+        #: mid-round train-set repair (``discard_member``): members evicted
+        #: from the overlay before contributing. The coverage TARGET is
+        #: ``train_set - removed`` while the foreign-contributor check stays
+        #: against the full original train set (a removed member's update
+        #: that did reach a peer remains aggregatable).
+        self._removed: set[str] = set()
         self._models: dict[frozenset, ModelUpdate] = {}
         # gossip ships the same partial to several peers per tick: memoize
         # the combined update per exact set of source groups, so the
@@ -73,6 +83,7 @@ class Aggregator:
         with self._lock:
             self._train_set = list(nodes)
             self._waiting = False
+            self._removed = set()
             self._models = {}
             self._partial_memo = {}
             self._memo_gen += 1
@@ -86,6 +97,7 @@ class Aggregator:
         with self._lock:
             self._train_set = list(nodes)
             self._waiting = True
+            self._removed = set()
             self._models = {}
             self._partial_memo = {}
             self._memo_gen += 1
@@ -95,6 +107,7 @@ class Aggregator:
         with self._lock:
             self._train_set = []
             self._waiting = False
+            self._removed = set()
             self._models = {}
             self._partial_memo = {}
             self._memo_gen += 1
@@ -136,12 +149,24 @@ class Aggregator:
                 # (reference aggregator.py:139-146 requires
                 # set(contributors) == set(train_set)); accepting a stray
                 # partial would make one node's single model this node's
-                # "aggregated model" — a poisoning hole
-                if contributors != frozenset(self._train_set):
+                # "aggregated model" — a poisoning hole. With mid-round
+                # repair the target interval widens: after members died
+                # (``_removed``), a survivors-only aggregate counts as full
+                # — but anything below the repaired target, or naming
+                # foreign contributors, stays rejected.
+                target = frozenset(self._train_set) - self._removed
+                if not target:
+                    # mid-round repair evicted EVERY member: an empty target
+                    # would accept any subset — a lone survivor's partial
+                    # must not become this node's "aggregated model". Fall
+                    # back to the strict full-coverage requirement (a
+                    # post-partition-heal full aggregate still passes).
+                    target = frozenset(self._train_set)
+                if not (target <= contributors <= frozenset(self._train_set)):
                     logger.debug(
                         self.node_name,
                         f"Rejecting model while waiting: coverage {sorted(contributors)} "
-                        f"!= train set {sorted(self._train_set)}",
+                        f"outside [{sorted(target)}, {sorted(self._train_set)}]",
                     )
                     return []
                 if self._models:  # first full update wins
@@ -194,7 +219,53 @@ class Aggregator:
             self._partial_memo = {}
             self._memo_gen += 1
             covered |= contributors
-            if covered == train:
+            if covered >= train - self._removed:
+                # the target excludes members repaired out mid-round —
+                # survivors' coverage closes the window without them
+                self._complete.set()
+            return sorted(covered)
+
+    def discard_member(self, addr: str) -> Optional[list[str]]:
+        """Mid-round train-set repair: ``addr`` was evicted from the overlay.
+
+        If its contribution has not arrived, shrink the round's coverage
+        TARGET to the surviving members so :meth:`wait_and_get_aggregation`
+        resolves to the survivors' partial as soon as they are all in,
+        instead of burning the remaining ``AGGREGATION_TIMEOUT`` on a model
+        that is never coming (the reference's graceful-degradation path,
+        made proactive). A contribution that already arrived is KEPT — the
+        member's training happened; only its absence is repaired.
+
+        Returns the current coverage list when the caller should
+        re-broadcast ``models_aggregated`` (collection target changed on a
+        collecting node), else None. Never called under
+        ``SECURE_AGGREGATION`` (see ``Settings.TRAIN_SET_REPAIR``): there
+        the aggregate still carries the dead member's uncancelled pair
+        masks and secagg's seed-recovery machinery owns the dropout.
+        """
+        with self._lock:
+            if addr not in self._train_set or addr in self._removed:
+                return None
+            if self._complete.is_set() and not self._waiting:
+                return None  # no collection window open — nothing to repair
+            covered = {c for key in self._models for c in key}
+            if addr in covered:
+                logger.debug(
+                    self.node_name,
+                    f"Train-set member {addr} evicted but already contributed — keeping",
+                )
+                return None
+            self._removed.add(addr)
+            target = set(self._train_set) - self._removed
+            logger.log_comm_metric(self.node_name, "train_set_repair")
+            logger.warning(
+                self.node_name,
+                f"Train-set repair: {addr} evicted before contributing — "
+                f"coverage target shrunk to {sorted(target)}",
+            )
+            if self._waiting:
+                return None  # acceptance interval widened; nothing to announce
+            if covered and covered >= target:
                 self._complete.set()
             return sorted(covered)
 

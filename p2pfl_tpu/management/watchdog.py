@@ -100,6 +100,10 @@ class StallWatchdog:
                 continue  # this stall (same stuck transition) already reported
             self._reported[addr] = last
             stage = getattr(state, "current_stage", "?")
+            # countable health signal alongside the human-readable dump —
+            # chaos tests and CI assert get_comm_metrics()['stall_detected']
+            # stays zero instead of grepping logs
+            logger.log_comm_metric(addr, "stall_detected")
             logger.error(
                 addr,
                 f"STALL: no stage transition for {now - last:.0f}s "

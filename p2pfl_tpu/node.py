@@ -8,6 +8,7 @@ for pure-communication use, matching the reference's communication tests.
 from __future__ import annotations
 
 import threading
+import time
 import weakref
 from typing import Any, Optional, Type, Union
 
@@ -35,6 +36,7 @@ from p2pfl_tpu.learning.aggregators.fedavg import FedAvg
 from p2pfl_tpu.learning.weights import ModelUpdate
 from p2pfl_tpu.management.logger import logger
 from p2pfl_tpu.node_state import NodeState
+from p2pfl_tpu.settings import Settings
 
 
 #: weak registry of every constructed Node — lets harnesses find and stop
@@ -92,12 +94,28 @@ class Node:
         self.total_rounds = 0
         self.epochs = 1
         self.pending_init_update: Optional[ModelUpdate] = None
+        # init_model that raced ahead of start_learning (weights plane vs
+        # TTL-flooded control broadcast): stashed with its arrival time,
+        # consumed by StartLearningStage while still fresh. Deliberately
+        # NOT latched into model_initialized_event at arrival — a LATE
+        # init (delivered after a graceful timeout abort) must not leak
+        # into the next experiment.
+        self._early_init_lock = threading.Lock()
+        self._early_init: Optional[tuple[float, ModelUpdate]] = None
         # round-start global stash for secagg dropout fallback
         # (stages/learning_stages.py TrainStage / GossipModelStage)
         self.round_start_params: Optional[Any] = None
         self._interrupt = threading.Event()
         self._learning_thread: Optional[threading.Thread] = None
         self._running = False
+        #: callables invoked as ``hook(node, stage_name)`` on every stage
+        #: transition of the learning thread — the fault-injection layer's
+        #: crash-at-stage seam (communication/faults.py)
+        self.stage_hooks: list = []
+        # mid-round train-set repair: heartbeat evictions of train-set
+        # members shrink the round's coverage target (aggregator) and the
+        # gossip targets (state.train_set) instead of stalling the round
+        self.protocol.add_evict_listener(self._on_peer_evicted)
         ALL_NODES.add(self)
 
         # command registry (reference node.py:110-131)
@@ -189,6 +207,14 @@ class Node:
     def learning_interrupted(self) -> bool:
         return self._interrupt.is_set()
 
+    def learning_active(self) -> bool:
+        """True while a learning thread is running — from the moment this
+        node processed ``start_learning`` until the workflow returned
+        (including graceful aborts). Commands that only make sense inside
+        an experiment (``init_model``) gate on this."""
+        t = self._learning_thread
+        return t is not None and t.is_alive()
+
     # ---- internals (called by commands too) ----
 
     def _start_learning_thread(self, rounds: int, epochs: int) -> None:
@@ -209,8 +235,89 @@ class Node:
 
         LearningWorkflow().run(self)
 
+    def stash_early_init(self, update: ModelUpdate) -> None:
+        """Hold an init_model that arrived before start_learning was
+        processed (InitModelCommand) for StartLearningStage to consume.
+
+        The TTL is also enforced by a timer, not only at take time: a node
+        that never starts an experiment (a pure-communication overlay
+        member, or a straggler init after an aborted run) must not hold a
+        full model's parameters for the life of the process."""
+        slot = (time.monotonic(), update)
+        with self._early_init_lock:
+            self._early_init = slot
+
+        def _expire() -> None:
+            with self._early_init_lock:
+                if self._early_init is slot:  # not consumed/replaced meanwhile
+                    self._early_init = None
+                    logger.debug(self.addr, "Early init_model stash expired unconsumed")
+
+        t = threading.Timer(Settings.EARLY_INIT_TTL, _expire)
+        t.daemon = True
+        t.start()
+
+    def take_early_init(self) -> Optional[ModelUpdate]:
+        """Pop the pre-start init_model stash if still fresh.
+
+        A stash older than ``Settings.EARLY_INIT_TTL`` is a leftover from
+        a previous (aborted) experiment — seeding THIS experiment with it
+        would discard the real init when it arrives — so it is dropped.
+        """
+        with self._early_init_lock:
+            slot, self._early_init = self._early_init, None
+        if slot is None:
+            return None
+        stashed_at, update = slot
+        if time.monotonic() - stashed_at > Settings.EARLY_INIT_TTL:
+            logger.debug(self.addr, "Discarding stale early init_model stash")
+            return None
+        return update
+
+    def _on_peer_evicted(self, addr: str) -> None:
+        """Mid-round train-set repair (ISSUE 5): a train-set member was
+        heartbeat-evicted. If it has not contributed, shrink the round's
+        coverage target to the survivors and re-announce our coverage so
+        peers' partial-gossip loops converge on the repaired target too —
+        ``wait_and_get_aggregation`` then resolves to the survivors'
+        partial instead of burning the full ``AGGREGATION_TIMEOUT``.
+
+        Inert under ``SECURE_AGGREGATION``: a survivors-only early close
+        would apply an aggregate still carrying the dead member's
+        uncancelled pair masks — secagg's seed-recovery machinery owns
+        dropouts there (stages/learning_stages.py).
+        """
+        st = self.state
+        if not Settings.TRAIN_SET_REPAIR or Settings.SECURE_AGGREGATION:
+            return
+        with st.train_set_lock:
+            # check-and-record under the lock: the vote tally
+            # (VoteTrainSetStage) replaces both fields concurrently on the
+            # learning thread — unsynchronized, one write silently wins.
+            # train_set itself is left INTACT (see NodeState.train_set_evicted:
+            # the aggregator must keep accepting this member's contributions
+            # that reached peers); only gossip targeting subtracts the set.
+            if st.round is None or addr == self.addr:
+                return
+            if addr not in st.train_set or addr in st.train_set_evicted:
+                return
+            st.train_set_evicted = st.train_set_evicted | {addr}
+            survivors = [n for n in st.train_set if n not in st.train_set_evicted]
+        logger.warning(
+            self.addr,
+            f"Train-set member {addr} evicted mid-round — gossip targets "
+            f"repaired to {survivors}",
+        )
+        covered = self.aggregator.discard_member(addr)
+        if covered:
+            self.protocol.broadcast(
+                self.protocol.build_msg("models_aggregated", covered, round=st.round or 0)
+            )
+
     def _stop_learning(self) -> None:
         self._interrupt.set()
+        with self._early_init_lock:
+            self._early_init = None
         if self.learner is not None:
             self.learner.interrupt_fit()
         self.aggregator.clear()

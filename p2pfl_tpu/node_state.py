@@ -30,6 +30,16 @@ class NodeState:
         self.nei_status: Dict[str, int] = {}
 
         self.train_set: List[str] = []
+        # mid-round train-set repair (Node._on_peer_evicted): members
+        # evicted from the overlay. train_set itself stays the FULL elected
+        # set — the aggregator must keep accepting an evicted member's
+        # contributions that reached peers (its acceptance interval is
+        # [train_set - removed, train_set]); shrinking the list here would
+        # turn those into "foreign contributors" and make every aggregate
+        # naming the member unacceptable for the rest of the experiment.
+        # Gossip targeting subtracts this set instead. Guarded by
+        # train_set_lock; writers REPLACE the set, never mutate in place.
+        self.train_set_evicted: set = set()
         self.train_set_votes: Dict[str, Dict[str, int]] = {}
 
         # secure aggregation (learning/secagg.py): this node's DH private key
@@ -74,6 +84,20 @@ class NodeState:
         self.current_stage: str = ""
 
         # synchronization (reference: four lock-latches, node_state.py:77-81)
+        # train_set has two writers on different threads: the vote tally
+        # (learning thread) and mid-round repair (heartbeater eviction
+        # listener, Node._on_peer_evicted) — both must hold this lock for
+        # their read-filter-write, or one silently overwrites the other.
+        # Readers take the list reference unlocked (writers always REPLACE
+        # the list, never mutate it in place).
+        self.train_set_lock = threading.Lock()
+        # serializes the control handlers' monotone read-merge-writes on
+        # models_aggregated / nei_status: handlers run on whatever thread
+        # delivers the message (sender gossip workers, duplicate-delivery
+        # timers), and two unlocked merges for the same source could still
+        # clobber each other — the exact stale-overwrite the monotone
+        # merges exist to prevent, surviving as a race window
+        self.status_merge_lock = threading.Lock()
         self.train_set_votes_lock = threading.Lock()
         self.start_thread_lock = threading.Lock()
         self.votes_ready_event = threading.Event()
@@ -91,6 +115,10 @@ class NodeState:
         """Advance the round; clears per-round caches (``node_state.py:97``)."""
         if self.round is None:
             raise ValueError("round not initialized")
+        # ORDER MATTERS: bump the round BEFORE replacing models_aggregated.
+        # ModelsAggregatedCommand captures the dict and then checks the
+        # round — seeing the new dict must imply the new round is already
+        # visible, or a raced stale entry leaks into the next round's view.
         self.round += 1
         self.models_aggregated = {}
 
@@ -102,7 +130,9 @@ class NodeState:
         self.total_rounds = None
         self.models_aggregated = {}
         self.nei_status = {}
-        self.train_set = []
+        with self.train_set_lock:
+            self.train_set = []
+            self.train_set_evicted = set()
         self.train_set_votes = {}
         self.secagg_priv = None
         self.secagg_pubs = {}

@@ -54,6 +54,47 @@ class Settings:
     # exercises/benches the real byte path without sockets (bench_gossip).
     MEMORY_WIRE_CODEC: bool = False
 
+    # --- control-plane reliability (communication/reliability.py) ---
+    # Failed message-plane sends are retried with exponential backoff +
+    # jitter up to this many attempts (0 restores the old fire-and-forget
+    # behavior where a False return silently lost the broadcast).
+    MESSAGE_RETRY_MAX: int = 4
+    # First-retry backoff; attempt a waits BASE * 2**(a-1), capped below.
+    MESSAGE_RETRY_BASE: float = 0.25
+    MESSAGE_RETRY_CAP: float = 2.0
+    # Consecutive send failures (any plane) before a neighbor is SUSPECT.
+    BREAKER_THRESHOLD: int = 3
+    # A suspect neighbor is evicted after this many seconds of beat
+    # silence instead of the full HEARTBEAT_TIMEOUT — send failures feed
+    # failure detection continuously (accrual-style) rather than relying
+    # on one binary timeout. Must exceed HEARTBEAT_PERIOD with slack
+    # (keep ~2x): a live suspect's last_beat age reaches a full period
+    # between beats, and a window equal to the period would evict on
+    # ordinary delivery jitter rather than actual silence.
+    BREAKER_SUSPECT_TIMEOUT: float = 4.0
+    # Mid-round train-set repair (learning/aggregators/aggregator.py):
+    # when a train-set member is evicted mid-round, shrink the round's
+    # coverage target to the live members and re-announce coverage, so
+    # aggregation resolves to the survivors' partial instead of burning
+    # the full AGGREGATION_TIMEOUT. Automatically inert under
+    # SECURE_AGGREGATION (secagg's seed-recovery machinery owns dropouts
+    # there — masks must be recovered, not skipped).
+    TRAIN_SET_REPAIR: bool = True
+    # An init_model that arrives BEFORE this node processed start_learning
+    # (the weights plane can beat the TTL-flooded control broadcast,
+    # especially when start_learning rides a retry backoff) is stashed and
+    # consumed by StartLearningStage if the experiment starts within this
+    # many seconds — instead of being dropped and relying on a redelivery
+    # the initiator's push loop may never make (it exits once its status
+    # view stops changing). The window is the ONLY discriminator between
+    # that race and a LATE init from a previous aborted experiment (the
+    # wire carries no experiment identity), so keep it just wide enough
+    # for the race: total message-plane retry backoff (~ MESSAGE_RETRY_MAX
+    # backoffs capped at MESSAGE_RETRY_CAP) plus flood relay lag — and
+    # well under any realistic gap between experiments, or a stale stash
+    # could seed the next experiment and shadow its real init.
+    EARLY_INIT_TTL: float = 15.0
+
     # --- learning round ---
     TRAIN_SET_SIZE: int = 4
     VOTE_TIMEOUT: float = 60.0
@@ -221,6 +262,9 @@ def set_low_latency_settings() -> None:
     Settings.AGGREGATION_TIMEOUT = 60.0
     Settings.SECAGG_RECOVERY_TIMEOUT = 10.0
     Settings.WAIT_HEARTBEATS_CONVERGENCE = 0.4
+    Settings.MESSAGE_RETRY_BASE = 0.1
+    Settings.MESSAGE_RETRY_CAP = 0.8
+    Settings.BREAKER_SUSPECT_TIMEOUT = 0.8
 
 
 def set_test_settings() -> None:
@@ -241,6 +285,13 @@ def set_test_settings() -> None:
     Settings.GOSSIP_SEND_WORKERS = 4
     Settings.GOSSIP_SEND_TIMEOUT = 2.0
     Settings.GOSSIP_PAYLOAD_CACHE = True
+    Settings.MESSAGE_RETRY_MAX = 4
+    Settings.MESSAGE_RETRY_BASE = 0.05
+    Settings.MESSAGE_RETRY_CAP = 0.4
+    Settings.BREAKER_THRESHOLD = 3
+    Settings.BREAKER_SUSPECT_TIMEOUT = 0.6
+    Settings.TRAIN_SET_REPAIR = True
+    Settings.EARLY_INIT_TTL = 15.0
     Settings.MEMORY_WIRE_CODEC = False
     Settings.WIRE_COMPRESSION_DEVICE = True
     Settings.CHUNK_STAGING_DEPTH = 2

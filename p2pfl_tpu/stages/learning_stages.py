@@ -79,11 +79,44 @@ class StartLearningStage(Stage):
                 )
             )
 
+        # an init_model may have raced ahead of our start_learning (weights
+        # plane vs TTL-flooded control broadcast): consume the fresh stash
+        # (commands/learning.py InitModelCommand) instead of waiting for a
+        # redelivery the initiator's exited push loop will never make
+        early = node.take_early_init()
+        if early is not None and not state.model_initialized_event.is_set():
+            try:
+                if early.params is None:
+                    early = node.learner.materialize(early)
+                node.pending_init_update = early
+                state.model_initialized_event.set()
+                node.protocol.broadcast(node.protocol.build_msg("model_initialized"))
+            except Exception as exc:  # noqa: BLE001 — a bad stash falls back to the normal wait
+                logger.info(
+                    node.addr,
+                    f"Stashed early init_model unusable ({exc!r}) — waiting for redelivery",
+                )
+
         # wait for initial weights: the initiator's event was set by
         # set_start_learning(); everyone else blocks until init_model arrives
         # (reference blocks on model_initialized_lock, start_learning_stage.py:78)
         if not state.model_initialized_event.wait(timeout=Settings.AGGREGATION_TIMEOUT):
-            raise TimeoutError("initial model never arrived")
+            # graceful abort, not an escaping TimeoutError: the initiator may
+            # have died before its init_model reached us — this node clears
+            # the experiment and keeps serving the overlay (it can join the
+            # next start_learning normally)
+            logger.error(
+                node.addr,
+                "Initial model never arrived within AGGREGATION_TIMEOUT — "
+                "aborting the experiment (node keeps serving)",
+            )
+            # an init that straggles in DURING the abort is this (dead)
+            # experiment's — it must not sit in the stash and seed the
+            # next one (anything later than this is bounded by the
+            # EARLY_INIT_TTL freshness check)
+            node.take_early_init()
+            state.clear()
+            return None
         if node.pending_init_update is not None:
             try:
                 node.learner.set_parameters(node.pending_init_update.params)
@@ -184,9 +217,15 @@ class VoteTrainSetStage(Stage):
         ranked = sorted(results.items(), key=lambda kv: (kv[1], kv[0]), reverse=True)
         train_set = [n for n, _ in ranked[: Settings.TRAIN_SET_SIZE]]
 
-        # drop elected nodes that died since (reference :167-178)
-        live = set(node.protocol.get_neighbors(only_direct=False)) | {node.addr}
-        state.train_set = [n for n in train_set if n in live]
+        # drop elected nodes that died since (reference :167-178); the live
+        # snapshot and the assignment run under train_set_lock so an
+        # eviction listener's concurrent read-filter-write
+        # (Node._on_peer_evicted, heartbeater thread) cannot interleave
+        # and replace the fresh election with a stale filtered list
+        with state.train_set_lock:
+            live = set(node.protocol.get_neighbors(only_direct=False)) | {node.addr}
+            state.train_set = [n for n in train_set if n in live]
+            state.train_set_evicted = set()  # fresh election: repairs reset
         logger.info(node.addr, f"Train set: {state.train_set}")
 
         return TrainStage if node.addr in state.train_set else WaitAggregatedModelsStage
@@ -200,7 +239,13 @@ class TrainStage(Stage):
     @staticmethod
     def execute(node: "Node") -> Optional[Type[Stage]]:
         state = node.state
+        # the FULL elected set opens the window (an already-evicted member's
+        # contributions that reached peers must stay aggregatable), then
+        # earlier rounds'/pre-stage evictions shrink the coverage target —
+        # the same repair Node._on_peer_evicted applies mid-round
         node.aggregator.set_nodes_to_aggregate(state.train_set)
+        for gone in list(state.train_set_evicted):
+            node.aggregator.discard_member(gone)
         if Settings.SECURE_AGGREGATION:
             # stash the round-start global: if a dropout makes the round's
             # masked aggregate unrecoverable, the round is discarded back to
@@ -339,19 +384,28 @@ class TrainStage(Stage):
         train-set members may not be direct neighbors.
         """
         state = node.state
-        train = set(state.train_set)
 
         def early_stop() -> bool:
             return node.learning_interrupted()
 
+        # re-read the train set EVERY tick, not once at stage entry:
+        # mid-round repair (Node._on_peer_evicted) records evicted members
+        # in state.train_set_evicted, and a snapshot here would keep
+        # gossiping at — and waiting on coverage announcements from — a
+        # dead peer until the convergence detector gave up on its own
+        def live_train() -> set:
+            return set(state.train_set) - state.train_set_evicted
+
         def candidates() -> list[str]:
+            train = live_train()
             out = []
             for n in train - {node.addr}:
-                if set(state.models_aggregated.get(n, [])) != train:
+                if not (train <= set(state.models_aggregated.get(n, []))):
                     out.append(n)
             return out
 
         def status():
+            train = live_train()
             return {n: tuple(sorted(state.models_aggregated.get(n, []))) for n in sorted(train)}
 
         def model_fn(nei: str):
@@ -386,7 +440,12 @@ class WaitAggregatedModelsStage(Stage):
 
     @staticmethod
     def execute(node: "Node") -> Optional[Type[Stage]]:
+        # full elected set, then apply pre-stage evictions — mirrors
+        # TrainStage so the acceptance interval stays
+        # [survivors, full train set] on both paths
         node.aggregator.set_waiting_aggregated_model(node.state.train_set)
+        for gone in list(node.state.train_set_evicted):
+            node.aggregator.discard_member(gone)
         return GossipModelStage
 
 
@@ -453,7 +512,11 @@ class GossipModelStage(Stage):
             # header, not the encoded tensor bytes, so rewriting them below
             # never invalidates the cached payload
             update = node.learner.get_model_update()
-            update.contributors = list(state.train_set)
+            # claim the survivors, not the full elected set: after repair
+            # the round's aggregate genuinely lacks the evicted members
+            update.contributors = [
+                n for n in state.train_set if n not in state.train_set_evicted
+            ]
             if Settings.SECURE_AGGREGATION and Settings.SECAGG_DOUBLE_MASK:
                 # mark the diffusion as FINALIZED (self-mask-free): a
                 # receiver's aggregator may otherwise hold a bit-different

@@ -16,6 +16,7 @@ class LearningWorkflow:
     def run(self, node: "Node") -> None:
         import time
 
+        from p2pfl_tpu.communication.faults import FaultCrash
         from p2pfl_tpu.stages.learning_stages import StartLearningStage
 
         stage = StartLearningStage
@@ -25,10 +26,33 @@ class LearningWorkflow:
             node.state.current_stage = stage.name
             node.state.last_transition = time.monotonic()
             try:
+                # crash-at-stage seam (communication/faults.py): hooks run on
+                # every transition and may raise FaultCrash to kill the node
+                for hook in node.stage_hooks:
+                    hook(node, stage.name)
                 stage = stage.execute(node)
+            except FaultCrash as exc:
+                # injected hard crash: the node is already torn down with no
+                # goodbyes; just stop executing, like a killed process
+                logger.info(node.addr, f"{exc}")
+                return
             except Exception as exc:  # noqa: BLE001 — stage failure ends learning, not the node
                 if node.learning_interrupted():
                     logger.info(node.addr, f"Learning interrupted during {stage.name}")
                 else:
                     logger.error(node.addr, f"Stage {stage.name} failed: {exc!r}")
+                    # a failed stage must not leave experiment state latched:
+                    # the monotone control-plane merges (commands/control.py)
+                    # assume nei_status/models_aggregated reset at experiment
+                    # boundaries, and a stale "peer is at round N" entry would
+                    # exclude that peer from the next experiment's diffusion
+                    # forever (interrupt path already clears via _stop_learning)
+                    node.state.clear()
+                    # same for the aggregator: a stage that died between
+                    # set_nodes_to_aggregate() and the aggregation resolving
+                    # leaves _complete cleared, and the NEXT experiment's
+                    # set_nodes_to_aggregate would raise "already in
+                    # progress" — failing every subsequent experiment one
+                    # stage in until an explicit stop_learning
+                    node.aggregator.clear()
                 return

@@ -114,6 +114,13 @@ def test_node_abrupt_down_evicted_by_heartbeat():
 
 
 def test_send_failure_evicts_neighbor():
+    """Send failures no longer evict instantly (the reference's behavior,
+    which also silently lost the message): the failed send is retried with
+    backoff while consecutive failures open the circuit breaker, and the
+    heartbeater evicts the suspect on its accelerated clock — bounded, but
+    not synchronous (communication/reliability.py)."""
+    import time as _time
+
     n1, n2 = _make_nodes(2)
     n1.connect(n2.addr)
     wait_convergence([n1, n2], 1, only_direct=True)
@@ -121,7 +128,18 @@ def test_send_failure_evicts_neighbor():
     n2.protocol._server_stop()
     ok = n1.protocol.send(n2.addr, n1.protocol.build_msg("beat", ["0"]))
     assert not ok
+    # still a neighbor right after ONE failure — one transient failure is
+    # not death anymore
+    assert n2.addr in n1.get_neighbors()
+    # ...but sustained failure opens the breaker and eviction follows
+    # within the suspect window, well before HEARTBEAT_TIMEOUT would fire
+    deadline = _time.monotonic() + 10.0
+    while n2.addr in n1.get_neighbors() and _time.monotonic() < deadline:
+        _time.sleep(0.05)
     assert n2.addr not in n1.get_neighbors()
+    from p2pfl_tpu.management.logger import logger as _logger
+
+    assert _logger.get_comm_metrics(n1.addr).get("breaker_open", 0) >= 1
     _stop_all([n1, n2])
 
 

@@ -4,11 +4,23 @@ FedMedian/Krum must not be fed pre-averaged partials
 (``SUPPORTS_PARTIALS=False``); in gossip mode nodes ship individual models
 one per tick. This covers the reference's ``get_partial_aggregation`` /
 models-to-send seam (``aggregator.py:249-281``) for the robust family.
+
+Also: message-plane robustness against a stalled neighbor — a control
+message whose send is skipped because the neighbor has a send stuck past
+``GOSSIP_SEND_TIMEOUT`` must be requeued and redelivered once the stall
+clears, and ``stop()``/``start()`` must not leak ``_stalled`` state into
+the next run.
 """
+
+import threading
+import time
 
 import pytest
 
+from p2pfl_tpu.communication.gossiper import Gossiper
 from p2pfl_tpu.communication.memory import MemoryRegistry
+from p2pfl_tpu.communication.message import Message
+from p2pfl_tpu.settings import Settings
 from p2pfl_tpu.learning.aggregators import FedMedian
 from p2pfl_tpu.learning.dataset import FederatedDataset
 from p2pfl_tpu.learning.learner import JaxLearner
@@ -22,6 +34,136 @@ def _clean():
     MemoryRegistry.reset()
     yield
     MemoryRegistry.reset()
+
+
+class _StallableTransport:
+    """Fake transport: sends to ``stalled`` neighbors block on an event."""
+
+    def __init__(self):
+        self.release = threading.Event()
+        self.stall_nei: str = ""
+        self.delivered: list[tuple[str, str]] = []  # (nei, cmd)
+        self.lock = threading.Lock()
+
+    def __call__(self, nei, env, create_connection=False):
+        if nei == self.stall_nei and not self.release.is_set():
+            self.release.wait(timeout=10)
+        with self.lock:
+            self.delivered.append((nei, env.cmd))
+        return True
+
+    def got(self, nei, cmd):
+        with self.lock:
+            return (nei, cmd) in self.delivered
+
+
+def test_message_requeued_after_stall_clears():
+    """A control send skipped for a stalled neighbor is NOT lost: it is
+    requeued and delivered once the stuck task completes."""
+    old_timeout = Settings.GOSSIP_SEND_TIMEOUT
+    Settings.GOSSIP_SEND_TIMEOUT = 0.2
+    transport = _StallableTransport()
+    transport.stall_nei = "peer"
+    g = Gossiper("me", transport)
+    g.start()
+    try:
+        # first message's send blocks → exceeds its budget → peer stalled
+        g.add_message(Message("me", "first", ()), ["peer"])
+        deadline = time.monotonic() + 5.0
+        while "peer" not in g._stalled and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert "peer" in g._stalled, "stall was never detected"
+
+        # second message: dispatch must skip (not stack another worker
+        # behind the stall) and requeue — and must not mark a failure
+        g.add_message(Message("me", "second", ()), ["peer"])
+        time.sleep(0.5)
+        assert not transport.got("peer", "second")
+
+        # stall clears → the requeued message is redelivered
+        transport.release.set()
+        deadline = time.monotonic() + 5.0
+        while not transport.got("peer", "second") and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert transport.got("peer", "first")
+        assert transport.got("peer", "second"), "requeued message was lost"
+        assert "peer" not in g._stalled
+    finally:
+        Settings.GOSSIP_SEND_TIMEOUT = old_timeout
+        g.stop()
+
+
+def test_stop_start_clears_stalled():
+    """A send hung past stop() must not leave its neighbor excluded after
+    a fresh start(): the stalled set gets a clean slate."""
+    old_timeout = Settings.GOSSIP_SEND_TIMEOUT
+    Settings.GOSSIP_SEND_TIMEOUT = 0.2
+    transport = _StallableTransport()
+    transport.stall_nei = "peer"
+    g = Gossiper("me", transport)
+    g.start()
+    try:
+        g.add_message(Message("me", "first", ()), ["peer"])
+        deadline = time.monotonic() + 5.0
+        while "peer" not in g._stalled and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert "peer" in g._stalled
+        g.stop()  # the hung send never runs its done-callback
+
+        g.start()
+        assert g._stalled == {}, "stalled state leaked across stop()/start()"
+        transport.stall_nei = ""  # peer is healthy in the new run
+        g.add_message(Message("me", "after-restart", ()), ["peer"])
+        deadline = time.monotonic() + 5.0
+        while not transport.got("peer", "after-restart") and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert transport.got("peer", "after-restart"), "neighbor still excluded after restart"
+    finally:
+        Settings.GOSSIP_SEND_TIMEOUT = old_timeout
+        transport.release.set()
+        g.stop()
+
+
+def test_late_failure_after_stall_is_retried():
+    """A control send that overruns GOSSIP_SEND_TIMEOUT and then FAILS on
+    its worker is not silently lost: the late outcome feeds the retry
+    queue and the message is redelivered (regression — the late result
+    used to be discarded, so only prompt failures were retried)."""
+    old_timeout = Settings.GOSSIP_SEND_TIMEOUT
+    Settings.GOSSIP_SEND_TIMEOUT = 0.2
+    release = threading.Event()
+    delivered: list[tuple[str, str]] = []
+    lock = threading.Lock()
+    calls = {"n": 0}
+
+    def transport(nei, env, create_connection=False):
+        with lock:
+            calls["n"] += 1
+            first = calls["n"] == 1
+        if first:
+            release.wait(timeout=10)
+            return False  # hung past the budget, then definitively failed
+        with lock:
+            delivered.append((nei, env.cmd))
+        return True
+
+    g = Gossiper("me", transport)
+    g.start()
+    try:
+        g.add_message(Message("me", "vote", ()), ["peer"])
+        deadline = time.monotonic() + 5.0
+        while "peer" not in g._stalled and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert "peer" in g._stalled, "stall was never detected"
+        release.set()  # the hung send now returns False
+        deadline = time.monotonic() + 5.0
+        while not delivered and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert ("peer", "vote") in delivered, "late-failed send was lost"
+    finally:
+        Settings.GOSSIP_SEND_TIMEOUT = old_timeout
+        release.set()
+        g.stop()
 
 
 def test_fedmedian_gossip_three_nodes():

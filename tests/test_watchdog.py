@@ -72,6 +72,10 @@ def test_stall_detected_and_reported_once():
     assert "stuck-node" in msg and "VoteTrainSetStage" in msg
     assert "stall-watchdog" in msg or "MainThread" in msg  # stacks included
     assert all("moving-node" not in r.getMessage() for r in hits)
+    # the stall is also a countable health metric (chaos tests / CI assert
+    # zero stalls via get_comm_metrics instead of grepping logs)
+    assert logger.get_comm_metrics("stuck-node").get("stall_detected", 0) == 1
+    assert logger.get_comm_metrics("moving-node").get("stall_detected", 0) == 0
 
     # one report per stall, not one per tick
     hits2 = wait_for_hits(2, timeout=1.0)
