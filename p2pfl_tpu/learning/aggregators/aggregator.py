@@ -138,6 +138,13 @@ class Aggregator:
 
         An empty return means the update was rejected (duplicate, overlapping,
         foreign contributor, or no collection window open).
+
+        Accepts fully DEVICE-RESIDENT contributions: ``update.params`` may
+        be uncommitted jax arrays (futures of an in-flight dispatch) and
+        the node's own fused-round contribution additionally carries
+        ``update.partial_acc`` — the fp32 accumulator the train dispatch
+        already folded. Nothing here forces a host sync; collection is
+        pure bookkeeping, and the fold happens in the aggregate kernels.
         """
         contributors = frozenset(update.contributors)
         if not contributors:
@@ -309,6 +316,9 @@ class Aggregator:
             waiting or not self.ALWAYS_AGGREGATE or len(models[0].contributors) > 1
         ):
             return self.on_result(models[0])
+        from p2pfl_tpu.management.profiling import record_dispatch
+
+        record_dispatch("aggregate", self.node_name)
         return self._inherit_anchor(self.aggregate(models), models)
 
     @staticmethod
@@ -374,6 +384,9 @@ class Aggregator:
             gen = self._memo_gen
         if hit is not None:
             return hit
+        from p2pfl_tpu.management.profiling import record_dispatch
+
+        record_dispatch("aggregate", self.node_name)
         result = self._inherit_anchor(self.aggregate(todo), todo)
         with self._lock:
             if self._memo_gen == gen:  # collected set unchanged since read

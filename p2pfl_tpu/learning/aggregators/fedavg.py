@@ -1,7 +1,12 @@
 """FedAvg: sample-weighted mean (McMahan et al. 2017).
 
 Reference: ``p2pfl/learning/aggregators/fedavg.py:28-60`` (a Python loop over
-state-dict layers). Here: one jitted weighted-mean over the stacked pytree.
+state-dict layers). Here: one jitted weighted-mean over the stacked pytree —
+and when the round ran fused (``Settings.ROUND_FUSED``), the node's own
+contribution arrives as a device-resident fp32 accumulator
+(:attr:`~p2pfl_tpu.learning.weights.ModelUpdate.partial_acc`, folded inside
+the train dispatch), so aggregation starts from it and only folds the peers:
+the Train→Aggregate seam never re-casts or re-weights the own params.
 """
 
 from __future__ import annotations
@@ -10,7 +15,7 @@ import jax.numpy as jnp
 
 from p2pfl_tpu.learning.aggregators.aggregator import Aggregator
 from p2pfl_tpu.learning.weights import ModelUpdate
-from p2pfl_tpu.ops.aggregation import fedavg
+from p2pfl_tpu.ops.aggregation import fedavg, fedavg_fold_acc
 from p2pfl_tpu.ops.tree import tree_stack
 from p2pfl_tpu.settings import Settings
 
@@ -20,8 +25,25 @@ class FedAvg(Aggregator):
     MASK_COMPATIBLE = True  # linear: secagg pairwise masks cancel through it
 
     def aggregate(self, models: list[ModelUpdate]) -> ModelUpdate:
+        contributors = sorted({c for m in models for c in m.contributors})
+        total = sum(m.num_samples for m in models)
+        own = next((m for m in models if m.partial_acc is not None), None)
+        if own is not None:
+            # fused-round seam: continue the in-dispatch fp32 fold instead
+            # of restacking; the accumulator is read, never donated — the
+            # memoized partial getters reuse it across peer-coverage sets
+            others = [m for m in models if m is not own]
+            psum, wsum = own.partial_acc
+            params = fedavg_fold_acc(
+                psum,
+                wsum,
+                tuple(m.params for m in others),
+                jnp.asarray([float(m.num_samples) for m in others], jnp.float32),
+                own.params,
+                Settings.AGG_DTYPE,
+            )
+            return ModelUpdate(params, contributors, total)
         stacked = tree_stack([m.params for m in models])
         weights = jnp.asarray([float(m.num_samples) for m in models])
         params = fedavg(stacked, weights, Settings.AGG_DTYPE)
-        contributors = sorted({c for m in models for c in m.contributors})
-        return ModelUpdate(params, contributors, sum(m.num_samples for m in models))
+        return ModelUpdate(params, contributors, total)

@@ -66,6 +66,36 @@ class NodeLearner(ABC):
 
     addr: str = ""
 
+    def fused_round(self):
+        """Whole train-stage compute as one donated dispatch, or None.
+
+        The fused overlay round (``Settings.ROUND_FUSED``): evaluate the
+        incoming model, run all local epochs and fold the node's own
+        weighted fp32 partial-aggregation contribution in a SINGLE jit
+        dispatch, returning the node's own
+        :class:`~p2pfl_tpu.learning.weights.ModelUpdate` with device-
+        resident ``params`` and ``partial_acc`` — nothing on the model
+        plane syncs to host. Metrics come back as device scalars, stashed
+        for :meth:`pop_round_metrics` (one batched D2H flush per round).
+
+        Returning None means this learner cannot fuse (the base default):
+        ``TrainStage`` falls back to the staged ``evaluate()`` + ``fit()``
+        sequence, which stays the bit-parity baseline.
+        """
+        return None
+
+    def pop_round_metrics(self) -> dict:
+        """Take (and clear) the metrics stashed by :meth:`fused_round`.
+
+        ``{"train_loss_series": ([E] dev vector, [E] step numbers)
+        [, "test_loss", "test_acc"]}`` — values are device arrays;
+        converting them is the round's ONE metric host sync, done by the
+        stage flush after aggregation already forced the program.
+        """
+        out = getattr(self, "_round_metrics", None) or {}
+        self._round_metrics = {}
+        return out
+
     def set_addr(self, addr: str) -> None:
         self.addr = addr
 
@@ -330,6 +360,8 @@ class JaxLearner(NodeLearner):
                 logger.info(self.addr, "Training interrupted")
                 return
             xs, ys = self.data.epoch_batches(self.batch_size, self._rng)
+            from p2pfl_tpu.management.profiling import record_dispatch
+
             if self.dp_clip > 0.0:
                 from p2pfl_tpu.learning.privacy import dp_train_epoch
 
@@ -346,8 +378,107 @@ class JaxLearner(NodeLearner):
                     self.params, self.opt_state, jnp.asarray(xs), jnp.asarray(ys),
                     self.model.module, self.tx, prox_mu=self.prox_mu, anchor=anchor,
                 )
+            record_dispatch("train_epoch", self.addr)
             self._steps_done += xs.shape[0]
             logger.log_metric(self.addr, "train_loss", float(loss), step=self._steps_done)
+
+    def fused_round(self):
+        """Eval + all local epochs + own partial fold: ONE donated dispatch.
+
+        Calls :func:`p2pfl_tpu.parallel.spmd.fused_node_round` (the shared
+        fused-step builder — same ``_local_epoch`` math as the SPMD round)
+        on this node's params/opt state and the round's pre-drawn epoch
+        batches, so ``TrainStage`` crosses the host↔device boundary once
+        per round instead of ``1 + epochs`` times with a blocking
+        ``float(loss)`` after every epoch. Metrics stay device scalars
+        (stashed for :meth:`pop_round_metrics`); the returned own update
+        carries device-resident ``params`` and the fp32 ``partial_acc``
+        the aggregator folds peers into.
+
+        Returns None — caller falls back to the staged path — for the
+        variants the single program does not cover: DP-SGD (its per-epoch
+        rng derivation is owned by ``fit``) and ``epochs == 0`` test mode.
+        A FAILED dispatch also returns None after restoring the batch-rng
+        stream and rebuilding the donated opt state, so one bad dispatch
+        degrades to the staged path instead of poisoning the learner
+        (the PR-4 encode lesson, applied to the round program).
+        """
+        if self.epochs == 0 or self.dp_clip > 0.0:
+            return None
+        from p2pfl_tpu.management.profiling import record_dispatch
+        from p2pfl_tpu.parallel.spmd import fused_node_round, tree_has_deleted
+        from p2pfl_tpu.settings import Settings
+
+        self._interrupt.clear()
+        rng_state = self._rng.bit_generator.state
+        xs_eps, ys_eps = [], []
+        for _ in range(self.epochs):
+            xs, ys = self.data.epoch_batches(self.batch_size, self._rng)
+            xs_eps.append(xs)
+            ys_eps.append(ys)
+        if self._interrupt.is_set():
+            # interrupt_fit() landed while batches were being drawn: honor
+            # it before committing the (uninterruptible) whole-round
+            # dispatch; the rng rewind makes the abort side-effect-free
+            self._rng.bit_generator.state = rng_state
+            logger.info(self.addr, "Training interrupted")
+            return None
+        x_test, y_test = self.data.test_arrays()
+        has_eval = len(y_test) > 0
+        # under secure aggregation the own contribution gets masked before
+        # it enters the aggregator — a pre-folded unmasked accumulator
+        # would bypass the mask, so the fold is compiled out
+        with_acc = not Settings.SECURE_AGGREGATION
+        try:
+            out = fused_node_round(
+                self.params,
+                self.opt_state,
+                jnp.asarray(np.stack(xs_eps)),
+                jnp.asarray(np.stack(ys_eps)),
+                jnp.float32(float(self.get_num_samples())),
+                jnp.asarray(x_test) if has_eval else None,
+                jnp.asarray(y_test) if has_eval else None,
+                module=self.model.module,
+                tx=self.tx,
+                prox_mu=self.prox_mu,
+                with_acc=with_acc,
+                agg_dtype=Settings.AGG_DTYPE,
+            )
+        except Exception as exc:  # noqa: BLE001 — degrade to staged, never poison
+            self._rng.bit_generator.state = rng_state
+            if tree_has_deleted(self.opt_state):
+                # the dispatch consumed the donated opt state before dying:
+                # rebuild instead of leaving deleted arrays in the store
+                self.opt_state = self.tx.init(self.params)
+            logger.error(
+                self.addr,
+                f"Fused round dispatch failed ({exc!r}) — opt state "
+                "rebuilt, falling back to the staged path",
+            )
+            return None
+        record_dispatch("fused_round", self.addr)
+        self.params = out["params"]
+        self.opt_state = out["opt_state"]
+        self.bump_model_version()
+        nb = xs_eps[0].shape[0]
+        base = self._steps_done
+        self._steps_done += self.epochs * nb
+        # per-epoch loss points at the same step numbers fit() logs —
+        # the flush replays the staged path's exact train_loss series
+        metrics = {
+            "train_loss_series": (
+                out["train_losses"],
+                [base + (e + 1) * nb for e in range(self.epochs)],
+            )
+        }
+        if has_eval:
+            metrics["test_loss"] = out["eval_loss"]
+            metrics["test_acc"] = out["eval_acc"]
+        self._round_metrics = metrics
+        update = self.get_model_update()
+        if with_acc:
+            update.partial_acc = (out["psum"], out["wsum"])
+        return update
 
     def interrupt_fit(self) -> None:
         self._interrupt.set()
@@ -356,7 +487,10 @@ class JaxLearner(NodeLearner):
         x, y = self.data.test_arrays()
         if len(y) == 0:
             return {}
+        from p2pfl_tpu.management.profiling import record_dispatch
+
         loss, acc = eval_step(self.params, jnp.asarray(x), jnp.asarray(y), self.model.module)
+        record_dispatch("eval_step", self.addr)
         return {"test_loss": float(loss), "test_acc": float(acc)}
 
     def get_num_samples(self) -> int:

@@ -101,6 +101,15 @@ class PersonalizedLearner(JaxLearner):
         update.params = self._body_tree(update.params)
         return update
 
+    def fused_round(self):
+        """Staged path only: the fused program's partial accumulator folds
+        the FULL parameter tree, but this learner federates body-only
+        updates — a full-tree fold would leak the personal subtree into
+        the aggregate. Returning None routes ``TrainStage`` to the staged
+        ``evaluate()`` + ``fit()`` sequence, whose outgoing update already
+        strips the personal paths."""
+        return None
+
     def set_wire_anchor(self, params, tag: str) -> None:
         # delta-code against the BODY anchor (the only thing on the wire)
         super().set_wire_anchor(self._body_tree(params), tag)

@@ -373,8 +373,10 @@ def encode_params(
     }
     _validate_residual(residual, {key: _size(named[key]) for key in topk_plan})
 
+    from p2pfl_tpu.settings import wire_compression_device
+
     use_device = (
-        Settings.WIRE_COMPRESSION_DEVICE
+        wire_compression_device()
         and compression in ("int8", "topk8")
         and any(isinstance(leaf, jax.Array) for leaf in named.values())
     )
@@ -455,9 +457,9 @@ def decode_params(
             # reach the device consumer without a host round-trip
             anchor_flat = dict(named_leaves(anchor)[1])
 
-        from p2pfl_tpu.settings import Settings
+        from p2pfl_tpu.settings import wire_compression_device
 
-        device_consume = Settings.WIRE_COMPRESSION_DEVICE
+        device_consume = wire_compression_device()
         flat = {}
         deferred: list = []  # tk8 entries reconstructed on device post-CRC
         off = 8 + hlen
@@ -604,6 +606,16 @@ class ModelUpdate:
     #: aggregate-encode error) so dropped delta coordinates re-enter the
     #: next round
     ef_residual: Optional[dict] = None
+    #: device-resident partial-aggregation accumulator ``(psum, wsum)``:
+    #: ``psum`` is the fp32 pytree ``num_samples × params`` already folded
+    #: INSIDE the fused round dispatch (``parallel/spmd.py``
+    #: ``fused_node_round``), ``wsum`` the matching fp32 sample weight.
+    #: Set only on a node's OWN fused train-stage contribution; FedAvg's
+    #: aggregate starts its weighted fold from it instead of re-casting and
+    #: re-weighting the trained params, so the Train→Aggregate seam carries
+    #: device arrays end to end. Never serialized, never set on wire
+    #: updates, dropped by aggregation results and secagg masking.
+    partial_acc: Optional[tuple] = None
     #: encode-once plumbing (module docstring) — the learner's shared
     #: :class:`PayloadCache` plus its model-version counter at the time
     #: this update was handed out; ``cache_round`` is stamped by
@@ -624,7 +636,7 @@ class ModelUpdate:
     def _encode_locked(self) -> bytes:
         if self.encoded is not None:
             return self.encoded
-        from p2pfl_tpu.settings import Settings
+        from p2pfl_tpu.settings import Settings, wire_compression_device
 
         cache = self.payload_cache
         key = None
@@ -637,9 +649,10 @@ class ModelUpdate:
                 self.cache_version,
                 self.cache_round,
                 Settings.WIRE_COMPRESSION,
-                # producer flag: device and host bytes decode identically but
-                # differ at quantization-tie level — never mix them in one key
-                Settings.WIRE_COMPRESSION_DEVICE,
+                # RESOLVED producer flag: device and host bytes decode
+                # identically but differ at quantization-tie level — never
+                # mix them in one key
+                wire_compression_device(),
                 self.anchor_tag,
                 self.ef_residual is not None,
             )

@@ -102,6 +102,51 @@ def mfu(flops: Optional[float], seconds: float, n_devices: int = 1) -> Optional[
     return flops / seconds / (peak * n_devices)
 
 
+# ---- dispatch accounting (ISSUE 6: the host dispatch tax) ----
+#
+# Process-wide counters of MODEL-PLANE device dispatches, incremented at
+# the jit call sites of the overlay round's compute: "eval_step",
+# "train_epoch" (one per epoch on the staged path), "fused_round" (the
+# whole-round program) and "aggregate" (one per Aggregator.aggregate
+# invocation). Deliberately NOT a hook into jax internals — the counter
+# measures how many times OUR hot path crosses the host↔device boundary,
+# which is the tax the fused round exists to kill; incidental eager ops
+# (optimizer re-init, tree utilities) are not the round's dispatch
+# structure and are excluded. Per-node counts additionally land in
+# ``logger.get_comm_metrics(addr)["device_dispatch"]`` so benches can
+# attribute dispatches/round per node.
+
+import threading as _threading
+
+_dispatch_lock = _threading.Lock()
+_dispatch_counts: dict = {}
+
+
+def record_dispatch(site: str, node: str = "") -> None:
+    """Count one model-plane device dispatch issued at ``site``."""
+    with _dispatch_lock:
+        _dispatch_counts[site] = _dispatch_counts.get(site, 0) + 1
+    if node:
+        logger.log_comm_metric(node, "device_dispatch")
+
+
+def get_dispatch_counts() -> dict:
+    """Snapshot of per-site dispatch counters (``logger.get_comm_metrics``
+    style: plain accumulators, reset via :func:`reset_dispatch_counts`)."""
+    with _dispatch_lock:
+        return dict(_dispatch_counts)
+
+
+def total_dispatches() -> int:
+    with _dispatch_lock:
+        return int(sum(_dispatch_counts.values()))
+
+
+def reset_dispatch_counts() -> None:
+    with _dispatch_lock:
+        _dispatch_counts.clear()
+
+
 class Stopwatch:
     """Cheap wall-clock section timing (the reference's --measure_time,
     generalized): ``with sw.section("fit"): ...`` then ``sw.summary()``."""

@@ -32,6 +32,47 @@ def fedavg(stacked: Pytree, weights: jax.Array, agg_dtype: str = "float32") -> P
     return jax.tree.map(avg, stacked)
 
 
+@partial(jax.jit, static_argnames=("agg_dtype",))
+def fedavg_fold_acc(
+    psum: Pytree,
+    wsum: jax.Array,
+    others: tuple,
+    weights: jax.Array,
+    ref: Pytree,
+    agg_dtype: str = "float32",
+) -> Pytree:
+    """Finish a FedAvg whose first term is a pre-folded accumulator.
+
+    ``(psum, wsum)`` is a node's own device-resident partial-aggregation
+    accumulator (``weight × params`` folded INSIDE the fused round
+    dispatch, ``parallel/spmd.py fused_node_round``); ``others`` is a
+    tuple of the remaining contributions' pytrees with ``weights`` their
+    ``[k]`` sample counts (k may be 0). One dispatch: the peers stack
+    into one ``[k, ...]`` tensordot (the same reduction shape
+    :func:`fedavg` compiles, one executable per k like every stacked
+    kernel), the running sum and the final divide all fuse; ``ref``
+    gives the output dtypes.
+
+    Numerics note: this accumulates-then-divides where :func:`fedavg`
+    normalizes-then-tensordots — equivalent algebra, summed in a
+    different order, so results agree to summation-order ulp level in
+    ``agg_dtype`` (the fold-vs-restack parity test's tolerance), NOT bit
+    for bit. The bit-exact fused-vs-staged contract is on the train
+    program's outputs (params / opt state / accumulator), not on this
+    fold's ordering.
+    """
+    if others:
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *others)
+        w = weights.astype(agg_dtype)
+        psum = jax.tree.map(
+            lambda s, x: s + jnp.tensordot(w, x.astype(agg_dtype), axes=(0, 0)),
+            psum,
+            stacked,
+        )
+        wsum = wsum + jnp.sum(w)
+    return jax.tree.map(lambda s, r: (s / wsum).astype(r.dtype), psum, ref)
+
+
 @jax.jit
 def fedmedian(stacked: Pytree) -> Pytree:
     """Coordinate-wise median across the node axis."""

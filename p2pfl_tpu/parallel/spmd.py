@@ -42,7 +42,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from p2pfl_tpu.learning.dataset import FederatedDataset
-from p2pfl_tpu.learning.learner import _loss, _prox_term, adam, sgd
+from p2pfl_tpu.learning.learner import _loss, _prox_term, adam, ce_eval, sgd
 from p2pfl_tpu.models.base import FlaxModel
 from p2pfl_tpu.settings import Settings
 
@@ -139,6 +139,88 @@ def _local_epoch(
     if accumulate_grads:
         return params, opt_state, jnp.mean(losses), gsum
     return params, opt_state, jnp.mean(losses)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("module", "tx", "prox_mu", "with_acc", "agg_dtype"),
+    donate_argnums=(1,),
+)
+def fused_node_round(
+    params,
+    opt_state,
+    xs,  # [E, nb, bs, ...] all local epochs' batches
+    ys,  # [E, nb, bs]
+    weight,  # fp32 scalar sample count (traced: reweighting never retraces)
+    x_test=None,
+    y_test=None,
+    *,
+    module,
+    tx,
+    prox_mu: float = 0.0,
+    with_acc: bool = True,
+    agg_dtype: str = "float32",
+):
+    """ONE overlay node's whole round compute as ONE donated dispatch.
+
+    The overlay (gossip Node) round used to cross the host at every stage
+    boundary: an eval dispatch, one ``train_epoch`` dispatch per epoch with
+    a blocking ``float(loss)`` between each, then host-side re-weighting at
+    aggregation time. This program fuses all of it — the eval forward of
+    the INCOMING params (TrainStage evaluates before training, pure-CE
+    :func:`~p2pfl_tpu.learning.learner.ce_eval` so the metric stays
+    comparable with the staged path), the epoch ``lax.scan`` (shared
+    :func:`_local_epoch` math — bit-comparable to ``train_epoch``), and the
+    node's own partial-aggregation fold ``psum = weight × params'`` in fp32
+    (the chunked-federation accumulator algebra from ``parallel/chunked.py``
+    applied at the Train→Aggregate seam) — so ``TrainStage`` issues exactly
+    one device dispatch and nothing on the model plane syncs to host.
+
+    ``opt_state`` is donated (round-carried state, exactly like
+    ``train_epoch``); ``params`` is NOT — with the zero-copy in-memory
+    transport other nodes' aggregators may hold references to these exact
+    buffers. Returns a dict of device values: ``params``, ``opt_state``,
+    ``train_losses`` (the [E] per-epoch mean-loss vector — the same series
+    the staged path logs point by point), ``psum``/``wsum`` when
+    ``with_acc`` (the :class:`~p2pfl_tpu.learning.weights.ModelUpdate.
+    partial_acc` payload, accumulated in ``agg_dtype`` exactly like the
+    staged fedavg kernel), ``eval_loss``/``eval_acc`` when test data was
+    passed. All metrics stay device values — the caller batches their D2H
+    into one flush per round instead of one sync per step.
+    """
+    out = {}
+    if x_test is not None:
+        e_loss, logits = ce_eval(params, module, x_test, y_test)
+        out["eval_loss"] = e_loss
+        out["eval_acc"] = jnp.mean(
+            (jnp.argmax(logits, axis=-1) == y_test).astype(jnp.float32)
+        )
+    anchor = params if prox_mu > 0.0 else None
+
+    def epoch(carry, batch):
+        p, o = carry
+        exs, eys = batch
+        p, o, loss = _local_epoch(
+            p, o, exs, eys, module, tx, False, prox_mu=prox_mu, anchor=anchor
+        )
+        return (p, o), loss
+
+    (params, opt_state), losses = jax.lax.scan(epoch, (params, opt_state), (xs, ys))
+    out["params"] = params
+    out["opt_state"] = opt_state
+    # [E] per-epoch mean losses — the caller logs the same per-epoch
+    # series the staged fit() produces (one metric point per epoch)
+    out["train_losses"] = losses
+    if with_acc:
+        # weighted fold in Settings.AGG_DTYPE (the same accumulate dtype
+        # the staged fedavg kernel uses), zero-init order identical to the
+        # staged aggregate's ``w·p`` term (0 + w·p ≡ w·p) — the bit-parity
+        # anchor for tests/test_fused_round.py
+        out["psum"] = jax.tree.map(
+            lambda p: p.astype(agg_dtype) * weight.astype(agg_dtype), params
+        )
+        out["wsum"] = weight.astype(agg_dtype)
+    return out
 
 
 def _aggregate(p_used, mask, weights, sel_idx, agg: str, trim: int, center=None, clip_tau: float = 1.0):
@@ -549,6 +631,18 @@ def spmd_eval(stacked_params, x_test, y_test, *, module):
 # ---- host-side driver ----
 
 
+def tree_has_deleted(tree) -> bool:
+    """True if any jax leaf of ``tree`` was consumed by a donated dispatch."""
+    for leaf in jax.tree.leaves(tree):
+        if isinstance(leaf, jax.Array):
+            try:
+                if leaf.is_deleted():
+                    return True
+            except Exception:  # noqa: BLE001 — backends without the probe
+                continue
+    return False
+
+
 def elect_train_set_mask(n: int, py_rng) -> np.ndarray:
     """Round-0 election: every node casts weighted random votes
     (``vote_train_set_stage.py:78-81``); top ``TRAIN_SET_SIZE`` win.
@@ -895,28 +989,32 @@ class SpmdFederation:
         # robust aggregators see only the [K] selected rows; K is static per
         # mask pattern, so the executable is reused as long as K is stable
         sel_idx = jax.device_put(np.flatnonzero(eff).astype(np.int32), self._repl)
-        result = spmd_round(
-            self.params,
-            self.opt_state,
-            self.x_all,
-            self.y_all,
-            perm,
-            mask,
-            self._samples,
-            sel_idx,
-            module=self.module,
-            tx=self.tx,
-            agg=self.aggregator,
-            trim=self.trim,
-            clip_tau=self.clip_tau,
-            out_sharding=self._shard,
-            keep_opt_state=self.keep_opt_state,
-            remat=self.remat,
-            x_test=self.x_test if eval else None,
-            y_test=self.y_test if eval else None,
-            dp_keys=self._dp_round_keys(),
-            **self._algo_kwargs(self._server_t + 1 if self.server_opt else 0),
-        )
+        try:
+            result = spmd_round(
+                self.params,
+                self.opt_state,
+                self.x_all,
+                self.y_all,
+                perm,
+                mask,
+                self._samples,
+                sel_idx,
+                module=self.module,
+                tx=self.tx,
+                agg=self.aggregator,
+                trim=self.trim,
+                clip_tau=self.clip_tau,
+                out_sharding=self._shard,
+                keep_opt_state=self.keep_opt_state,
+                remat=self.remat,
+                x_test=self.x_test if eval else None,
+                y_test=self.y_test if eval else None,
+                dp_keys=self._dp_round_keys(),
+                **self._algo_kwargs(self._server_t + 1 if self.server_opt else 0),
+            )
+        except Exception:
+            self._recover_donated_state()
+            raise
         self.params, self.opt_state, loss = result[:3]
         i = 3
         if self.scaffold:
@@ -954,12 +1052,24 @@ class SpmdFederation:
         federation still needs. Medians over ``iters`` calls. Sets
         ``self.last_profile`` and returns it.
         """
+        rng_state = self._rng.bit_generator.state
+        try:
+            profile = self._profile_round_body(epochs, iters)
+        finally:
+            # restored on EVERY exit, including a failed probe dispatch:
+            # profiling must never perturb the federation's round stream
+            # (the pre-fix path skipped the restore when a probe raised,
+            # silently desynchronizing every later perm draw)
+            self._rng.bit_generator.state = rng_state
+        self.last_profile = profile
+        return profile
+
+    def _profile_round_body(self, epochs: int, iters: int) -> dict:
         import time
 
         from p2pfl_tpu.management.profiling import force_execution
 
-        rng_state = self._rng.bit_generator.state  # restored below: profiling
-        perm = self._make_perm(epochs)  # must not perturb the round stream
+        perm = self._make_perm(epochs)
         eff = self._effective_mask()
         mask = jax.device_put(jnp.asarray(eff), self._shard)
         sel_idx = jax.device_put(np.flatnonzero(eff).astype(np.int32), self._repl)
@@ -1029,15 +1139,43 @@ class SpmdFederation:
             ts.append(time.monotonic() - t0)
         t_agg = sorted(ts)[len(ts) // 2]
 
-        self._rng.bit_generator.state = rng_state
-        self.last_profile = {
+        return {
             "total_s": round(t_total, 4),
             "train_s": round(t_train, 4),
             "correction_s": round(max(t_total - t_train, 0.0), 4),
             "aggregate_s": round(t_agg, 4),
             "overhead_x": round(t_total / t_train, 2) if t_train > 0 else None,
         }
-        return self.last_profile
+
+    def _recover_donated_state(self) -> None:
+        """Failed round dispatch: drop and rebuild any consumed donated state.
+
+        ``spmd_round`` / ``spmd_rounds_fused`` donate params, opt state and
+        the SCAFFOLD/FedOpt carries. A dispatch that dies mid-execution may
+        already have consumed those buffers — leaving them in place poisons
+        EVERY later round with "array has been deleted" deep inside jit
+        argument processing (the exact failure mode PR 4 fixed for the
+        encode path's EF store). Same remedy: drop and rebuild. Rebuilt
+        state is the round-0 init (the consumed training progress is gone
+        with the buffers — recorded loudly), which keeps the federation
+        object usable for a retry/diagnosis instead of bricked.
+        """
+        from p2pfl_tpu.management.logger import logger
+
+        donated = [self.params, self.opt_state]
+        if self.scaffold:
+            donated += [self.c_global, self.c_local]
+        if self.server_opt:
+            donated += [self.opt_m, self.opt_v]
+        if not any(tree_has_deleted(t) for t in donated):
+            return
+        logger.warning(
+            "spmd",
+            "Round dispatch failed after consuming donated buffers — "
+            "rebuilding federation state from the round-0 init (training "
+            "progress in the consumed buffers is lost)",
+        )
+        self._stage_state()
 
     def run(self, rounds: int, epochs: int = 1, eval_every: int = 0) -> list[dict]:
         for r in range(rounds):
@@ -1081,17 +1219,21 @@ class SpmdFederation:
         is computed on-device and returned in the history entries.
         """
         perms, mask, sel_idx = self._fused_inputs(rounds, epochs)
-        result = spmd_rounds_fused(
-            self.params, self.opt_state, self.x_all, self.y_all, perms, mask,
-            self._samples, sel_idx,
-            module=self.module, tx=self.tx, agg=self.aggregator, trim=self.trim, clip_tau=self.clip_tau,
-            out_sharding=self._shard, keep_opt_state=self.keep_opt_state,
-            remat=self.remat,
-            x_test=self.x_test if eval else None,
-            y_test=self.y_test if eval else None,
-            dp_keys=self._dp_round_keys(rounds),
-            **self._algo_kwargs(self._server_t),
-        )
+        try:
+            result = spmd_rounds_fused(
+                self.params, self.opt_state, self.x_all, self.y_all, perms, mask,
+                self._samples, sel_idx,
+                module=self.module, tx=self.tx, agg=self.aggregator, trim=self.trim, clip_tau=self.clip_tau,
+                out_sharding=self._shard, keep_opt_state=self.keep_opt_state,
+                remat=self.remat,
+                x_test=self.x_test if eval else None,
+                y_test=self.y_test if eval else None,
+                dp_keys=self._dp_round_keys(rounds),
+                **self._algo_kwargs(self._server_t),
+            )
+        except Exception:
+            self._recover_donated_state()
+            raise
         self.params, self.opt_state, losses = result[:3]
         i = 3
         if self.scaffold:

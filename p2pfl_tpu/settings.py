@@ -8,6 +8,8 @@ reference find what they expect; TPU-specific knobs are added at the bottom.
 
 from __future__ import annotations
 
+from typing import Optional
+
 
 class Settings:
     """Mutable global settings (class attributes, no instances needed)."""
@@ -147,7 +149,24 @@ class Settings:
     # path (bit-format-compatible baseline — one decoder decodes both).
     # The decode side mirrors it: a device-resident anchor is updated by a
     # fused scatter-add instead of a host ravel-copy.
-    WIRE_COMPRESSION_DEVICE: bool = True
+    #
+    # None (the default) auto-selects by backend: the device producer on
+    # accelerators (where eliminating the D2H pull is the point), the host
+    # producer on XLA:CPU (where "device" is the same host and XLA's exact
+    # TopK loses wall-clock to numpy's introselect — the PR-4 measurement).
+    # An explicit True/False overrides the auto-select either way; read the
+    # resolved value through :func:`wire_compression_device`.
+    WIRE_COMPRESSION_DEVICE: Optional[bool] = None
+    # Fuse the overlay round's node compute (eval forward + local epochs +
+    # the node's own weighted fp32 partial-aggregation fold) into ONE
+    # donated jit dispatch per node per round (parallel/spmd.py
+    # fused_node_round, driven by JaxLearner.fused_round). The staged path
+    # (eval dispatch + one train dispatch per epoch + host-side metric
+    # syncs between them) is kept as the bit-parity baseline behind
+    # False — the same pattern as CHUNK_FUSED_REDUCE. Learners that
+    # cannot fuse (DummyLearner, LoRA, personalization, DP-SGD) fall back
+    # to the staged path automatically.
+    ROUND_FUSED: bool = True
     # Error feedback for topk8: dropped coordinates accumulate locally and
     # re-enter the next round's delta (Seide et al. 2014).
     TOPK_ERROR_FEEDBACK: bool = True
@@ -226,6 +245,25 @@ class Settings:
     SECAGG_DOUBLE_MASK: bool = True
 
 
+def wire_compression_device() -> bool:
+    """Resolve ``Settings.WIRE_COMPRESSION_DEVICE`` (None = by backend).
+
+    The auto-select encodes the PR-4 measurement: the fused device
+    producer exists to keep the full fp32 model + anchor pull off the
+    D2H link, which only pays on a real accelerator; on XLA:CPU the
+    "device" is the same host and its exact TopK (partial sort) loses
+    wall-clock to numpy's introselect, so the host producer wins there.
+    Both producers emit bit-layout-identical frames, so the auto-select
+    can never change what a receiver decodes — only who does the work.
+    """
+    explicit = Settings.WIRE_COMPRESSION_DEVICE
+    if explicit is not None:
+        return bool(explicit)
+    import jax
+
+    return jax.default_backend() != "cpu"
+
+
 def set_low_latency_settings() -> None:
     """Documented low-latency profile for reliable local networks.
 
@@ -293,7 +331,10 @@ def set_test_settings() -> None:
     Settings.TRAIN_SET_REPAIR = True
     Settings.EARLY_INIT_TTL = 15.0
     Settings.MEMORY_WIRE_CODEC = False
+    # explicit (not auto): tests exercise the device-producer code paths
+    # on whatever backend CI runs them on
     Settings.WIRE_COMPRESSION_DEVICE = True
+    Settings.ROUND_FUSED = True
     Settings.CHUNK_STAGING_DEPTH = 2
     Settings.CHUNK_FUSED_REDUCE = True
     Settings.CHUNK_DONATE_BUFFERS = True

@@ -23,6 +23,23 @@ if TYPE_CHECKING:
     from p2pfl_tpu.node import Node
 
 
+def broadcast_metrics(node: "Node", metrics: dict) -> None:
+    """The ONE builder of the ``metrics`` wire message.
+
+    Both publishers — the staged path's pre-train evaluate and the fused
+    round's batched flush — must emit provably identical messages, so the
+    flatten + ``build_msg`` lives exactly once.
+    """
+    if not metrics:
+        return
+    flat: list[str] = []
+    for k, v in metrics.items():
+        flat += [k, str(float(v))]
+    node.protocol.broadcast(
+        node.protocol.build_msg("metrics", flat, round=node.state.round or 0)
+    )
+
+
 class StartLearningStage(Stage):
     """Set up the experiment, synchronize initial weights across the overlay."""
 
@@ -38,6 +55,9 @@ class StartLearningStage(Stage):
         node.aggregator.reset_experiment()
         node.learner.set_epochs(node.epochs)
         node.learner.set_addr(node.addr)
+        # a metric stash left by an aborted round must not flush into THIS
+        # experiment's round 0 (fused path batches metrics per round)
+        node.learner.pop_round_metrics()
 
         if Settings.SECURE_AGGREGATION:
             from p2pfl_tpu.learning import secagg
@@ -252,18 +272,34 @@ class TrainStage(Stage):
             # this model instead of applying noise (GossipModelStage)
             node.round_start_params = node.learner.get_parameters()
 
-        # evaluate current model, share metrics (reference train_stage.py:59-60,95-112)
-        TrainStage._evaluate(node)
+        # local compute. Fused (Settings.ROUND_FUSED): eval + all local
+        # epochs + the node's own weighted fp32 partial fold run as ONE
+        # donated dispatch (parallel/spmd.py fused_node_round) — metrics
+        # come back as device scalars batched into RoundFinishedStage's
+        # single flush, and the own update below carries device-resident
+        # params + partial_acc, so nothing on the model plane syncs to
+        # host between here and the aggregate. Learners that cannot fuse
+        # (Dummy/LoRA/personalized, DP-SGD) return None and take the
+        # staged path — kept verbatim as the bit-parity baseline
+        # (tests/test_fused_round.py).
+        own = None
+        if Settings.ROUND_FUSED and not node.learning_interrupted():
+            own = node.learner.fused_round()
+        if own is None:
+            # evaluate current model, share metrics (reference train_stage.py:59-60,95-112)
+            TrainStage._evaluate(node)
+            if node.learning_interrupted():
+                return None
+
+            # local training — the hot loop; one jitted train step per batch
+            node.learner.fit()
+            if node.learning_interrupted():
+                return None
+
+            # contribute own model (masked when secure aggregation is on)
+            own = node.learner.get_model_update()
         if node.learning_interrupted():
             return None
-
-        # local training — the hot loop; one jitted train step per batch
-        node.learner.fit()
-        if node.learning_interrupted():
-            return None
-
-        # contribute own model (masked when secure aggregation is on)
-        own = node.learner.get_model_update()
         if (
             Settings.WIRE_COMPRESSION == "topk8"
             and Settings.TOPK_ERROR_FEEDBACK
@@ -365,14 +401,7 @@ class TrainStage(Stage):
 
     @staticmethod
     def _evaluate(node: "Node") -> None:
-        metrics = node.learner.evaluate()
-        if metrics:
-            flat: list[str] = []
-            for k, v in metrics.items():
-                flat += [k, str(float(v))]
-            node.protocol.broadcast(
-                node.protocol.build_msg("metrics", flat, round=node.state.round or 0)
-            )
+        broadcast_metrics(node, node.learner.evaluate())
 
     @staticmethod
     def _gossip_partial_aggregations(node: "Node") -> None:
@@ -938,11 +967,38 @@ class RoundFinishedStage(Stage):
     name = "RoundFinishedStage"
 
     @staticmethod
+    def _flush_round_metrics(node: "Node") -> None:
+        """Batched metric flush: the fused round's ONE host callback.
+
+        The staged path floats every metric where it is produced (an eval
+        sync before training, a ``float(loss)`` after every epoch); the
+        fused round instead stashes device scalars and this flush converts
+        and publishes them once per round — after aggregation already
+        forced the program, so the conversions are free. Mirrors the
+        staged path's observable behavior: the per-epoch ``train_loss``
+        series into the local metric store (same step numbers fit() would
+        log), eval metrics broadcast as the ``metrics`` message (peers log
+        them via ``MetricsCommand``), same round number.
+        """
+        metrics = node.learner.pop_round_metrics()
+        if not metrics:
+            return
+        series = metrics.pop("train_loss_series", None)
+        if series is not None:
+            import numpy as np
+
+            losses, steps = series
+            for step, loss in zip(steps, np.asarray(losses)):
+                logger.log_metric(node.addr, "train_loss", float(loss), step=step)
+        broadcast_metrics(node, metrics)
+
+    @staticmethod
     def execute(node: "Node") -> Optional[Type[Stage]]:
         state = node.state
         if node.learning_interrupted():
             logger.info(node.addr, "Early stopping.")
             return None
+        RoundFinishedStage._flush_round_metrics(node)
         node.aggregator.clear()
         state.increase_round()
         # round boundary: the just-diffused aggregate is the next round's

@@ -19,40 +19,65 @@ class LearningWorkflow:
         from p2pfl_tpu.communication.faults import FaultCrash
         from p2pfl_tpu.stages.learning_stages import StartLearningStage
 
-        stage = StartLearningStage
-        while stage is not None:
-            logger.debug(node.addr, f"── stage: {stage.name}")
-            # stall-watchdog instrumentation (management/watchdog.py)
-            node.state.current_stage = stage.name
-            node.state.last_transition = time.monotonic()
+        def flush_pending_metrics() -> None:
+            # a round that trained but never reached RoundFinishedStage
+            # (interrupt mid-gossip, stage failure) must not silently drop
+            # its already-computed metrics — the staged path would have
+            # logged/broadcast them inside TrainStage. Best-effort: the
+            # transport may already be stopping. MUST run before
+            # state.clear() so the metrics keep their experiment identity.
             try:
-                # crash-at-stage seam (communication/faults.py): hooks run on
-                # every transition and may raise FaultCrash to kill the node
-                for hook in node.stage_hooks:
-                    hook(node, stage.name)
-                stage = stage.execute(node)
-            except FaultCrash as exc:
-                # injected hard crash: the node is already torn down with no
-                # goodbyes; just stop executing, like a killed process
-                logger.info(node.addr, f"{exc}")
-                return
-            except Exception as exc:  # noqa: BLE001 — stage failure ends learning, not the node
-                if node.learning_interrupted():
-                    logger.info(node.addr, f"Learning interrupted during {stage.name}")
-                else:
-                    logger.error(node.addr, f"Stage {stage.name} failed: {exc!r}")
-                    # a failed stage must not leave experiment state latched:
-                    # the monotone control-plane merges (commands/control.py)
-                    # assume nei_status/models_aggregated reset at experiment
-                    # boundaries, and a stale "peer is at round N" entry would
-                    # exclude that peer from the next experiment's diffusion
-                    # forever (interrupt path already clears via _stop_learning)
-                    node.state.clear()
-                    # same for the aggregator: a stage that died between
-                    # set_nodes_to_aggregate() and the aggregation resolving
-                    # leaves _complete cleared, and the NEXT experiment's
-                    # set_nodes_to_aggregate would raise "already in
-                    # progress" — failing every subsequent experiment one
-                    # stage in until an explicit stop_learning
-                    node.aggregator.clear()
-                return
+                from p2pfl_tpu.stages.learning_stages import RoundFinishedStage
+
+                RoundFinishedStage._flush_round_metrics(node)
+            except Exception:  # noqa: BLE001 — abort-path flush never masks the exit
+                pass
+
+        stage = StartLearningStage
+        try:
+            while stage is not None:
+                logger.debug(node.addr, f"── stage: {stage.name}")
+                # stall-watchdog instrumentation (management/watchdog.py)
+                node.state.current_stage = stage.name
+                node.state.last_transition = time.monotonic()
+                try:
+                    # crash-at-stage seam (communication/faults.py): hooks run on
+                    # every transition and may raise FaultCrash to kill the node
+                    for hook in node.stage_hooks:
+                        hook(node, stage.name)
+                    stage = stage.execute(node)
+                except FaultCrash as exc:
+                    # injected hard crash: the node is already torn down with no
+                    # goodbyes; just stop executing, like a killed process —
+                    # including the pending metric stash (a dead process
+                    # publishes nothing)
+                    if node.learner is not None:
+                        node.learner.pop_round_metrics()
+                    logger.info(node.addr, f"{exc}")
+                    return
+                except Exception as exc:  # noqa: BLE001 — stage failure ends learning, not the node
+                    flush_pending_metrics()
+                    if node.learning_interrupted():
+                        logger.info(node.addr, f"Learning interrupted during {stage.name}")
+                    else:
+                        logger.error(node.addr, f"Stage {stage.name} failed: {exc!r}")
+                        # a failed stage must not leave experiment state latched:
+                        # the monotone control-plane merges (commands/control.py)
+                        # assume nei_status/models_aggregated reset at experiment
+                        # boundaries, and a stale "peer is at round N" entry would
+                        # exclude that peer from the next experiment's diffusion
+                        # forever (interrupt path already clears via _stop_learning)
+                        node.state.clear()
+                        # same for the aggregator: a stage that died between
+                        # set_nodes_to_aggregate() and the aggregation resolving
+                        # leaves _complete cleared, and the NEXT experiment's
+                        # set_nodes_to_aggregate would raise "already in
+                        # progress" — failing every subsequent experiment one
+                        # stage in until an explicit stop_learning
+                        node.aggregator.clear()
+                    return
+        finally:
+            # covers the remaining exits: a stage returning None mid-round
+            # (interrupt during gossip/diffusion). No-op when a flush
+            # already ran — the stash pops on read.
+            flush_pending_metrics()
