@@ -234,7 +234,10 @@ def run_federation(
         # worst case the fan-out is built for
         Settings.TRAIN_SET_SIZE = train_set_size
     MemoryRegistry.reset()
-    logger.reset_comm_metrics()
+    # atomic snapshot_and_reset (not the old get+reset pair): counters a
+    # previous scenario's still-draining threads land between the two
+    # calls can no longer leak into this scenario's window
+    logger.snapshot_and_reset_comm_metrics()
 
     if model_name == "transformer":
         full = FederatedDataset.synthetic_lm(
@@ -274,7 +277,10 @@ def run_federation(
         wait_to_finish(nodes[:-1] if slow_peer_delay > 0 else nodes, timeout=300)
         wall_s = time.perf_counter() - t0
         encodes = W.encode_call_count() - encodes_before
-        comm = logger.get_comm_metrics()
+        # harvest atomically: the federation's heartbeat/gossip threads are
+        # still incrementing — a get+reset pair here would lose whatever
+        # lands in the gap (and double-count it into the next scenario)
+        comm = logger.snapshot_and_reset_comm_metrics()
 
         def total(metric):
             return int(sum(m.get(metric, 0) for m in comm.values()))

@@ -152,9 +152,8 @@ def config1_mnist_2node() -> None:
     JaxLearner.evaluate = timed("eval_s", JaxLearner.evaluate)
 
     from p2pfl_tpu.management.profiling import (
-        get_dispatch_counts,
         mfu,
-        reset_dispatch_counts,
+        snapshot_and_reset_dispatch_counts,
     )
     from p2pfl_tpu.settings import Settings
 
@@ -162,17 +161,21 @@ def config1_mnist_2node() -> None:
     full = FederatedDataset.synthetic_mnist(n_train=4096, n_test=1024)
     n_nodes = 2
 
-    def run_overlay(rounds: int, epochs: int, fused: bool) -> dict:
+    def run_overlay(rounds: int, epochs: int, fused: bool, telemetry_on: bool = True) -> dict:
         """One fresh 2-node federation; returns sec/round + dispatch split.
 
         ``dispatches_per_round`` counts MODEL-PLANE device dispatches per
         node per round (management/profiling.py record_dispatch sites:
         eval/train/fused-round programs + aggregate kernels), excluding
         the per-node experiment-end evaluation which is outside the round
-        loop on both paths.
+        loop on both paths. ``telemetry_on=False`` disables the flight
+        recorder (ISSUE 7 overhead split — counters stay on either way,
+        so the dispatch accounting is unaffected).
         """
         prev = Settings.ROUND_FUSED
+        prev_telemetry = Settings.TELEMETRY_ENABLED
         Settings.ROUND_FUSED = fused
+        Settings.TELEMETRY_ENABLED = telemetry_on
         nodes = []
         try:
             # compile warm-up OUTSIDE the timer: the mode's round programs
@@ -193,13 +196,15 @@ def config1_mnist_2node() -> None:
                 nodes.append(n)
             nodes[0].connect(nodes[1].addr)
             time.sleep(0.5)
-            reset_dispatch_counts()
+            snapshot_and_reset_dispatch_counts()  # atomic clear of warm-up counts
             acc_before = dict(acc)  # primitive-timing snapshot (breakdown
             t0 = time.monotonic()   # must exclude warm-up and final eval)
             nodes[0].set_start_learning(rounds=rounds, epochs=epochs)
             wait_to_finish(nodes, timeout=300)
             elapsed = time.monotonic() - t0
-            counts = get_dispatch_counts()
+            # atomic harvest: the nodes' threads are still live here — a
+            # get+reset pair would lose dispatches landing in the gap
+            counts = snapshot_and_reset_dispatch_counts()
             run_breakdown = {
                 k: round(v - acc_before.get(k, 0.0), 2)
                 for k, v in sorted(acc.items())
@@ -208,6 +213,7 @@ def config1_mnist_2node() -> None:
             final_acc = nodes[0].learner.evaluate()["test_acc"]
         finally:
             Settings.ROUND_FUSED = prev
+            Settings.TELEMETRY_ENABLED = prev_telemetry
             for n in nodes:
                 n.stop()
         in_round = sum(counts.values()) - n_nodes  # minus experiment-end evals
@@ -234,6 +240,21 @@ def config1_mnist_2node() -> None:
     split_epochs = 5
     staged5 = run_overlay(rounds, epochs=split_epochs, fused=False)
     fused5 = run_overlay(rounds, epochs=split_epochs, fused=True)
+
+    # ISSUE 7 overhead split: the flight recorder (stage/gossip/dispatch
+    # spans, wire trace ctx, per-span histogram feed) must stay ≤5% on
+    # this round loop. Longer runs than the headline pair because the
+    # on/off delta is small against protocol-tick noise; the headline
+    # value above already INCLUDES telemetry (it is on by default).
+    tel_rounds = 6
+    tel_on = run_overlay(tel_rounds, epochs=1, fused=True)
+    tel_off = run_overlay(tel_rounds, epochs=1, fused=True, telemetry_on=False)
+    telemetry_overhead_pct = round(
+        (tel_on["sec_per_round"] - tel_off["sec_per_round"])
+        / tel_off["sec_per_round"]
+        * 100,
+        2,
+    )
 
     # model FLOPs of one overlay round (all nodes, scan-free single-step
     # probe x steps — the same scan-trip-count correction every SPMD
@@ -301,6 +322,15 @@ def config1_mnist_2node() -> None:
         },
         "flops_per_round_overlay": flops_round,
         "overlay_mfu": round(overlay_mfu, 4) if overlay_mfu is not None else None,
+        # ISSUE 7 acceptance row: flight-recorder overhead on the fused
+        # round loop (spans + wire trace ctx + histograms vs all off)
+        "telemetry": {
+            "on_sec_per_round": tel_on["sec_per_round"],
+            "off_sec_per_round": tel_off["sec_per_round"],
+            "overhead_pct": telemetry_overhead_pct,
+            "budget_pct": 5.0,
+            "rounds": tel_rounds,
+        },
     })
 
 

@@ -52,6 +52,7 @@ from typing import TYPE_CHECKING, Callable, Iterable, Optional
 
 from p2pfl_tpu.communication.message import Message, WeightsEnvelope
 from p2pfl_tpu.management.logger import logger
+from p2pfl_tpu.management.telemetry import telemetry
 
 if TYPE_CHECKING:
     from p2pfl_tpu.node import Node
@@ -170,8 +171,15 @@ class FaultInjector:
         transport_send: Callable[..., bool],
     ) -> bool:
         plan = self.plan
+        # every verdict is also a flight-recorder event: the injector runs
+        # INSIDE the protocol's send span, so each event lands on the
+        # affected edge's timeline and chaos runs are self-explaining
+        cmd = getattr(env, "cmd", "?")
         if plan.partitioned(self.src, nei):
             logger.log_comm_metric(self.src, "fault_partition_drop")
+            telemetry.event(
+                self.src, "fault_partition_drop", attrs={"peer": nei, "cmd": cmd}
+            )
             return False
         # straggler latency: every inbound WEIGHTS delivery to a slow node
         # pays it (its control plane stays healthy — that asymmetry, a fat
@@ -179,6 +187,9 @@ class FaultInjector:
         # send budget and the stall machinery exist for)
         slow = plan.slow_nodes.get(nei, 0.0)
         if slow and isinstance(env, WeightsEnvelope):
+            telemetry.event(
+                self.src, "fault_slow", attrs={"peer": nei, "delay_s": slow}
+            )
             time.sleep(slow)
         fault = plan.edge_fault(self.src, nei)
         if not fault.applies_to(env):
@@ -190,13 +201,18 @@ class FaultInjector:
         drop_u, dup_u, jitter_u = rng.random(), rng.random(), rng.random()
         if fault.drop and drop_u < fault.drop:
             logger.log_comm_metric(self.src, "fault_drop")
+            telemetry.event(self.src, "fault_drop", attrs={"peer": nei, "cmd": cmd})
             return False
         d = fault.delay + jitter_u * fault.jitter
         if d > 0:
+            telemetry.event(
+                self.src, "fault_delay", attrs={"peer": nei, "delay_s": round(d, 4)}
+            )
             time.sleep(d)
         ok = transport_send(nei, env, create_connection=create_connection)
         if ok and fault.duplicate and dup_u < fault.duplicate:
             logger.log_comm_metric(self.src, "fault_duplicate")
+            telemetry.event(self.src, "fault_duplicate", attrs={"peer": nei, "cmd": cmd})
             copy = _stale_copy(env)
             t = threading.Timer(
                 max(fault.duplicate_delay, 0.001),
@@ -217,7 +233,9 @@ def _stale_copy(env: object) -> object:
     must not re-amplify. Weights envelopes replay verbatim.
     """
     if isinstance(env, Message):
-        return Message(env.source, env.cmd, env.args, env.round, ttl=1)
+        return Message(
+            env.source, env.cmd, env.args, env.round, ttl=1, trace_ctx=env.trace_ctx
+        )
     return env
 
 
@@ -242,6 +260,12 @@ def hard_crash(node: "Node") -> None:
     """
     logger.warning(node.addr, "FAULT: hard crash injected")
     logger.log_comm_metric(node.addr, "fault_crash")
+    telemetry.event(
+        node.addr,
+        "fault_crash",
+        attrs={"stage": getattr(node.state, "current_stage", None),
+               "round": getattr(node.state, "round", None)},
+    )
     node._interrupt.set()
     if node.learner is not None:
         try:

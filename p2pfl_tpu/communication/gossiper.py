@@ -380,18 +380,35 @@ class Gossiper:
         exactly the failure windows that matter (the failed send still
         fed the breaker).
         """
+        from p2pfl_tpu.management.telemetry import telemetry
+
         if msg.cmd == BEAT_CMD:
             return
         if attempt > Settings.MESSAGE_RETRY_MAX:
             logger.log_comm_metric(self.self_addr, "msg_retry_exhausted")
+            telemetry.event(
+                self.self_addr,
+                "retry_exhausted",
+                kind="retry",
+                attrs={"peer": nei, "cmd": msg.cmd},
+            )
             logger.debug(
                 self.self_addr,
                 f"Dropping '{msg.cmd}' for {nei} after "
                 f"{Settings.MESSAGE_RETRY_MAX} retries",
             )
             return
-        due = time.monotonic() + retry_delay(attempt)
+        delay = retry_delay(attempt)
+        due = time.monotonic() + delay
         logger.log_comm_metric(self.self_addr, "msg_retry_scheduled")
+        # retry-plane event: the RoundReport sums delay_s per peer into the
+        # round's retry/backoff-wait attribution
+        telemetry.event(
+            self.self_addr,
+            "retry_scheduled",
+            kind="retry",
+            attrs={"peer": nei, "cmd": msg.cmd, "attempt": attempt, "delay_s": round(delay, 4)},
+        )
         with self._queue_cv:
             heapq.heappush(self._retries, (due, next(self._retry_seq), attempt, nei, msg))
             self._queue_cv.notify()

@@ -57,37 +57,50 @@ _METHODS = ("handshake", "disconnect", "send_message", "send_weights")
 
 
 def encode_message(msg: Message) -> bytes:
-    return json.dumps(
-        {
-            "src": msg.source,
-            "cmd": msg.cmd,
-            "args": list(msg.args),
-            "round": msg.round,
-            "ttl": msg.ttl,
-            "id": msg.msg_id,
-        }
-    ).encode()
+    d = {
+        "src": msg.source,
+        "cmd": msg.cmd,
+        "args": list(msg.args),
+        "round": msg.round,
+        "ttl": msg.ttl,
+        "id": msg.msg_id,
+    }
+    if msg.trace_ctx is not None:
+        # flight-recorder trace context (management/telemetry.py): optional
+        # key — absent on old senders, ignored by old receivers, so both
+        # wire directions stay compatible with pre-telemetry frames
+        d["tc"] = list(msg.trace_ctx)
+    return json.dumps(d).encode()
+
+
+def _trace_ctx(d: dict):
+    tc = d.get("tc")
+    return (str(tc[0]), str(tc[1])) if tc else None
 
 
 def decode_message(data: bytes) -> Message:
     d = json.loads(data.decode())
-    return Message(d["src"], d["cmd"], tuple(d["args"]), d["round"], d["ttl"], d["id"])
+    return Message(
+        d["src"], d["cmd"], tuple(d["args"]), d["round"], d["ttl"], d["id"],
+        trace_ctx=_trace_ctx(d),
+    )
 
 
 def encode_weights(env: WeightsEnvelope) -> bytes:
     # update.encode() is served by the encode-once payload cache while the
     # sender's model version is unchanged (learning/weights.py) — only this
     # small envelope header is built per send
-    header = json.dumps(
-        {
-            "src": env.source,
-            "round": env.round,
-            "cmd": env.cmd,
-            "contributors": env.update.contributors,
-            "num_samples": env.update.num_samples,
-            "id": env.msg_id,
-        }
-    ).encode()
+    d = {
+        "src": env.source,
+        "round": env.round,
+        "cmd": env.cmd,
+        "contributors": env.update.contributors,
+        "num_samples": env.update.num_samples,
+        "id": env.msg_id,
+    }
+    if env.trace_ctx is not None:
+        d["tc"] = list(env.trace_ctx)  # optional — see encode_message
+    header = json.dumps(d).encode()
     return b"".join((len(header).to_bytes(4, "little"), header, env.update.encode()))
 
 
@@ -100,7 +113,9 @@ def decode_weights(data: bytes) -> WeightsEnvelope:
         num_samples=int(d["num_samples"]),
         encoded=data[4 + hlen :],
     )
-    return WeightsEnvelope(d["src"], d["round"], d["cmd"], update, d["id"])
+    return WeightsEnvelope(
+        d["src"], d["round"], d["cmd"], update, d["id"], trace_ctx=_trace_ctx(d)
+    )
 
 
 def _reply(ok: bool, error: str = "") -> bytes:

@@ -130,7 +130,10 @@ class InMemoryProtocol(CommunicationProtocol):
                         num_samples=env.update.num_samples,
                         encoded=env.update.encode(),
                     )
-                    env = WeightsEnvelope(env.source, env.round, env.cmd, wire, env.msg_id)
+                    env = WeightsEnvelope(
+                        env.source, env.round, env.cmd, wire, env.msg_id,
+                        trace_ctx=env.trace_ctx,
+                    )
                 return peer.handle_weights(env).ok
             if isinstance(env, Message):
                 return peer.handle_message(env).ok

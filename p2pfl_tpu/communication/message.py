@@ -36,7 +36,15 @@ def _message_id(source: str, cmd: str, args: tuple[str, ...]) -> str:
 
 @dataclass
 class Message:
-    """A small control-plane message (vote, beat, round status, ...)."""
+    """A small control-plane message (vote, beat, round status, ...).
+
+    ``trace_ctx`` is the flight recorder's wire-propagated trace context
+    (``(trace_id, parent_span_id)`` — ``management/telemetry.py``),
+    stamped by ``protocol.build_msg`` from the sender's active span so the
+    receiver's dispatch span joins the sender's causal tree. Optional end
+    to end: ``None`` is never serialized, and a frame without the field
+    decodes exactly as before.
+    """
 
     source: str
     cmd: str
@@ -44,6 +52,7 @@ class Message:
     round: int = -1
     ttl: int = 1
     msg_id: str = ""
+    trace_ctx: Optional[tuple[str, str]] = None
 
     def __post_init__(self) -> None:
         self.args = tuple(str(a) for a in self.args)
@@ -57,6 +66,8 @@ class WeightsEnvelope:
 
     ``update`` may hold a live pytree (in-process transports — zero copy,
     device-resident) or only ``update.encoded`` bytes (network transports).
+    ``trace_ctx`` carries the sender's trace context exactly like
+    :class:`Message` (stamped by ``protocol.build_weights``).
     """
 
     source: str
@@ -64,6 +75,7 @@ class WeightsEnvelope:
     cmd: str  # "init_model" | "add_model"
     update: ModelUpdate
     msg_id: str = field(default="")
+    trace_ctx: Optional[tuple[str, str]] = None
 
     def __post_init__(self) -> None:
         if not self.msg_id:

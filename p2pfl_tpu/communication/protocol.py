@@ -12,6 +12,7 @@ neighbors manager.
 
 from __future__ import annotations
 
+import contextlib
 import random
 import threading
 from abc import ABC, abstractmethod
@@ -24,6 +25,7 @@ from p2pfl_tpu.communication.neighbors import Neighbors
 from p2pfl_tpu.communication.reliability import CircuitBreaker
 from p2pfl_tpu.learning.weights import ModelUpdate
 from p2pfl_tpu.management.logger import logger
+from p2pfl_tpu.management.telemetry import telemetry
 
 
 class CommunicationProtocol(ABC):
@@ -97,7 +99,20 @@ class CommunicationProtocol(ABC):
     def build_msg(self, cmd: str, args: Optional[list[str]] = None, round: int = -1) -> Message:
         from p2pfl_tpu.settings import Settings
 
-        return Message(self._address, cmd, tuple(args or ()), round, ttl=Settings.TTL)
+        # flight recorder: outgoing envelopes are stamped with the BUILDING
+        # thread's trace context (usually a stage span on the learning
+        # thread) — the seam where causality is still known; the worker
+        # threads that later transmit the envelope have no context of
+        # their own, and the same Message object is shared across a whole
+        # broadcast, so per-send mutation would race
+        return Message(
+            self._address,
+            cmd,
+            tuple(args or ()),
+            round,
+            ttl=Settings.TTL,
+            trace_ctx=telemetry.current_ctx(),
+        )
 
     def build_weights(
         self, cmd: str, round: int, update: ModelUpdate
@@ -106,14 +121,43 @@ class CommunicationProtocol(ABC):
         # byte transports then reuse the encode across candidates and ticks
         # for as long as the learner's model version is unchanged
         update.cache_round = round
-        return WeightsEnvelope(self._address, round, cmd, update)
+        return WeightsEnvelope(
+            self._address, round, cmd, update, trace_ctx=telemetry.current_ctx()
+        )
 
     # ---- sending ----
 
     def _do_send(self, nei: str, env, create_connection: bool = False) -> bool:
         """Transport send behind the fault-injection seam — EVERY outgoing
         envelope (both gossip planes, direct sends, broadcasts) passes
-        through here, so a chaos plan sees all of them."""
+        through here, so a chaos plan sees all of them — and behind the
+        flight recorder's send span: one ``send:<cmd>`` span per attempt,
+        parented to the envelope's wire trace context, with the outcome
+        and peer in its attrs (the RoundReport's edge attribution reads
+        exactly these). Beats are span-exempt by default
+        (``Settings.TELEMETRY_BEAT_SPANS``) — they flood at
+        1/HEARTBEAT_PERIOD per neighbor and would drown the ring."""
+        from p2pfl_tpu.settings import Settings
+
+        cmd = getattr(env, "cmd", "?")
+        if not telemetry.enabled() or (
+            cmd == "beat" and not Settings.TELEMETRY_BEAT_SPANS
+        ):
+            return self._transport_send(nei, env, create_connection)
+        is_weights = isinstance(env, WeightsEnvelope)
+        with telemetry.span(
+            self._address,
+            f"send:{cmd}",
+            kind="heartbeat" if cmd == "beat" else "gossip",
+            parent=getattr(env, "trace_ctx", None),
+            attrs={"peer": nei, "plane": "weights" if is_weights else "control"},
+        ) as sp:
+            ok = self._transport_send(nei, env, create_connection)
+            if sp is not None:
+                sp.attrs["ok"] = bool(ok)
+        return ok
+
+    def _transport_send(self, nei: str, env, create_connection: bool) -> bool:
         fi = self.fault_injector
         if fi is not None:
             return fi(nei, env, create_connection, self._send_to_neighbor)
@@ -163,6 +207,12 @@ class CommunicationProtocol(ABC):
 
     def _neighbor_evicted(self, addr: str) -> None:
         logger.log_comm_metric(self._address, "neighbor_evicted")
+        # eviction transition on the flight-recorder timeline: every
+        # eviction path (stale beats, breaker suspect fast path, one-way
+        # partition) funnels through here
+        telemetry.event(
+            self._address, "neighbor_evicted", kind="fault", attrs={"peer": addr}
+        )
         self.breaker.forget(addr)
         for fn in self._evict_listeners:
             try:
@@ -215,17 +265,32 @@ class CommunicationProtocol(ABC):
         if not self.gossiper.check_and_set_processed(msg.msg_id):
             return CommandResult(ok=True)  # duplicate — already handled
         if msg.ttl > 1:
-            relay = Message(msg.source, msg.cmd, msg.args, msg.round, msg.ttl - 1, msg.msg_id)
+            # the relay keeps the ORIGIN's trace context: every hop of a
+            # TTL flood stays one causal tree rooted at the first sender
+            relay = Message(
+                msg.source, msg.cmd, msg.args, msg.round, msg.ttl - 1, msg.msg_id,
+                trace_ctx=msg.trace_ctx,
+            )
             pending = [n for n in self.neighbors.get_all(only_direct=True) if n != msg.source]
             self.gossiper.add_message(relay, pending)
-        return self._dispatch(msg.cmd, msg.source, msg.round, list(msg.args), None)
+        return self._dispatch(
+            msg.cmd, msg.source, msg.round, list(msg.args), None, trace_ctx=msg.trace_ctx
+        )
 
     def handle_weights(self, env: WeightsEnvelope) -> CommandResult:
         """Data-plane receive: direct dispatch, no TTL/dedup (``grpc_server.py:168-197``)."""
-        return self._dispatch(env.cmd, env.source, env.round, [], env.update)
+        return self._dispatch(
+            env.cmd, env.source, env.round, [], env.update, trace_ctx=env.trace_ctx
+        )
 
     def _dispatch(
-        self, cmd: str, source: str, round: int, args: list[str], update: Optional[ModelUpdate]
+        self,
+        cmd: str,
+        source: str,
+        round: int,
+        args: list[str],
+        update: Optional[ModelUpdate],
+        trace_ctx: Optional[tuple[str, str]] = None,
     ) -> CommandResult:
         from p2pfl_tpu.settings import Settings
 
@@ -237,11 +302,25 @@ class CommunicationProtocol(ABC):
         if handler is None:
             logger.error(self._address, f"Unknown command '{cmd}' from {source}")
             return CommandResult(ok=False, error=f"unknown command {cmd}")
+        # the receiver's half of the wire-propagated causal edge: a
+        # recv:<cmd> span parented to the SENDER's span via trace_ctx, so
+        # the round's tree crosses nodes; beats span-exempt as on send
+        if cmd != "beat" or Settings.TELEMETRY_BEAT_SPANS:
+            span_cm = telemetry.span(
+                self._address,
+                f"recv:{cmd}",
+                kind="heartbeat" if cmd == "beat" else "gossip",
+                parent=trace_ctx,
+                attrs={"src": source, "round": round},
+            )
+        else:
+            span_cm = contextlib.nullcontext()
         try:
-            if update is not None:
-                handler.execute(source, round, update=update)
-            else:
-                handler.execute(source, round, *args)
+            with span_cm:
+                if update is not None:
+                    handler.execute(source, round, update=update)
+                else:
+                    handler.execute(source, round, *args)
             return CommandResult(ok=True)
         except Exception as exc:  # noqa: BLE001 — commands must not kill the server thread
             logger.error(self._address, f"Error executing {cmd} from {source}: {exc!r}")

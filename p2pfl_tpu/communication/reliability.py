@@ -73,6 +73,11 @@ class CircuitBreaker:
                 self._last_failure.pop(nei, None)
                 if self._suspect_since.pop(nei, None) is not None:
                     logger.log_comm_metric(self.self_addr, "breaker_close")
+                    from p2pfl_tpu.management.telemetry import telemetry
+
+                    telemetry.event(
+                        self.self_addr, "breaker_close", kind="fault", attrs={"peer": nei}
+                    )
                     logger.info(
                         self.self_addr,
                         f"Breaker closed for {nei} — send succeeded again",
@@ -84,6 +89,16 @@ class CircuitBreaker:
             if count >= Settings.BREAKER_THRESHOLD and nei not in self._suspect_since:
                 self._suspect_since[nei] = time.monotonic()
                 logger.log_comm_metric(self.self_addr, "breaker_open")
+                # flight-recorder event on the affected edge: inside a send
+                # span when the failing send is what tripped the breaker
+                from p2pfl_tpu.management.telemetry import telemetry
+
+                telemetry.event(
+                    self.self_addr,
+                    "breaker_open",
+                    kind="fault",
+                    attrs={"peer": nei, "failures": count},
+                )
                 logger.info(
                     self.self_addr,
                     f"Breaker open for {nei}: {count} consecutive send "

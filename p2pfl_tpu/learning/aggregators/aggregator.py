@@ -316,10 +316,11 @@ class Aggregator:
             waiting or not self.ALWAYS_AGGREGATE or len(models[0].contributors) > 1
         ):
             return self.on_result(models[0])
-        from p2pfl_tpu.management.profiling import record_dispatch
+        from p2pfl_tpu.management.profiling import dispatch_span
 
-        record_dispatch("aggregate", self.node_name)
-        return self._inherit_anchor(self.aggregate(models), models)
+        with dispatch_span("aggregate", self.node_name, n_models=len(models)):
+            result = self.aggregate(models)
+        return self._inherit_anchor(result, models)
 
     @staticmethod
     def _inherit_anchor(result: ModelUpdate, models: list[ModelUpdate]) -> ModelUpdate:
@@ -384,10 +385,11 @@ class Aggregator:
             gen = self._memo_gen
         if hit is not None:
             return hit
-        from p2pfl_tpu.management.profiling import record_dispatch
+        from p2pfl_tpu.management.profiling import dispatch_span
 
-        record_dispatch("aggregate", self.node_name)
-        result = self._inherit_anchor(self.aggregate(todo), todo)
+        with dispatch_span("aggregate", self.node_name, n_models=len(todo)):
+            aggregated = self.aggregate(todo)
+        result = self._inherit_anchor(aggregated, todo)
         with self._lock:
             if self._memo_gen == gen:  # collected set unchanged since read
                 self._partial_memo[memo_key] = result

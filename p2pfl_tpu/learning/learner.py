@@ -360,25 +360,26 @@ class JaxLearner(NodeLearner):
                 logger.info(self.addr, "Training interrupted")
                 return
             xs, ys = self.data.epoch_batches(self.batch_size, self._rng)
-            from p2pfl_tpu.management.profiling import record_dispatch
+            from p2pfl_tpu.management.profiling import dispatch_span
 
             if self.dp_clip > 0.0:
                 from p2pfl_tpu.learning.privacy import dp_train_epoch
 
                 key = jax.random.PRNGKey(int(self._rng.integers(2**31)))
-                self.params, self.opt_state, loss = dp_train_epoch(
-                    self.params, self.opt_state, jnp.asarray(xs), jnp.asarray(ys),
-                    key, self.model.module, self.tx, self.dp_clip, self.dp_noise,
-                    prox_mu=self.prox_mu, anchor=anchor,
-                )
+                with dispatch_span("train_epoch", self.addr, dp=True):
+                    self.params, self.opt_state, loss = dp_train_epoch(
+                        self.params, self.opt_state, jnp.asarray(xs), jnp.asarray(ys),
+                        key, self.model.module, self.tx, self.dp_clip, self.dp_noise,
+                        prox_mu=self.prox_mu, anchor=anchor,
+                    )
                 if self.accountant is not None:
                     self.accountant.step(xs.shape[0])
             else:
-                self.params, self.opt_state, loss = train_epoch(
-                    self.params, self.opt_state, jnp.asarray(xs), jnp.asarray(ys),
-                    self.model.module, self.tx, prox_mu=self.prox_mu, anchor=anchor,
-                )
-            record_dispatch("train_epoch", self.addr)
+                with dispatch_span("train_epoch", self.addr):
+                    self.params, self.opt_state, loss = train_epoch(
+                        self.params, self.opt_state, jnp.asarray(xs), jnp.asarray(ys),
+                        self.model.module, self.tx, prox_mu=self.prox_mu, anchor=anchor,
+                    )
             self._steps_done += xs.shape[0]
             logger.log_metric(self.addr, "train_loss", float(loss), step=self._steps_done)
 
@@ -405,7 +406,7 @@ class JaxLearner(NodeLearner):
         """
         if self.epochs == 0 or self.dp_clip > 0.0:
             return None
-        from p2pfl_tpu.management.profiling import record_dispatch
+        from p2pfl_tpu.management.profiling import dispatch_span
         from p2pfl_tpu.parallel.spmd import fused_node_round, tree_has_deleted
         from p2pfl_tpu.settings import Settings
 
@@ -430,20 +431,21 @@ class JaxLearner(NodeLearner):
         # would bypass the mask, so the fold is compiled out
         with_acc = not Settings.SECURE_AGGREGATION
         try:
-            out = fused_node_round(
-                self.params,
-                self.opt_state,
-                jnp.asarray(np.stack(xs_eps)),
-                jnp.asarray(np.stack(ys_eps)),
-                jnp.float32(float(self.get_num_samples())),
-                jnp.asarray(x_test) if has_eval else None,
-                jnp.asarray(y_test) if has_eval else None,
-                module=self.model.module,
-                tx=self.tx,
-                prox_mu=self.prox_mu,
-                with_acc=with_acc,
-                agg_dtype=Settings.AGG_DTYPE,
-            )
+            with dispatch_span("fused_round", self.addr, epochs=self.epochs):
+                out = fused_node_round(
+                    self.params,
+                    self.opt_state,
+                    jnp.asarray(np.stack(xs_eps)),
+                    jnp.asarray(np.stack(ys_eps)),
+                    jnp.float32(float(self.get_num_samples())),
+                    jnp.asarray(x_test) if has_eval else None,
+                    jnp.asarray(y_test) if has_eval else None,
+                    module=self.model.module,
+                    tx=self.tx,
+                    prox_mu=self.prox_mu,
+                    with_acc=with_acc,
+                    agg_dtype=Settings.AGG_DTYPE,
+                )
         except Exception as exc:  # noqa: BLE001 — degrade to staged, never poison
             self._rng.bit_generator.state = rng_state
             if tree_has_deleted(self.opt_state):
@@ -456,7 +458,6 @@ class JaxLearner(NodeLearner):
                 "rebuilt, falling back to the staged path",
             )
             return None
-        record_dispatch("fused_round", self.addr)
         self.params = out["params"]
         self.opt_state = out["opt_state"]
         self.bump_model_version()
@@ -487,10 +488,10 @@ class JaxLearner(NodeLearner):
         x, y = self.data.test_arrays()
         if len(y) == 0:
             return {}
-        from p2pfl_tpu.management.profiling import record_dispatch
+        from p2pfl_tpu.management.profiling import dispatch_span
 
-        loss, acc = eval_step(self.params, jnp.asarray(x), jnp.asarray(y), self.model.module)
-        record_dispatch("eval_step", self.addr)
+        with dispatch_span("eval_step", self.addr):
+            loss, acc = eval_step(self.params, jnp.asarray(x), jnp.asarray(y), self.model.module)
         return {"test_loss": float(loss), "test_acc": float(acc)}
 
     def get_num_samples(self) -> int:

@@ -90,12 +90,12 @@ class P2pflLogger:
         # hits/misses, send outcomes/timeouts, and the wire-codec byte
         # accounting — wire_raw_bytes vs wire_payload_bytes per node gives
         # the live compression ratio, wire_d2h_bytes the device→host
-        # traffic, wire_encode_device/host the producer split) — plain
-        # accumulators keyed (node, metric), incremented from gossip
-        # worker threads, so they need no experiment context unlike the
-        # two metric stores above
-        self._comm_metrics: Dict[str, Dict[str, float]] = {}
-        self._comm_lock = threading.Lock()
+        # traffic, wire_encode_device/host the producer split) — keyed
+        # (node, metric), incremented from gossip worker threads, so they
+        # need no experiment context unlike the two metric stores above.
+        # Since the flight recorder (management/telemetry.py) these live
+        # in the unified telemetry registry (counter group "comm"); the
+        # log_comm_metric/get_comm_metrics surface below is a thin view.
         # addr -> (node_state, simulation_flag)
         self._nodes: Dict[str, Tuple[Any, bool]] = {}
         self._nodes_lock = threading.Lock()
@@ -206,21 +206,30 @@ class P2pflLogger:
 
     def log_comm_metric(self, node: str, metric: str, value: float = 1.0) -> None:
         """Accumulate a communication counter (thread-safe, no experiment
-        context needed — callable from gossip/send worker threads)."""
-        with self._comm_lock:
-            per_node = self._comm_metrics.setdefault(node, {})
-            per_node[metric] = per_node.get(metric, 0.0) + value
+        context needed — callable from gossip/send worker threads). A thin
+        view over the telemetry registry's ``"comm"`` counter group."""
+        from p2pfl_tpu.management.telemetry import telemetry
+
+        telemetry.inc("comm", node, metric, value)
 
     def get_comm_metrics(self, node: Optional[str] = None) -> Dict:
         """Counter snapshot: one node's ``{metric: value}``, or all nodes'."""
-        with self._comm_lock:
-            if node is not None:
-                return dict(self._comm_metrics.get(node, {}))
-            return {n: dict(d) for n, d in self._comm_metrics.items()}
+        from p2pfl_tpu.management.telemetry import telemetry
+
+        return telemetry.counters("comm", node)
 
     def reset_comm_metrics(self) -> None:
-        with self._comm_lock:
-            self._comm_metrics.clear()
+        from p2pfl_tpu.management.telemetry import telemetry
+
+        telemetry.reset_counters("comm")
+
+    def snapshot_and_reset_comm_metrics(self, node: Optional[str] = None) -> Dict:
+        """Atomic read-and-clear of the comm counters: the ``get`` +
+        ``reset`` pair benches/tests used to run could lose increments
+        landing between the two calls — this cannot."""
+        from p2pfl_tpu.management.telemetry import telemetry
+
+        return telemetry.snapshot_and_reset("comm", node)
 
     # ---- node registry (reference logger.py:491-543) ----
 

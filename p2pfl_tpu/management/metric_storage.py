@@ -10,9 +10,10 @@ Reference: ``p2pfl/management/metric_storage.py:30-247``.
 
 from __future__ import annotations
 
+import bisect
 import copy
 import threading
-from typing import Dict, List, Tuple
+from typing import Dict, List, Set, Tuple
 
 LocalLogs = Dict[str, Dict[int, Dict[str, Dict[str, List[Tuple[int, float]]]]]]
 GlobalLogs = Dict[str, Dict[str, Dict[str, List[Tuple[int, float]]]]]
@@ -50,13 +51,20 @@ class GlobalMetricStorage:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._logs: GlobalLogs = {}
+        # per-series round membership: the dedup check is O(1) instead of
+        # a full scan, and insertion keeps the series sorted via
+        # bisect.insort instead of re-sorting the whole list per append —
+        # add_log used to be O(n) per call, quadratic over an experiment
+        self._rounds: Dict[Tuple[str, str, str], Set[int]] = {}
 
     def add_log(self, exp: str, rnd: int, metric: str, node: str, value: float) -> None:
         with self._lock:
+            seen = self._rounds.setdefault((exp, node, metric), set())
+            if rnd in seen:  # dedup by round, first write wins (reference 156-247)
+                return
+            seen.add(rnd)
             series = self._logs.setdefault(exp, {}).setdefault(node, {}).setdefault(metric, [])
-            if all(r != rnd for r, _ in series):  # dedup by round (reference 156-247)
-                series.append((rnd, float(value)))
-                series.sort(key=lambda rv: rv[0])
+            bisect.insort(series, (rnd, float(value)), key=lambda rv: rv[0])
 
     def get_all_logs(self) -> GlobalLogs:
         with self._lock:

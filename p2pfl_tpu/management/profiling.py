@@ -9,6 +9,7 @@ in TensorBoard/Perfetto.
 from __future__ import annotations
 
 import contextlib
+import threading as _threading
 import time
 from typing import Iterator, Optional
 
@@ -115,17 +116,19 @@ def mfu(flops: Optional[float], seconds: float, n_devices: int = 1) -> Optional[
 # structure and are excluded. Per-node counts additionally land in
 # ``logger.get_comm_metrics(addr)["device_dispatch"]`` so benches can
 # attribute dispatches/round per node.
-
-import threading as _threading
-
-_dispatch_lock = _threading.Lock()
-_dispatch_counts: dict = {}
+#
+# Since the flight recorder the counters live in the unified telemetry
+# registry (counter group "dispatch", node "" = process-wide site totals);
+# this surface is a thin view, and :func:`dispatch_span` is the preferred
+# call-site wrapper — it counts AND records a "dispatch"-plane span (with
+# an optional jax.profiler annotation bridge on accelerators).
 
 
 def record_dispatch(site: str, node: str = "") -> None:
     """Count one model-plane device dispatch issued at ``site``."""
-    with _dispatch_lock:
-        _dispatch_counts[site] = _dispatch_counts.get(site, 0) + 1
+    from p2pfl_tpu.management.telemetry import telemetry
+
+    telemetry.inc("dispatch", "", site)
     if node:
         logger.log_comm_metric(node, "device_dispatch")
 
@@ -133,39 +136,117 @@ def record_dispatch(site: str, node: str = "") -> None:
 def get_dispatch_counts() -> dict:
     """Snapshot of per-site dispatch counters (``logger.get_comm_metrics``
     style: plain accumulators, reset via :func:`reset_dispatch_counts`)."""
-    with _dispatch_lock:
-        return dict(_dispatch_counts)
+    from p2pfl_tpu.management.telemetry import telemetry
+
+    return {k: int(v) for k, v in telemetry.counters("dispatch", "").items()}
 
 
 def total_dispatches() -> int:
-    with _dispatch_lock:
-        return int(sum(_dispatch_counts.values()))
+    return int(sum(get_dispatch_counts().values()))
 
 
 def reset_dispatch_counts() -> None:
-    with _dispatch_lock:
-        _dispatch_counts.clear()
+    from p2pfl_tpu.management.telemetry import telemetry
+
+    telemetry.reset_counters("dispatch")
+
+
+def snapshot_and_reset_dispatch_counts() -> dict:
+    """Atomic read-and-clear: a ``get`` + ``reset`` pair can lose
+    dispatches recorded between the two calls (e.g. a gossip worker's
+    decode-side aggregate landing mid-bench) — this cannot."""
+    from p2pfl_tpu.management.telemetry import telemetry
+
+    return {
+        k: int(v)
+        for k, v in telemetry.snapshot_and_reset("dispatch", "").items()
+    }
+
+
+@contextlib.contextmanager
+def dispatch_span(site: str, node: str = "", **attrs) -> Iterator[None]:
+    """Wrap one model-plane jit call site: counts the dispatch
+    (:func:`record_dispatch`) and records a "dispatch"-plane span whose
+    duration is the HOST-side dispatch cost (jax returns before the device
+    finishes — the async tail bills to whoever blocks, which is exactly
+    the host-dispatch-tax accounting ISSUE 6 established). On accelerators
+    the span body additionally runs under ``jax.profiler.TraceAnnotation``
+    so a captured profiler trace lines the host span up with the device
+    timeline (``settings.telemetry_jax_annotations``).
+
+    The count lands only when the body SUCCEEDS: a failed fused-round
+    dispatch falls back to the staged path, and counting both would
+    inflate dispatches_per_round with a program that never ran to
+    completion (the span still records, with the error in its attrs)."""
+    from p2pfl_tpu.management.telemetry import telemetry
+    from p2pfl_tpu.settings import telemetry_jax_annotations
+
+    with telemetry.span(node, site, kind="dispatch", attrs=attrs or None):
+        if telemetry_jax_annotations():
+            with jax.profiler.TraceAnnotation(f"p2pfl:{site}"):
+                yield
+        else:
+            yield
+    record_dispatch(site, node)
 
 
 class Stopwatch:
     """Cheap wall-clock section timing (the reference's --measure_time,
-    generalized): ``with sw.section("fit"): ...`` then ``sw.summary()``."""
+    generalized): ``with sw.section("fit"): ...`` then ``sw.summary()``.
+
+    Thread-safe — sections run on gossip worker threads too — and backed
+    by the telemetry registry's :class:`~p2pfl_tpu.management.telemetry.
+    LatencyHistogram`, so ``summary()`` carries percentiles alongside the
+    historical total/mean columns. ``totals``/``counts`` remain readable
+    as plain dict snapshots for existing callers.
+    """
 
     def __init__(self) -> None:
-        self.totals: dict[str, float] = {}
-        self.counts: dict[str, int] = {}
+        from p2pfl_tpu.management.telemetry import LatencyHistogram
+
+        self._lock = _threading.Lock()
+        self._hists: dict[str, LatencyHistogram] = {}
 
     @contextlib.contextmanager
     def section(self, name: str) -> Iterator[None]:
-        t0 = time.monotonic()
+        from p2pfl_tpu.management.telemetry import LatencyHistogram
+
+        t0 = time.monotonic_ns()
         try:
             yield
         finally:
-            self.totals[name] = self.totals.get(name, 0.0) + time.monotonic() - t0
-            self.counts[name] = self.counts.get(name, 0) + 1
+            hist = self._hists.get(name)
+            if hist is None:
+                with self._lock:
+                    hist = self._hists.setdefault(name, LatencyHistogram())
+            hist.record(time.monotonic_ns() - t0)
+
+    @property
+    def totals(self) -> dict[str, float]:
+        with self._lock:
+            items = list(self._hists.items())
+        return {k: h.sum_ns / 1e9 for k, h in items}
+
+    @property
+    def counts(self) -> dict[str, int]:
+        with self._lock:
+            items = list(self._hists.items())
+        return {k: h.count for k, h in items}
 
     def summary(self) -> dict[str, dict[str, float]]:
-        return {
-            k: {"total_s": round(v, 4), "calls": self.counts[k], "mean_s": round(v / self.counts[k], 4)}
-            for k, v in self.totals.items()
-        }
+        with self._lock:
+            items = list(self._hists.items())
+        out: dict[str, dict[str, float]] = {}
+        for k, h in items:
+            s = h.summary()
+            if not s.get("count"):
+                continue
+            out[k] = {
+                "total_s": round(s["total_s"], 4),
+                "calls": s["count"],
+                "mean_s": round(s["total_s"] / s["count"], 4),
+                "p50_ms": s["p50_ms"],
+                "p95_ms": s["p95_ms"],
+                "p99_ms": s["p99_ms"],
+            }
+        return out

@@ -989,29 +989,32 @@ class SpmdFederation:
         # robust aggregators see only the [K] selected rows; K is static per
         # mask pattern, so the executable is reused as long as K is stable
         sel_idx = jax.device_put(np.flatnonzero(eff).astype(np.int32), self._repl)
+        from p2pfl_tpu.management.profiling import dispatch_span
+
         try:
-            result = spmd_round(
-                self.params,
-                self.opt_state,
-                self.x_all,
-                self.y_all,
-                perm,
-                mask,
-                self._samples,
-                sel_idx,
-                module=self.module,
-                tx=self.tx,
-                agg=self.aggregator,
-                trim=self.trim,
-                clip_tau=self.clip_tau,
-                out_sharding=self._shard,
-                keep_opt_state=self.keep_opt_state,
-                remat=self.remat,
-                x_test=self.x_test if eval else None,
-                y_test=self.y_test if eval else None,
-                dp_keys=self._dp_round_keys(),
-                **self._algo_kwargs(self._server_t + 1 if self.server_opt else 0),
-            )
+            with dispatch_span("spmd_round", "spmd", nodes=self.n, epochs=epochs):
+                result = spmd_round(
+                    self.params,
+                    self.opt_state,
+                    self.x_all,
+                    self.y_all,
+                    perm,
+                    mask,
+                    self._samples,
+                    sel_idx,
+                    module=self.module,
+                    tx=self.tx,
+                    agg=self.aggregator,
+                    trim=self.trim,
+                    clip_tau=self.clip_tau,
+                    out_sharding=self._shard,
+                    keep_opt_state=self.keep_opt_state,
+                    remat=self.remat,
+                    x_test=self.x_test if eval else None,
+                    y_test=self.y_test if eval else None,
+                    dp_keys=self._dp_round_keys(),
+                    **self._algo_kwargs(self._server_t + 1 if self.server_opt else 0),
+                )
         except Exception:
             self._recover_donated_state()
             raise
@@ -1219,18 +1222,21 @@ class SpmdFederation:
         is computed on-device and returned in the history entries.
         """
         perms, mask, sel_idx = self._fused_inputs(rounds, epochs)
+        from p2pfl_tpu.management.profiling import dispatch_span
+
         try:
-            result = spmd_rounds_fused(
-                self.params, self.opt_state, self.x_all, self.y_all, perms, mask,
-                self._samples, sel_idx,
-                module=self.module, tx=self.tx, agg=self.aggregator, trim=self.trim, clip_tau=self.clip_tau,
-                out_sharding=self._shard, keep_opt_state=self.keep_opt_state,
-                remat=self.remat,
-                x_test=self.x_test if eval else None,
-                y_test=self.y_test if eval else None,
-                dp_keys=self._dp_round_keys(rounds),
-                **self._algo_kwargs(self._server_t),
-            )
+            with dispatch_span("spmd_rounds_fused", "spmd", nodes=self.n, rounds=rounds):
+                result = spmd_rounds_fused(
+                    self.params, self.opt_state, self.x_all, self.y_all, perms, mask,
+                    self._samples, sel_idx,
+                    module=self.module, tx=self.tx, agg=self.aggregator, trim=self.trim, clip_tau=self.clip_tau,
+                    out_sharding=self._shard, keep_opt_state=self.keep_opt_state,
+                    remat=self.remat,
+                    x_test=self.x_test if eval else None,
+                    y_test=self.y_test if eval else None,
+                    dp_keys=self._dp_round_keys(rounds),
+                    **self._algo_kwargs(self._server_t),
+                )
         except Exception:
             self._recover_donated_state()
             raise

@@ -108,6 +108,28 @@ class Settings:
     VOTE_EVERY_ROUND: bool = False
 
     # --- monitoring ---
+    # Round flight recorder (management/telemetry.py): wire-propagated
+    # trace spans, the unified counter/histogram registry and the
+    # Perfetto-loadable exporter. Disabling skips span/histogram recording
+    # entirely (counters — comm metrics, dispatch counts — always stay on:
+    # they are load-bearing for tests and benches, and one locked dict
+    # increment is not measurable overhead).
+    TELEMETRY_ENABLED: bool = True
+    # Per-node span ring-buffer bound: a flight recorder keeps the recent
+    # past, not an archive — old spans fall off instead of growing memory
+    # for the life of a long federation.
+    TELEMETRY_RING_SPANS: int = 4096
+    # Record spans for heartbeat 'beat' sends/receives. Off by default:
+    # beats flood at 1/HEARTBEAT_PERIOD per neighbor and would both crowd
+    # the ring and dominate the overhead budget (the same rationale as
+    # EXCLUDE_BEAT_LOGS; beat *evictions* and breaker transitions are
+    # always recorded as events).
+    TELEMETRY_BEAT_SPANS: bool = False
+    # Bridge dispatch spans to jax.profiler.TraceAnnotation so the host-side
+    # dispatch timeline lines up with XLA's device timeline in a captured
+    # profiler trace. None = auto (annotate on accelerators, skip on CPU
+    # where there is no separate device timeline to correlate).
+    TELEMETRY_JAX_ANNOTATIONS: Optional[bool] = None
     RESOURCE_MONITOR_PERIOD: float = 1.0
     # Stall watchdog (management/watchdog.py): when > 0, a daemon thread
     # dumps every thread's stack if a learning node makes no stage
@@ -264,6 +286,22 @@ def wire_compression_device() -> bool:
     return jax.default_backend() != "cpu"
 
 
+def telemetry_jax_annotations() -> bool:
+    """Resolve ``Settings.TELEMETRY_JAX_ANNOTATIONS`` (None = by backend).
+
+    The annotation bridge exists to line host dispatch spans up with XLA's
+    device timeline inside a captured ``jax.profiler`` trace — which only
+    exists on a real accelerator; on CPU the extra TraceAnnotation call is
+    pure overhead with nothing to correlate against.
+    """
+    explicit = Settings.TELEMETRY_JAX_ANNOTATIONS
+    if explicit is not None:
+        return bool(explicit)
+    import jax
+
+    return jax.default_backend() != "cpu"
+
+
 def set_low_latency_settings() -> None:
     """Documented low-latency profile for reliable local networks.
 
@@ -339,6 +377,9 @@ def set_test_settings() -> None:
     Settings.CHUNK_FUSED_REDUCE = True
     Settings.CHUNK_DONATE_BUFFERS = True
     Settings.SCAFFOLD_FUSED_CI = True
+    Settings.TELEMETRY_ENABLED = True
+    Settings.TELEMETRY_RING_SPANS = 4096
+    Settings.TELEMETRY_BEAT_SPANS = False
     Settings.TRAIN_SET_SIZE = 4
     Settings.VOTE_TIMEOUT = 10.0
     Settings.AGGREGATION_TIMEOUT = 10.0

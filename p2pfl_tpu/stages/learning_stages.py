@@ -16,8 +16,21 @@ import time
 from typing import TYPE_CHECKING, Optional, Type
 
 from p2pfl_tpu.management.logger import logger
+from p2pfl_tpu.management.telemetry import telemetry
 from p2pfl_tpu.settings import Settings
 from p2pfl_tpu.stages.stage import Stage
+
+
+def _wait_span(node: "Node", name: str):
+    """A sub-span on the stage plane (nested under the FSM stage span) for
+    the waits that gate a round — RoundReport lists these separately from
+    the top-level stage split so e.g. aggregation-wait burn is visible."""
+    return telemetry.span(
+        node.addr,
+        name,
+        kind="stage",
+        attrs={"round": node.state.round, "experiment": node.state.experiment_name},
+    )
 
 if TYPE_CHECKING:
     from p2pfl_tpu.node import Node
@@ -453,13 +466,14 @@ class TrainStage(Stage):
                 partial = todo[0]
             return node.protocol.build_weights("add_model", state.round or 0, partial)
 
-        node.protocol.gossip_weights(
-            early_stopping_fn=early_stop,
-            get_candidates_fn=candidates,
-            status_fn=status,
-            model_fn=model_fn,
-            create_connection=True,
-        )
+        with _wait_span(node, "gossip_partials"):
+            node.protocol.gossip_weights(
+                early_stopping_fn=early_stop,
+                get_candidates_fn=candidates,
+                status_fn=status,
+                model_fn=model_fn,
+                create_connection=True,
+            )
 
 
 class WaitAggregatedModelsStage(Stage):
@@ -506,7 +520,12 @@ class GossipModelStage(Stage):
             # leave headroom for the train set's seed-recovery round to
             # finish before giving up on that diffusion arriving
             timeout = Settings.AGGREGATION_TIMEOUT + Settings.SECAGG_RECOVERY_TIMEOUT
-        agg = node.aggregator.wait_and_get_aggregation(timeout=timeout)
+        with _wait_span(node, "aggregation_wait") as sp:
+            agg = node.aggregator.wait_and_get_aggregation(timeout=timeout)
+            if sp is not None:
+                # partial coverage here means the wait closed by timeout or
+                # repair, not full arrival — the report's timeout-burn signal
+                sp.attrs["contributors"] = len(agg.contributors)
         if Settings.SECURE_AGGREGATION:
             agg = GossipModelStage._secagg_finalize(node, agg)
         node.learner.set_parameters(agg.params)
@@ -555,12 +574,13 @@ class GossipModelStage(Stage):
                 update.contributors = [*update.contributors, CLEAN_MARKER]
             return node.protocol.build_weights("add_model", state.round or 0, update)
 
-        node.protocol.gossip_weights(
-            early_stopping_fn=node.learning_interrupted,
-            get_candidates_fn=candidates,
-            status_fn=lambda: sorted(candidates()),
-            model_fn=model_fn,
-        )
+        with _wait_span(node, "diffusion"):
+            node.protocol.gossip_weights(
+                early_stopping_fn=node.learning_interrupted,
+                get_candidates_fn=candidates,
+                status_fn=lambda: sorted(candidates()),
+                model_fn=model_fn,
+            )
         if node.learning_interrupted():
             return None
         return RoundFinishedStage

@@ -33,6 +33,8 @@ class LearningWorkflow:
             except Exception:  # noqa: BLE001 — abort-path flush never masks the exit
                 pass
 
+        from p2pfl_tpu.management.telemetry import telemetry
+
         stage = StartLearningStage
         try:
             while stage is not None:
@@ -40,12 +42,34 @@ class LearningWorkflow:
                 # stall-watchdog instrumentation (management/watchdog.py)
                 node.state.current_stage = stage.name
                 node.state.last_transition = time.monotonic()
+                state = node.state
+                # flight recorder: every FSM stage is a span on the node's
+                # "stage" plane, tagged with the round so RoundReport can
+                # attribute round wall-clock per stage. The trace id is
+                # DETERMINISTIC per (experiment epoch, round) — every node
+                # derives the same one, so all nodes' spans of one round
+                # form one trace without any coordination, and wire ctx
+                # stamped under this span links the cross-node edges.
+                trace_id = (
+                    f"{state.experiment_name or 'exp'}:"
+                    f"{getattr(state, 'experiment_epoch', 0)}:r{state.round or 0}"
+                )
                 try:
                     # crash-at-stage seam (communication/faults.py): hooks run on
                     # every transition and may raise FaultCrash to kill the node
                     for hook in node.stage_hooks:
                         hook(node, stage.name)
-                    stage = stage.execute(node)
+                    with telemetry.span(
+                        node.addr,
+                        stage.name,
+                        kind="stage",
+                        attrs={
+                            "round": state.round,
+                            "experiment": state.experiment_name,
+                        },
+                        trace_id=trace_id,
+                    ):
+                        stage = stage.execute(node)
                 except FaultCrash as exc:
                     # injected hard crash: the node is already torn down with no
                     # goodbyes; just stop executing, like a killed process —

@@ -30,8 +30,8 @@ import pytest
 from p2pfl_tpu.learning.dataset import FederatedDataset
 from p2pfl_tpu.learning.learner import JaxLearner
 from p2pfl_tpu.management.profiling import (
-    get_dispatch_counts,
     reset_dispatch_counts,
+    snapshot_and_reset_dispatch_counts,
 )
 from p2pfl_tpu.models import mlp
 from p2pfl_tpu.settings import Settings, wire_compression_device
@@ -215,14 +215,15 @@ class TestDispatchBudget:
         staged = _learner(data, "staged-n", epochs=epochs)
         reset_dispatch_counts()
         one_round(staged, FedAvg("staged-n"), fused=False)
-        staged_counts = get_dispatch_counts()
+        # atomic harvest (telemetry registry): read-and-clear in one lock
+        # hold, so the next mode's window cannot swallow late increments
+        staged_counts = snapshot_and_reset_dispatch_counts()
         staged_total = sum(staged_counts.values())
         assert staged_total >= epochs + 2, staged_counts
 
         fused = _learner(data, "fused-n", epochs=epochs)
-        reset_dispatch_counts()
         one_round(fused, FedAvg("fused-n"), fused=True)
-        fused_counts = get_dispatch_counts()
+        fused_counts = snapshot_and_reset_dispatch_counts()
         fused_total = sum(fused_counts.values())
         assert fused_total <= 2, fused_counts
         # the CI smoke guard: ≥ 3× fewer dispatches than the staged round
@@ -391,7 +392,9 @@ class TestFusedFederationE2E:
             reset_dispatch_counts()
             nodes[0].set_start_learning(rounds=2, epochs=2)
             wait_to_finish(nodes, timeout=90)
-            counts = get_dispatch_counts()
+            # nodes are still running here — harvest atomically so nothing
+            # lands between a get and a reset
+            counts = snapshot_and_reset_dispatch_counts()
             # 2 nodes × 2 rounds of fused programs, no staged train epochs
             assert counts.get("fused_round") == 4, counts
             assert counts.get("train_epoch") is None, counts

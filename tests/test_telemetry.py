@@ -1,0 +1,496 @@
+"""Flight-recorder suite (ISSUE 7): spans, wire trace context, RoundReport,
+Chrome-trace export, the unified counter registry, and the thread-safety
+satellites (Stopwatch, snapshot_and_reset, GlobalMetricStorage dedup).
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from p2pfl_tpu.communication.faults import (
+    CrashSpec,
+    EdgeFault,
+    FaultPlan,
+    install_fault_plan,
+    remove_fault_plan,
+)
+from p2pfl_tpu.communication.memory import MemoryRegistry
+from p2pfl_tpu.learning.learner import DummyLearner
+from p2pfl_tpu.management.logger import logger
+from p2pfl_tpu.management.telemetry import (
+    telemetry,
+    validate_chrome_trace,
+)
+from p2pfl_tpu.node import Node
+from p2pfl_tpu.settings import Settings
+from p2pfl_tpu.utils import full_connection, wait_convergence, wait_to_finish
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    MemoryRegistry.reset()
+    telemetry.reset()
+    yield
+    MemoryRegistry.reset()
+    telemetry.reset()
+    Settings.TELEMETRY_RING_SPANS = 4096
+
+
+def _mk_nodes(n: int) -> list:
+    nodes = [Node(learner=DummyLearner(value=float(i))) for i in range(n)]
+    for node in nodes:
+        node.start()
+    for node in nodes:
+        full_connection(node, nodes)
+    wait_convergence(nodes, n - 1, only_direct=True, wait=10)
+    return nodes
+
+
+def _stop_all(nodes):
+    for n in nodes:
+        n.stop()
+
+
+# ---------------------------------------------------------------------------
+# span API
+# ---------------------------------------------------------------------------
+
+
+def test_span_nesting_and_context():
+    with telemetry.span("n1", "outer", kind="stage") as outer:
+        assert telemetry.current_ctx() == (outer.trace_id, outer.span_id)
+        with telemetry.span("n1", "inner", kind="gossip") as inner:
+            # nesting: same trace, parent chain through the stack
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+            assert telemetry.current_ctx() == (inner.trace_id, inner.span_id)
+        assert telemetry.current_ctx() == (outer.trace_id, outer.span_id)
+    assert telemetry.current_ctx() is None
+    spans = telemetry.spans("n1")
+    assert [s.name for s in spans] == ["outer", "inner"]
+    for s in spans:
+        assert s.t1_ns >= s.t0_ns
+
+
+def test_explicit_parent_overrides_stack():
+    """A wire ``trace_ctx`` wins over the thread-local stack — the receive
+    path links to the SENDER's span, not whatever the delivering thread
+    happens to be inside."""
+    with telemetry.span("n1", "local", kind="stage"):
+        with telemetry.span("n2", "recv", kind="gossip", parent=("tX", "sX")) as sp:
+            assert sp.trace_id == "tX"
+            assert sp.parent_id == "sX"
+
+
+def test_span_disabled_records_nothing():
+    Settings.TELEMETRY_ENABLED = False
+    try:
+        with telemetry.span("n1", "x") as sp:
+            assert sp is None
+        telemetry.event("n1", "boom")
+        assert telemetry.spans() == []
+        assert telemetry.current_ctx() is None
+    finally:
+        Settings.TELEMETRY_ENABLED = True
+
+
+def test_ring_bounded_under_concurrent_writers():
+    Settings.TELEMETRY_RING_SPANS = 128
+    telemetry.reset_spans()
+    n_threads, per_thread = 8, 200
+    errors = []
+
+    def hammer(i):
+        try:
+            for k in range(per_thread):
+                with telemetry.span("ring-node", f"w{i}", kind="gossip", attrs={"k": k}):
+                    pass
+        except Exception as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    threads = [threading.Thread(target=hammer, args=(i,)) for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    spans = telemetry.spans("ring-node")
+    # bounded: only the most recent TELEMETRY_RING_SPANS survive
+    assert len(spans) == 128
+    # and the survivors are the tail of the stream, not a random sample:
+    # every thread's final span (k = per_thread - 1) postdates at least
+    # n_threads * 128 earlier commits, so the retained k's skew high
+    assert max(s.attrs["k"] for s in spans) == per_thread - 1
+    assert min(s.attrs["k"] for s in spans) >= per_thread - 1 - 128
+
+
+def test_histogram_percentiles_ordered():
+    for ms in (1, 2, 3, 5, 8, 13, 100, 400):
+        telemetry.observe("h-node", "lat", ms * 1_000_000)
+    h = telemetry.histograms("h-node")["lat"]
+    assert h["count"] == 8
+    assert h["p50_ms"] <= h["p95_ms"] <= h["p99_ms"] <= 2 * h["max_ms"]
+    # log2 buckets: p50 within 2x of the true median (5.5 ms)
+    assert 2 <= h["p50_ms"] <= 12
+
+
+# ---------------------------------------------------------------------------
+# unified counter registry + atomic snapshot_and_reset (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_comm_metrics_view_backed_by_registry():
+    logger.log_comm_metric("cnode", "m", 2.0)
+    logger.log_comm_metric("cnode", "m", 3.0)
+    assert logger.get_comm_metrics("cnode") == {"m": 5.0}
+    assert telemetry.counters("comm", "cnode") == {"m": 5.0}
+    logger.reset_comm_metrics()
+    assert logger.get_comm_metrics("cnode") == {}
+
+
+def test_snapshot_and_reset_loses_no_increments():
+    """Concurrent incrementer + repeated snapshot_and_reset: the sum of all
+    snapshots plus the residue equals exactly what was written — the
+    get+reset pair this replaces could drop increments in the gap."""
+    total_writes = 4000
+    done = threading.Event()
+
+    def writer():
+        for _ in range(total_writes):
+            logger.log_comm_metric("atomic-node", "hits")
+        done.set()
+
+    t = threading.Thread(target=writer)
+    t.start()
+    harvested = 0.0
+    while not done.is_set():
+        harvested += logger.snapshot_and_reset_comm_metrics("atomic-node").get("hits", 0.0)
+    t.join()
+    harvested += logger.snapshot_and_reset_comm_metrics("atomic-node").get("hits", 0.0)
+    assert harvested == total_writes
+
+
+def test_dispatch_counts_snapshot_and_reset():
+    from p2pfl_tpu.management.profiling import (
+        get_dispatch_counts,
+        record_dispatch,
+        reset_dispatch_counts,
+        snapshot_and_reset_dispatch_counts,
+    )
+
+    reset_dispatch_counts()
+    record_dispatch("site_a")
+    record_dispatch("site_a")
+    record_dispatch("site_b")
+    snap = snapshot_and_reset_dispatch_counts()
+    assert snap == {"site_a": 2, "site_b": 1}
+    assert get_dispatch_counts() == {}
+
+
+def test_stopwatch_thread_safe():
+    from p2pfl_tpu.management.profiling import Stopwatch
+
+    sw = Stopwatch()
+    n_threads, per_thread = 8, 300
+
+    def hammer():
+        for _ in range(per_thread):
+            with sw.section("hot"):
+                pass
+
+    threads = [threading.Thread(target=hammer) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # the racy read-modify-write would lose counts here
+    assert sw.counts["hot"] == n_threads * per_thread
+    s = sw.summary()
+    assert s["hot"]["calls"] == n_threads * per_thread
+    assert "p95_ms" in s["hot"]
+
+
+# ---------------------------------------------------------------------------
+# GlobalMetricStorage round-dedup satellite
+# ---------------------------------------------------------------------------
+
+
+def test_global_metric_storage_dedup_and_sorted():
+    from p2pfl_tpu.management.metric_storage import GlobalMetricStorage
+
+    store = GlobalMetricStorage()
+    # out-of-order rounds, duplicate round 1: first write wins, list sorted
+    store.add_log("e", 3, "acc", "n", 0.3)
+    store.add_log("e", 1, "acc", "n", 0.1)
+    store.add_log("e", 1, "acc", "n", 0.999)  # dup — dropped
+    store.add_log("e", 2, "acc", "n", 0.2)
+    series = store.get_all_logs()["e"]["n"]["acc"]
+    assert series == [(1, 0.1), (2, 0.2), (3, 0.3)]
+    # independent series do not share dedup state
+    store.add_log("e", 1, "loss", "n", 9.0)
+    assert store.get_all_logs()["e"]["n"]["loss"] == [(1, 9.0)]
+
+
+# ---------------------------------------------------------------------------
+# wire trace context
+# ---------------------------------------------------------------------------
+
+
+def test_trace_ctx_grpc_codec_roundtrip():
+    from p2pfl_tpu.communication.grpc_transport import (
+        decode_message,
+        decode_weights,
+        encode_message,
+        encode_weights,
+    )
+    from p2pfl_tpu.communication.message import Message, WeightsEnvelope
+    from p2pfl_tpu.learning.weights import ModelUpdate
+
+    import numpy as np
+
+    msg = Message("a:1", "vote", ("x", "1"), round=2, trace_ctx=("tid9", "sid7"))
+    back = decode_message(encode_message(msg))
+    assert back.trace_ctx == ("tid9", "sid7")
+    assert (back.source, back.cmd, back.args) == (msg.source, msg.cmd, msg.args)
+
+    # absent field (old wire format) still decodes — trace_ctx None
+    old = json.loads(encode_message(msg).decode())
+    del old["tc"]
+    legacy = decode_message(json.dumps(old).encode())
+    assert legacy.trace_ctx is None
+    assert legacy.msg_id == msg.msg_id
+
+    update = ModelUpdate({"w": np.ones(4, np.float32)}, ["a:1"], 10)
+    env = WeightsEnvelope("a:1", 1, "add_model", update, trace_ctx=("tw", "sw"))
+    wire = encode_weights(env)
+    back_env = decode_weights(wire)
+    assert back_env.trace_ctx == ("tw", "sw")
+    # old weights frame (no tc in header) also decodes
+    hlen = int.from_bytes(wire[:4], "little")
+    header = json.loads(wire[4 : 4 + hlen].decode())
+    del header["tc"]
+    raw = json.dumps(header).encode()
+    legacy_wire = b"".join((len(raw).to_bytes(4, "little"), raw, wire[4 + hlen :]))
+    assert decode_weights(legacy_wire).trace_ctx is None
+
+
+def test_trace_ctx_links_sender_and_receiver_in_memory():
+    """A message built under a sender span produces a receiver recv-span
+    whose parent is the sender's span — one causal edge across nodes."""
+    nodes = _mk_nodes(2)
+    try:
+        a, b = nodes
+        telemetry.reset_spans()
+        with telemetry.span(a.addr, "probe_stage", kind="stage") as sp:
+            msg = a.protocol.build_msg("metrics", ["test_acc", "1.0"], round=0)
+            assert msg.trace_ctx == (sp.trace_id, sp.span_id)
+            assert a.protocol.send(b.addr, msg)
+        recv = [
+            s
+            for s in telemetry.spans(b.addr)
+            if s.name == "recv:metrics" and s.node == b.addr
+        ]
+        assert recv, "receiver recorded no recv span"
+        assert recv[0].trace_id == sp.trace_id
+        assert recv[0].parent_id == sp.span_id
+    finally:
+        _stop_all(nodes)
+
+
+# ---------------------------------------------------------------------------
+# RoundReport + Chrome trace export on a real federation
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def _slow_peer_federation():
+    nodes = _mk_nodes(4)
+    slow = nodes[-1]
+    plan = FaultPlan(seed=42, slow_nodes={slow.addr: 0.25})
+    install_fault_plan(nodes, plan)
+    telemetry.reset_spans()
+    yield nodes, slow
+    remove_fault_plan(nodes)
+    _stop_all(nodes)
+
+
+def test_round_report_names_slow_peer(_slow_peer_federation):
+    nodes, slow = _slow_peer_federation
+    Settings.TRAIN_SET_SIZE = 4
+    nodes[0].set_start_learning(rounds=1, epochs=1)
+    wait_to_finish(nodes, timeout=45)
+    report = telemetry.round_report(0)
+    assert report.per_node, "no stage spans attributed to round 0"
+    assert set(report.per_node) == {n.addr for n in nodes}
+    # every inbound weights delivery to the slow peer pays 0.25 s inside
+    # the sender's send span — the critical edge must point at it
+    assert report.critical_edge is not None
+    assert report.critical_edge["dst"] == slow.addr
+    assert report.critical_edge["busy_s"] >= 0.25
+    assert report.faults.get("fault_slow", 0) >= 1
+    # the report walks a tree whose stage split covers the round wall
+    for info in report.per_node.values():
+        assert info["wall_s"] > 0
+        assert info["stages_s"]
+
+
+def test_chrome_trace_export_schema(tmp_path, _slow_peer_federation):
+    nodes, _slow = _slow_peer_federation
+    Settings.TRAIN_SET_SIZE = 4
+    nodes[0].set_start_learning(rounds=1, epochs=1)
+    wait_to_finish(nodes, timeout=45)
+    out = tmp_path / "trace.json"
+    doc = telemetry.export_chrome_trace(path=str(out))
+    n_events = validate_chrome_trace(doc)
+    assert n_events > 20
+    # the file round-trips and validates identically (what Perfetto loads)
+    reloaded = json.loads(out.read_text())
+    assert validate_chrome_trace(reloaded) == n_events
+    events = reloaded["traceEvents"]
+    # one pid per node, named via process_name metadata
+    proc_names = {
+        e["pid"]: e["args"]["name"] for e in events if e.get("name") == "process_name"
+    }
+    assert set(proc_names.values()) >= {n.addr for n in nodes}
+    # spans land on per-plane tids with stage + gossip lanes populated
+    lanes = {(e["pid"], e["tid"]) for e in events if e.get("ph") == "X"}
+    from p2pfl_tpu.management.telemetry import PLANES
+
+    tids = {tid for _pid, tid in lanes}
+    assert PLANES["stage"] in tids and PLANES["gossip"] in tids
+    # X events carry the wire-propagated trace identity
+    x_events = [e for e in events if e.get("ph") == "X"]
+    assert all("trace_id" in e["args"] and "span_id" in e["args"] for e in x_events)
+
+
+def test_validate_chrome_trace_rejects_malformed():
+    with pytest.raises(ValueError):
+        validate_chrome_trace({"no": "traceEvents"})
+    with pytest.raises(ValueError):
+        validate_chrome_trace({"traceEvents": [{"ph": "X", "name": "x", "pid": 1, "tid": 1}]})
+    with pytest.raises(ValueError):
+        validate_chrome_trace(
+            {"traceEvents": [{"ph": "?", "name": "x", "pid": 1, "tid": 1}]}
+        )
+
+
+def test_deterministic_round_trace_id_across_nodes():
+    """Every node derives the same trace id for the same round, so one
+    round's spans across all nodes form one trace without coordination."""
+    nodes = _mk_nodes(2)
+    try:
+        telemetry.reset_spans()
+        nodes[0].set_start_learning(rounds=1, epochs=1)
+        wait_to_finish(nodes, timeout=30)
+        by_node = {}
+        for s in telemetry.spans():
+            if s.kind == "stage" and s.attrs.get("round") == 0 and s.name in (
+                "TrainStage",
+                "GossipModelStage",
+            ):
+                by_node.setdefault(s.node, set()).add(s.trace_id)
+        assert len(by_node) == 2
+        ids = set().union(*by_node.values())
+        assert len(ids) == 1, f"round 0 split into traces: {ids}"
+    finally:
+        _stop_all(nodes)
+
+
+# ---------------------------------------------------------------------------
+# the acceptance scenario: 6-node chaos federation, flight recorder on
+# ---------------------------------------------------------------------------
+
+
+def test_flight_recorder_chaos_federation(tmp_path):
+    """Seeded 6-node chaos run (5% drop + slow peer + mid-round crash):
+    the exported trace validates against the Chrome schema and the
+    RoundReport names the slow peer (critical edge) and the crashed peer
+    (failure ranking) — a chaos failure is self-explaining."""
+    Settings.TRAIN_SET_SIZE = 6
+    Settings.AGGREGATION_TIMEOUT = 60.0
+    nodes = _mk_nodes(6)
+    victim, slow = nodes[3], nodes[-1]
+    plan = FaultPlan(
+        seed=1905,
+        default=EdgeFault(drop=0.05),
+        slow_nodes={slow.addr: 0.3},
+        crashes={victim.addr: CrashSpec(stage="TrainStage", round_no=0)},
+    )
+    install_fault_plan(nodes, plan)
+    telemetry.reset_spans()
+    survivors = [n for n in nodes if n is not victim]
+    try:
+        nodes[0].set_start_learning(rounds=2, epochs=1)
+        wait_to_finish(survivors, timeout=45)
+        assert not victim._running
+
+        from p2pfl_tpu.management.telemetry import dump_flight_record
+
+        paths = dump_flight_record(str(tmp_path))
+        trace = json.loads(open(paths[0]).read())
+        assert validate_chrome_trace(trace) > 50
+        reports = json.loads(open(paths[1]).read())
+        affected = [r for r in reports if r["round"] == 0]
+        assert affected, "round 0 produced no report"
+        rep = affected[0]
+        # the round was gated by injected chaos, and the report names the
+        # culprits: the critical edge (send time + retry backoff) points
+        # at the slow peer or the corpse (retries to a crashed peer can
+        # out-burn a straggler's latency — both are the critical path)...
+        assert rep["critical_path"]["edge"]["dst"] in (slow.addr, victim.addr)
+        # ...the edge that burned the most raw send time is the slow
+        # peer's (every weights delivery to it pays 0.3 s)...
+        busiest = max(rep["edges"].items(), key=lambda kv: kv[1]["busy_s"])
+        assert busiest[0].endswith(f"->{slow.addr}")
+        # ...and the crash is visible twice: as an injected-fault event
+        # and as the most-failed peer (every send to the corpse fails
+        # until eviction)
+        assert rep["faults"].get("fault_crash", 0) >= 1
+        assert rep["critical_path"]["most_failed_peer"] == victim.addr
+        # cross-node causality survived the chaos: some receiver span's
+        # parent is a span recorded on ANOTHER node
+        spans = telemetry.spans()
+        by_id = {s.span_id: s for s in spans}
+        cross = [
+            s
+            for s in spans
+            if s.name.startswith("recv:")
+            and s.parent_id in by_id
+            and by_id[s.parent_id].node != s.node
+        ]
+        assert cross, "no cross-node parent links recorded"
+    finally:
+        remove_fault_plan(nodes)
+        _stop_all(nodes)
+
+
+# ---------------------------------------------------------------------------
+# overhead guard (micro): the disabled path must be near-free
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_span_is_cheap():
+    """The off switch must actually switch off: creating a disabled span
+    handle allocates nothing and is an order of magnitude cheaper than a
+    recorded span (the real ≤5% bound is measured by bench_suite config1
+    and guarded in CI — this is the unit-level sanity check)."""
+    n = 20_000
+    Settings.TELEMETRY_ENABLED = False
+    try:
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with telemetry.span("x", "s"):
+                pass
+        off = time.perf_counter() - t0
+    finally:
+        Settings.TELEMETRY_ENABLED = True
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with telemetry.span("x", "s"):
+            pass
+    on = time.perf_counter() - t0
+    assert off < on
+    # and even the enabled path stays in the microseconds-per-span regime
+    assert on / n < 200e-6
