@@ -1,0 +1,326 @@
+"""BENCH_ASYNC: time-to-accuracy, sync round FSM vs async vs hierarchical.
+
+The ISSUE-9 acceptance measurement: the same mnist fleet, the same seeded
+straggler/crash FaultPlan, the same total local-training budget — driven
+three ways:
+
+- ``sync``  — the barrier-synchronized round FSM (stages/learning_stages),
+- ``async`` — flat FedBuff (one global BufferedAggregator, no barrier),
+- ``hier``  — FedBuff + HierarchicalTopology (edge clusters → regional →
+  global).
+
+Each threaded row reports wall-clock to complete the budget with the
+final global model at/above the target accuracy — the async rows must
+beat the sync row on the same fleet, because the sync barrier pays the
+slow peer's inbound-weights latency (and the crash's eviction window)
+once per round while the async planes pay it only on that node's own
+contributions.
+
+The threaded fleet is small (10 real nodes), so the "10% slow / 1%
+crash" plan quantizes to 1 slow node and 1 crash; the 1k-node SIMULATED
+section runs the exact fractions through
+:class:`p2pfl_tpu.federation.simfleet.SimulatedAsyncFleet` (virtual
+clock, bit-identical replay) and compares against the sync fleet's
+analytic floor — a barrier fleet cannot finish a round faster than its
+slowest member trains.
+
+Usage: ``JAX_PLATFORMS=cpu python bench_async.py [--smoke] [--out BENCH_ASYNC.json]``
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+SEED = 1905
+TARGET_ACC = 0.80
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _fleet_settings():
+    from p2pfl_tpu.settings import Settings, set_low_latency_settings
+
+    set_low_latency_settings()
+    Settings.TRAIN_SET_SIZE = 10
+    Settings.VOTE_TIMEOUT = 30.0
+    Settings.AGGREGATION_TIMEOUT = 60.0
+    Settings.FEDBUFF_K = 4
+    Settings.FEDBUFF_ALPHA = 0.5
+    Settings.FEDBUFF_SERVER_LR = 1.0
+    Settings.ASYNC_MAX_STALENESS = 16
+    Settings.ASYNC_DRAIN_TIMEOUT = 20.0
+
+
+def _make_plan(addrs: list, slow_s: float, async_mode: bool):
+    """1 slow + 1 crash over a 10-node fleet (the small-fleet quantization
+    of the 10%/1% plan; the simulated section runs the exact fractions).
+    Deterministic: same seed, same victim indices in every mode."""
+    from p2pfl_tpu.communication.faults import CrashSpec, EdgeFault, FaultPlan
+
+    slow_addr = addrs[-1]
+    crash_addr = addrs[-2]
+    stage = "AsyncTrainStage" if async_mode else "TrainStage"
+    return FaultPlan(
+        seed=SEED,
+        default=EdgeFault(drop=0.01),
+        slow_nodes={slow_addr: slow_s},
+        crashes={crash_addr: CrashSpec(stage=stage, round_no=1)},
+    )
+
+
+def run_threaded(mode: str, *, n_nodes: int = 10, rounds: int = 4, slow_s: float = 0.5) -> dict:
+    """One fresh federation in the given mode; returns the row dict.
+
+    ``rounds`` is the per-node local-update budget in every mode (sync
+    rounds == async local updates: identical total training work).
+    """
+    from p2pfl_tpu.communication.memory import MemoryRegistry
+    from p2pfl_tpu.communication.faults import install_fault_plan, remove_fault_plan
+    from p2pfl_tpu.learning.dataset import FederatedDataset
+    from p2pfl_tpu.learning.learner import JaxLearner, eval_step
+    from p2pfl_tpu.management.logger import logger
+    from p2pfl_tpu.management.telemetry import telemetry
+    from p2pfl_tpu.models import mlp
+    from p2pfl_tpu.node import Node
+    from p2pfl_tpu.settings import Settings
+    from p2pfl_tpu.utils import full_connection, wait_convergence, wait_to_finish
+
+    MemoryRegistry.reset()
+    logger.reset_comm_metrics()
+    telemetry.reset()
+    _fleet_settings()
+    Settings.FEDERATION_MODE = "async" if mode != "sync" else "sync"
+    Settings.HIER_CLUSTER_SIZE = 4 if mode == "hier" else 0
+
+    full = FederatedDataset.synthetic_mnist(n_train=8192, n_test=2048, seed=3)
+    x_test, y_test = full.test_arrays()
+
+    # jit warm-up outside the timers (shared cache: same module/shapes)
+    warm = JaxLearner(mlp(seed=99), full.partition(0, n_nodes), batch_size=64, epochs=1)
+    warm.fused_round()
+    warm.evaluate()
+
+    nodes = []
+    for i in range(n_nodes):
+        learner = JaxLearner(mlp(seed=i), full.partition(i, n_nodes), batch_size=64)
+        nodes.append(Node(learner=learner))
+    for n in nodes:
+        n.start()
+    for n in nodes:
+        full_connection(n, nodes)
+    wait_convergence(nodes, n_nodes - 1, only_direct=True, wait=15)
+    plan = _make_plan([n.addr for n in nodes], slow_s, mode != "sync")
+    install_fault_plan(nodes, plan)
+    victim_addr = [n.addr for n in nodes][-2]
+    survivors = [n for n in nodes if n.addr != victim_addr]
+    try:
+        t0 = time.monotonic()
+        nodes[0].set_start_learning(rounds=rounds, epochs=1)
+        wait_to_finish(survivors, timeout=300)
+        wall = time.monotonic() - t0
+        # final accuracy of the fleet model (survivor consensus / latest
+        # global), evaluated on the full held-out test set
+        accs = []
+        for n in survivors:
+            _loss, acc = eval_step(
+                n.learner.get_parameters(), np.asarray(x_test), np.asarray(y_test),
+                n.learner.model.module,
+            )
+            accs.append(float(acc))
+        comm = {}
+        for d in logger.get_comm_metrics().values():
+            for k, v in d.items():
+                if k.startswith("async") or k in ("train_set_repair",):
+                    comm[k] = comm.get(k, 0) + v
+        stale = {
+            k.split("/")[0]: v
+            for k, v in telemetry.value_histograms().items()
+            if k.endswith("/staleness")
+        }
+        return {
+            "mode": mode,
+            "wall_s": round(wall, 3),
+            "final_acc_min": round(min(accs), 4),
+            "final_acc_max": round(max(accs), 4),
+            "reached_target": min(accs) >= TARGET_ACC,
+            "comm": {k: int(v) for k, v in sorted(comm.items())},
+            "staleness": stale,
+        }
+    finally:
+        remove_fault_plan(nodes)
+        for n in nodes:
+            n.stop()
+        MemoryRegistry.reset()
+
+
+def run_simulated(n: int = 1000, updates: int = 6, smoke: bool = False) -> dict:
+    """Exact 10% slow / 1% crash at 1k nodes on the virtual clock.
+
+    Time-to-loss-target is the comparison (makespan would unfairly bill
+    the async planes for stragglers finishing their own budgets after
+    the model already converged). The sync baseline is an EXACT
+    simulation of barrier rounds on the same task and population: every
+    round, all live nodes train from the global, the fleet averages all
+    of them, and the round's wall-clock is the slowest live member's
+    train duration — the barrier's defining cost.
+    """
+    from p2pfl_tpu.communication.faults import CrashSpec, EdgeFault, FaultPlan
+    from p2pfl_tpu.federation.simfleet import SimulatedAsyncFleet
+
+    if smoke:
+        n, updates = 100, 4
+    base, slow_factor = 1.0, 10.0
+    addrs = [f"sim-{i:04d}" for i in range(n)]
+    plan = FaultPlan(
+        seed=SEED,
+        default=EdgeFault(drop=0.01),
+        slow_nodes={},  # slow durations modeled via slow_frac (train time)
+        crashes={
+            a: CrashSpec(stage="AsyncTrainStage", round_no=2)
+            for a in addrs[7::100][: max(1, n // 100)]
+        },
+    )
+
+    def make_fleet(cluster_size: int) -> SimulatedAsyncFleet:
+        return SimulatedAsyncFleet(
+            n,
+            seed=SEED,
+            cluster_size=cluster_size,
+            updates_per_node=updates,
+            base_duration=base,
+            slow_frac=0.10,
+            slow_factor=slow_factor,
+            plan=plan,
+            local_lr=0.7,
+        )
+
+    # the loss target every mode must reach: 5% of the cold-start loss
+    probe = make_fleet(0)
+    dim = len(np.asarray(probe.nodes[addrs[0]].model["w"]))
+    start_loss = probe.loss_fn({"w": np.zeros(dim, np.float32)})
+    target = float(start_loss) * 0.05
+
+    def drive(cluster_size: int) -> dict:
+        fleet = make_fleet(cluster_size)
+        fleet.target_loss = target
+        res = fleet.run()
+        return {
+            "time_to_target_s": round(res.time_to_target, 3) if res.time_to_target else None,
+            "makespan_virtual_s": round(res.virtual_time, 3),
+            "global_versions": res.version,
+            "merges": res.merges,
+            "updates_sent": res.updates_sent,
+            "updates_dropped_wire": res.updates_dropped_wire,
+            "crashed": len(res.crashed),
+            "final_loss": round(res.final_loss(), 5),
+        }
+
+    def sync_baseline() -> dict:
+        """Exact barrier rounds on the same task/population/faults."""
+        params = {"w": np.zeros(dim, np.float32)}
+        durations = {a: probe.nodes[a].duration for a in addrs}
+        weights = {a: probe.nodes[a].num_samples for a in addrs}
+        crashed = set()
+        t, rounds, t_target = 0.0, 0, None
+        loss = float(start_loss)
+        while rounds < updates:
+            live = [a for a in addrs if a not in crashed]
+            trained, w = [], []
+            for a in live:
+                node = probe.nodes[a]
+                rng = np.random.default_rng([SEED, 13, node.idx, rounds])
+                trained.append(np.asarray(
+                    probe.train_fn(node.idx, params, rng)["w"], np.float32))
+                w.append(float(weights[a]))
+            w = np.asarray(w, np.float32)
+            params = {"w": (w[:, None] * np.stack(trained)).sum(0) / w.sum()}
+            t += max(durations[a] for a in live)  # the barrier
+            rounds += 1
+            loss = float(probe.loss_fn(params))
+            if t_target is None and loss <= target:
+                t_target = t
+            if rounds == 2:  # same crash schedule as the async plan
+                crashed |= set(plan.crashes)
+        return {
+            "time_to_target_s": round(t_target, 3) if t_target else None,
+            "rounds": rounds,
+            "final_loss": round(loss, 5),
+        }
+
+    flat = drive(0)
+    hier = drive(32)
+    sync = sync_baseline()
+
+    def speedup(row):
+        if row["time_to_target_s"] and sync["time_to_target_s"]:
+            return round(sync["time_to_target_s"] / row["time_to_target_s"], 2)
+        return None
+
+    return {
+        "n_nodes": n,
+        "updates_per_node": updates,
+        "plan": {"slow_frac": 0.10, "slow_factor": slow_factor, "crash_frac": 0.01,
+                 "drop": 0.01, "seed": SEED},
+        "start_loss": round(float(start_loss), 5),
+        "target_loss": round(target, 5),
+        "sync_barrier": sync,
+        "async_flat": flat,
+        "hier_cluster32": hier,
+        "speedup_vs_sync": {
+            "async_flat": speedup(flat),
+            "hier_cluster32": speedup(hier),
+        },
+    }
+
+
+def main() -> int:
+    smoke = "--smoke" in sys.argv
+    out_path = "BENCH_ASYNC.json"
+    if "--out" in sys.argv:
+        out_path = sys.argv[sys.argv.index("--out") + 1]
+
+    rows = []
+    for mode in ("sync", "async", "hier"):
+        log(f"=== threaded {mode} ===")
+        row = run_threaded(mode, rounds=2 if smoke else 4)
+        log(json.dumps(row))
+        rows.append(row)
+    sync_wall = next(r["wall_s"] for r in rows if r["mode"] == "sync")
+    for r in rows:
+        r["speedup_vs_sync"] = round(sync_wall / r["wall_s"], 2)
+
+    log("=== simulated 1k ===")
+    simulated = run_simulated(smoke=smoke)
+
+    doc = {
+        "bench": "async_federation_time_to_accuracy",
+        "fleet": {
+            "n_nodes": 10, "rounds": 2 if smoke else 4, "epochs": 1,
+            "model": "mnist mlp (synthetic_mnist 8192/2048)",
+            "plan": "seed=1905: 1 slow node (0.5s inbound weights), 1 crash "
+                    "(round 1), 1% drop — small-fleet quantization of 10%/1%",
+            "target_acc": TARGET_ACC,
+            "budget_note": "rounds == async local updates: identical total "
+                           "local training in every mode",
+        },
+        "threaded": rows,
+        "simulated_1k": simulated,
+        "smoke": smoke,
+    }
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    log(f"wrote {out_path}")
+    print(json.dumps({"metric": "bench_async", **{r['mode']: r['wall_s'] for r in rows},
+                      "speedups": {r['mode']: r['speedup_vs_sync'] for r in rows}}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1956,8 +1956,39 @@ def config10_moe_scale() -> None:
     })
 
 
+def config_async_federation() -> None:
+    """ISSUE 9 row: sync round FSM vs async FedBuff vs hierarchical on the
+    mnist fleet under the seeded straggler/crash plan (full measurement +
+    JSON artifact live in ``bench_async.py`` / BENCH_ASYNC.json; this row
+    is the suite-resident summary and CI guard)."""
+    if jax.default_backend() != "cpu":
+        _reexec("async", timeout=900)
+        return
+    import bench_async
+
+    rows = [bench_async.run_threaded(m, rounds=2) for m in ("sync", "async", "hier")]
+    sync_wall = next(r["wall_s"] for r in rows if r["mode"] == "sync")
+    emit(
+        {
+            "metric": "async_federation_time_to_target",
+            "provenance": "synthetic mnist, 10 nodes, seeded 1-slow/1-crash plan "
+            "(bench_async.py; BENCH_ASYNC.json has the full row + 1k-node sim)",
+            "target_acc": bench_async.TARGET_ACC,
+            "rows": {
+                r["mode"]: {
+                    "wall_s": r["wall_s"],
+                    "reached_target": r["reached_target"],
+                    "speedup_vs_sync": round(sync_wall / r["wall_s"], 2),
+                }
+                for r in rows
+            },
+        }
+    )
+
+
 CONFIGS = {
     "1": config1_mnist_2node,
+    "async": config_async_federation,
     "2": config2_resnet18_8node,
     "3": config3_resnet50_64node_dirichlet,
     "4": config4_byzantine_robust,

@@ -19,6 +19,11 @@ from p2pfl_tpu.commands.control import (
     SecAggShareCommand,
     VoteTrainSetCommand,
 )
+from p2pfl_tpu.commands.federation import (
+    AsyncDoneCommand,
+    AsyncModelCommand,
+    AsyncUpdateCommand,
+)
 from p2pfl_tpu.commands.heartbeat import HeartbeatCommand
 from p2pfl_tpu.commands.learning import (
     AddModelCommand,
@@ -28,6 +33,9 @@ from p2pfl_tpu.commands.learning import (
 )
 
 __all__ = [
+    "AsyncDoneCommand",
+    "AsyncModelCommand",
+    "AsyncUpdateCommand",
     "Command",
     "HeartbeatCommand",
     "StartLearningCommand",

@@ -1,0 +1,135 @@
+"""Async-federation commands: update push, model push, done announcement.
+
+The async control plane's wire verbs (``federation/workflow.py``):
+
+- ``async_update`` (weights plane) — a node's training update, or a
+  regional's merged aggregate, pushed to the next aggregation tier up;
+- ``async_model`` (weights plane) — a freshly minted global model pushed
+  down the tiers;
+- ``async_done`` (control plane, TTL-flooded) — a node announcing its
+  local update budget is spent, releasing aggregators' drain waits.
+
+Both weights handlers drop (never stop the node) on malformed payloads:
+an async fleet is long-running by design, and one garbage frame from a
+flaky peer must not take an *aggregator* down with it — the sync plane's
+stop-on-decode-failure matches its initiator-seeded trust model, not this
+one. Drops are loud (``async_decode_fail`` metric + error log).
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+from p2pfl_tpu.commands.command import Command
+from p2pfl_tpu.exceptions import DecodingParamsError, ModelNotMatchingError
+from p2pfl_tpu.learning.weights import ModelUpdate
+from p2pfl_tpu.management.logger import logger
+
+if TYPE_CHECKING:
+    from p2pfl_tpu.node import Node
+
+
+def materialize_or_drop(node: "Node", update: ModelUpdate, cmd: str):
+    """Decode a wire payload, or None (counted + logged) when malformed."""
+    try:
+        if update.params is None:
+            update = node.learner.materialize(update)
+        return update
+    except (DecodingParamsError, ModelNotMatchingError) as exc:
+        logger.log_comm_metric(node.addr, "async_decode_fail")
+        logger.error(node.addr, f"{cmd} decode failed: {exc} — dropped")
+        return None
+
+
+def drain_async_stash(node: "Node", ctx) -> None:
+    """Feed every stashed early async_update into the context — the ONE
+    drain routine (the workflow's post-install drain and the command
+    side's race-close both call it; ``take_async_stash`` pops atomically,
+    so each entry is processed exactly once whichever side wins)."""
+    for early in node.take_async_stash():
+        early = materialize_or_drop(node, early, "async_update(stash)")
+        if early is not None:
+            ctx.execute_actions(ctx.handle_update(early))
+
+
+class AsyncUpdateCommand(Command):
+    """A contribution arriving at an aggregation tier → buffer offer."""
+
+    def __init__(self, node: "Node") -> None:
+        self._node = node
+
+    @staticmethod
+    def get_name() -> str:
+        return "async_update"
+
+    def execute(self, source: str, round: int, *args, update: ModelUpdate = None, **kwargs) -> None:  # noqa: A002
+        node = self._node
+        ctx = node.async_ctx
+        if ctx is None:
+            if node.learning_active():
+                # a fast edge's update beat this aggregator's context
+                # creation (it is still in init gossip / topology
+                # derivation): stash for the workflow to drain — the async
+                # twin of the early-init stash
+                node.stash_async_update(update)
+                logger.log_comm_metric(node.addr, "async_update_stashed")
+                # close the install race: if the context landed between our
+                # None-read and the stash append, the workflow's one-shot
+                # drain may already have run — drain again ourselves
+                ctx = node.async_ctx
+                if ctx is not None and ctx.accepting:
+                    drain_async_stash(node, ctx)
+                return
+            logger.log_comm_metric(node.addr, "async_update_dropped")
+            logger.debug(node.addr, f"async_update from {source} with no async context — dropped")
+            return
+        if not ctx.accepting:
+            logger.log_comm_metric(node.addr, "async_update_dropped")
+            return
+        update = materialize_or_drop(node, update, "async_update")
+        if update is None:
+            return
+        # handlers run on whatever thread delivered the message; the
+        # context computes under its locks and returns the sends, which
+        # run here OUTSIDE every lock (deadlock contract — workflow docs)
+        ctx.execute_actions(ctx.handle_update(update))
+
+
+class AsyncModelCommand(Command):
+    """A fresh global model pushed down a tier → adopt + forward."""
+
+    def __init__(self, node: "Node") -> None:
+        self._node = node
+
+    @staticmethod
+    def get_name() -> str:
+        return "async_model"
+
+    def execute(self, source: str, round: int, *args, update: ModelUpdate = None, **kwargs) -> None:  # noqa: A002
+        node = self._node
+        ctx = node.async_ctx
+        if ctx is None or not ctx.accepting:
+            logger.log_comm_metric(node.addr, "async_model_dropped")
+            return
+        update = materialize_or_drop(node, update, "async_model")
+        if update is None:
+            return
+        ctx.execute_actions(ctx.handle_model(update, source))
+
+
+class AsyncDoneCommand(Command):
+    """Peer spent its local update budget (TTL-flooded announcement)."""
+
+    def __init__(self, state) -> None:  # NodeState
+        self._state = state
+
+    @staticmethod
+    def get_name() -> str:
+        return "async_done"
+
+    def execute(self, source: str, round: int, *args, **kwargs) -> None:  # noqa: A002
+        st = self._state
+        # monotone set-union under the same merge lock as the other
+        # control-plane lattices; cleared at experiment boundaries
+        with st.status_merge_lock:
+            st.async_done_peers.add(source)

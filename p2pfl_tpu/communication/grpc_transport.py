@@ -100,6 +100,11 @@ def encode_weights(env: WeightsEnvelope) -> bytes:
     }
     if env.trace_ctx is not None:
         d["tc"] = list(env.trace_ctx)  # optional — see encode_message
+    if env.update.version is not None:
+        # async-federation version triple (origin, seq, base_version) —
+        # optional like "tc": absent on sync senders, ignored by old
+        # receivers; the protobuf interop schema never carries it
+        d["vv"] = list(env.update.version)
     header = json.dumps(d).encode()
     return b"".join((len(header).to_bytes(4, "little"), header, env.update.encode()))
 
@@ -107,11 +112,13 @@ def encode_weights(env: WeightsEnvelope) -> bytes:
 def decode_weights(data: bytes) -> WeightsEnvelope:
     hlen = int.from_bytes(data[:4], "little")
     d = json.loads(data[4 : 4 + hlen].decode())
+    vv = d.get("vv")
     update = ModelUpdate(
         params=None,
         contributors=list(d["contributors"]),
         num_samples=int(d["num_samples"]),
         encoded=data[4 + hlen :],
+        version=(str(vv[0]), int(vv[1]), int(vv[2])) if vv else None,
     )
     return WeightsEnvelope(
         d["src"], d["round"], d["cmd"], update, d["id"], trace_ctx=_trace_ctx(d)

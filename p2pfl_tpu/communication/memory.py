@@ -129,6 +129,7 @@ class InMemoryProtocol(CommunicationProtocol):
                         contributors=list(env.update.contributors),
                         num_samples=env.update.num_samples,
                         encoded=env.update.encode(),
+                        version=env.update.version,
                     )
                     env = WeightsEnvelope(
                         env.source, env.round, env.cmd, wire, env.msg_id,

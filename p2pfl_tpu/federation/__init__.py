@@ -1,0 +1,41 @@
+"""Async bounded-staleness federation (ROADMAP item 3).
+
+The sync round FSM (``stages/learning_stages.py``) advances at the speed
+of the slowest peer — every round is a barrier, which is why PR 5 had to
+grow repair machinery. This package is the control plane that advances at
+the speed of the **median** instead:
+
+- :mod:`~p2pfl_tpu.federation.staleness` — the staleness weight
+  ``w(τ) = 1/(1+τ)^α`` and per-node version vectors (dedup + staleness
+  with no global clock);
+- :mod:`~p2pfl_tpu.federation.buffer` — the FedBuff-style
+  :class:`BufferedAggregator` (Nguyen et al., AISTATS 2022): apply
+  contributions as they arrive, merge once K are buffered;
+- :mod:`~p2pfl_tpu.federation.topology` — :class:`HierarchicalTopology`
+  (HierFAVG, Liu et al., ICC 2020): edge clusters → elected regional
+  aggregators → a global tier;
+- :mod:`~p2pfl_tpu.federation.workflow` — the async learning workflow
+  real nodes run when ``Settings.FEDERATION_MODE == "async"`` (selected
+  in ``Node._run_learning``; all sends ride the ``_do_send`` seam, so
+  FaultPlan, retries, breakers and telemetry wrap it for free);
+- :mod:`~p2pfl_tpu.federation.simfleet` — a deterministic event-driven
+  fleet simulator (1k–10k virtual nodes, virtual clock) for scale drives
+  and bit-identical replay tests.
+"""
+
+from p2pfl_tpu.federation.buffer import BufferedAggregator
+from p2pfl_tpu.federation.simfleet import FleetResult, SimulatedAsyncFleet
+from p2pfl_tpu.federation.staleness import UpdateVersion, VersionVector, staleness_weight
+from p2pfl_tpu.federation.topology import HierarchicalTopology
+from p2pfl_tpu.federation.workflow import AsyncLearningWorkflow
+
+__all__ = [
+    "AsyncLearningWorkflow",
+    "BufferedAggregator",
+    "FleetResult",
+    "HierarchicalTopology",
+    "SimulatedAsyncFleet",
+    "UpdateVersion",
+    "VersionVector",
+    "staleness_weight",
+]

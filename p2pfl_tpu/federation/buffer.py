@@ -1,0 +1,248 @@
+"""FedBuff-style buffered aggregation: merge when K arrive, weight by age.
+
+The sync :class:`~p2pfl_tpu.learning.aggregators.aggregator.Aggregator`
+opens a *collection window* per round and blocks until a coverage target
+is met — the barrier that lets one straggler gate the fleet. The
+:class:`BufferedAggregator` has no window and no target: contributions are
+accepted **as they arrive** (deduped by a version vector, down-weighted by
+staleness, dropped past the staleness bound), and once ``K`` are buffered
+the global model advances one version:
+
+    P̄      = Σᵢ wᵢ·paramsᵢ / Σᵢ wᵢ        wᵢ = num_samplesᵢ · w(τᵢ)
+    global ← (1−η)·global + η·P̄            (``ops/aggregation.server_merge``)
+
+Nobody ever waits: a slow node's update merges late (with a smaller
+weight) into whatever version the fleet has reached meanwhile.
+
+Determinism contract: given the same *sequence* of ``offer``/``set_global``
+calls, results are bit-identical — the flush sorts its buffer by
+``(origin, seq)`` so the fold order never depends on arrival interleaving
+within a buffer window, and the reduction is the same jitted kernel every
+time. The event-driven :mod:`~p2pfl_tpu.federation.simfleet` makes the
+call sequence itself a pure function of the seed, which is what the
+replay tests pin.
+
+Thread-safe: command handlers deliver from whatever thread carries the
+message (sender gossip workers, duplicate timers). The internal lock is
+never held across anything that can send — flush results are *returned*
+and the caller propagates them outside the lock (lock-ordering with peers'
+handlers would otherwise deadlock the in-memory transport's synchronous
+delivery chains).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, List, NamedTuple, Optional, Tuple
+
+from p2pfl_tpu.federation.staleness import (
+    UpdateVersion,
+    VersionVector,
+    as_version,
+    staleness_weight,
+)
+from p2pfl_tpu.learning.weights import ModelUpdate
+from p2pfl_tpu.management.logger import logger
+from p2pfl_tpu.management.telemetry import telemetry
+from p2pfl_tpu.settings import Settings
+
+Pytree = Any
+
+
+class FlushResult(NamedTuple):
+    """One merge's outcome, handed to the caller for propagation."""
+
+    params: Pytree  #: the post-merge model
+    version: int  #: this tier's model version after the merge
+    contributors: List[str]  #: union of the merged updates' contributors
+    num_samples: int  #: summed RAW sample counts (pre-staleness-discount)
+    taus: List[int]  #: per-merged-update staleness, fold order
+
+
+class BufferedAggregator:
+    """Bounded-staleness buffer around one model tier.
+
+    ``bump_on_flush`` distinguishes the two tiers of the hierarchy:
+
+    - the **global** tier owns the version counter — every flush IS a new
+      global version (``bump_on_flush=True``, the default);
+    - a **regional** tier merges its cluster's updates but its version is
+      the *global* version it tracks via :meth:`set_global` — a regional
+      flush produces an aggregate to push upward, not a new global
+      (``bump_on_flush=False``), so edge staleness is still measured in
+      global versions end to end.
+    """
+
+    def __init__(
+        self,
+        node_name: str,
+        params: Pytree,
+        *,
+        k: Optional[int] = None,
+        alpha: Optional[float] = None,
+        server_lr: Optional[float] = None,
+        max_staleness: Optional[int] = None,
+        bump_on_flush: bool = True,
+    ) -> None:
+        self.node_name = node_name
+        self.k = max(1, int(Settings.FEDBUFF_K if k is None else k))
+        self.alpha = float(Settings.FEDBUFF_ALPHA if alpha is None else alpha)
+        self.server_lr = float(
+            Settings.FEDBUFF_SERVER_LR if server_lr is None else server_lr
+        )
+        self.max_staleness = int(
+            Settings.ASYNC_MAX_STALENESS if max_staleness is None else max_staleness
+        )
+        self.bump_on_flush = bump_on_flush
+        self._lock = threading.Lock()
+        self._params = params
+        self._version = 0
+        self._vv = VersionVector()
+        # buffered (version triple, update, effective weight, accept-time
+        # staleness) — flushed in (origin, seq) order, NOT arrival order
+        # (determinism contract)
+        self._pending: List[Tuple[UpdateVersion, ModelUpdate, float, int]] = []
+        self.merges = 0
+
+    # ---- views ----
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def snapshot(self) -> Tuple[Pytree, int]:
+        """The current ``(params, version)`` pair, atomically."""
+        with self._lock:
+            return self._params, self._version
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def version_vector(self) -> dict:
+        return self._vv.snapshot()
+
+    # ---- upstream adoption (regional tiers / restarts) ----
+
+    def set_global(self, params: Pytree, version: int) -> bool:
+        """Adopt a newer upstream global. Returns False for stale pushes.
+
+        Buffered-but-unflushed contributions are kept: their staleness
+        simply grows (and the bound may later drop them) — exactly the
+        semantics their producers signed up for.
+        """
+        with self._lock:
+            if version <= self._version:
+                return False
+            self._params = params
+            self._version = version
+            return True
+
+    # ---- the hot path ----
+
+    def offer(self, update: ModelUpdate) -> Optional[FlushResult]:
+        """Accept a contribution; returns a :class:`FlushResult` when this
+        acceptance completed a buffer of K, else None.
+
+        Rejections (all counted in the comm metrics, never raising):
+
+        - ``async_dup_drop`` — the version vector already saw an equal or
+          newer ``(origin, seq)`` (duplicate / reordered delivery);
+        - ``async_stale_drop`` — ``τ > max_staleness`` (bounded
+          staleness: too old to merge at any weight).
+
+        An update with no version triple (a sync-mode producer poking the
+        buffer directly) is treated as fresh from its first contributor
+        with an auto-assigned seq — counted ``async_unversioned`` so a
+        misconfigured fleet is visible in the metrics.
+        """
+        ver = as_version(update.version)
+        with self._lock:
+            if ver is None:
+                origin = update.contributors[0] if update.contributors else "?"
+                ver = UpdateVersion(origin, self._vv.last(origin) + 1, self._version)
+                logger.log_comm_metric(self.node_name, "async_unversioned")
+            if not self._vv.observe(ver.origin, ver.seq):
+                logger.log_comm_metric(self.node_name, "async_dup_drop")
+                telemetry.event(
+                    self.node_name,
+                    "async_dup_drop",
+                    kind="gossip",
+                    attrs={"origin": ver.origin, "seq": ver.seq},
+                )
+                return None
+            tau = max(self._version - ver.base_version, 0)
+            if tau > self.max_staleness:
+                logger.log_comm_metric(self.node_name, "async_stale_drop")
+                telemetry.event(
+                    self.node_name,
+                    "async_stale_drop",
+                    kind="gossip",
+                    attrs={"origin": ver.origin, "tau": tau},
+                )
+                return None
+            weight = float(update.num_samples) * staleness_weight(tau, self.alpha)
+            self._pending.append((ver, update, weight, tau))
+            logger.log_comm_metric(self.node_name, "async_update_buffered")
+            result = self._maybe_flush_locked()
+        return self._finish_flush(result)
+
+    def set_k(self, k: int) -> Optional[FlushResult]:
+        """Adjust the buffer size mid-run — the eviction repair hook.
+
+        A tier's K is clamped to its fan-in at creation, but members die:
+        a cluster of 3 with K=3 and one corpse would never flush again —
+        the async twin of the sync plane's mid-round train-set repair.
+        The workflow's eviction listener shrinks K to the live fan-in;
+        if the buffer already holds that many, the merge fires HERE and
+        the result is returned for propagation.
+        """
+        with self._lock:
+            self.k = max(1, int(k))
+            result = self._maybe_flush_locked()
+        return self._finish_flush(result)
+
+    def _maybe_flush_locked(self) -> Optional[FlushResult]:
+        if len(self._pending) < self.k:
+            return None
+        entries = sorted(self._pending, key=lambda e: (e[0].origin, e[0].seq))
+        self._pending = []
+        return self._merge_locked(entries)
+
+    def _finish_flush(self, result: Optional[FlushResult]) -> Optional[FlushResult]:
+        if result is None:
+            return None
+        # telemetry outside the lock: the staleness histogram is fed per
+        # MERGED update (drops counted separately in offer)
+        for tau in result.taus:
+            telemetry.observe_value(self.node_name, "staleness", tau)
+        logger.log_comm_metric(self.node_name, "async_merge")
+        return result
+
+    def _merge_locked(self, entries) -> FlushResult:
+        import jax
+        import jax.numpy as jnp
+
+        from p2pfl_tpu.ops.aggregation import fedavg, server_merge
+
+        with telemetry.span(
+            self.node_name,
+            "async_merge",
+            kind="stage",
+            attrs={"k": len(entries), "version": self._version},
+        ):
+            weights = jnp.asarray([w for _v, _u, w, _t in entries], dtype="float32")
+            params_list = [u.params for _v, u, _w, _t in entries]
+            stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *params_list)
+            avg = fedavg(stacked, weights, agg_dtype=Settings.AGG_DTYPE)
+            self._params = server_merge(
+                self._params, avg, lr=self.server_lr, agg_dtype=Settings.AGG_DTYPE
+            )
+            if self.bump_on_flush:
+                self._version += 1
+            self.merges += 1
+            contributors = sorted({c for _v, u, _w, _t in entries for c in u.contributors})
+            num_samples = int(sum(u.num_samples for _v, u, _w, _t in entries))
+            taus = [t for _v, _u, _w, t in entries]
+            return FlushResult(self._params, self._version, contributors, num_samples, taus)

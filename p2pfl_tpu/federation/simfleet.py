@@ -1,0 +1,388 @@
+"""Deterministic event-driven async-fleet simulator (1k–10k virtual nodes).
+
+Real threaded nodes cannot replay bit-identically — the OS scheduler
+decides which K updates share a buffer window. This driver replaces
+threads with a **virtual clock**: every train completion, update arrival
+and model push is an event on one heap, popped in ``(time, insertion
+seq)`` order, so the entire run — including which updates land in which
+merge, every staleness value, every fault verdict — is a pure function of
+``(seed, fault plan, fleet shape)``. That purity is what the replay test
+pins (same inputs ⇒ bit-identical final global), and what makes 1k-node
+hierarchical convergence drives affordable: no sockets, no sleeps, the
+only real compute is the buffers' jitted merges.
+
+The simulated fleet shares the production plane's *state machines*: the
+same :class:`~p2pfl_tpu.federation.buffer.BufferedAggregator` instances,
+the same :class:`~p2pfl_tpu.federation.topology.HierarchicalTopology`
+derivation, the same version triples and staleness arithmetic. The
+tier-routing glue (which buffer an arrival feeds, upward stamping,
+downward forwarding) is MIRRORED from ``workflow.AsyncContext`` rather
+than shared — the threaded context is entangled with Node/transport;
+extracting a node-free routing core both drivers consume is an open
+refactor (ROADMAP 3) — so a routing change in one must be mirrored in
+the other. The transport (heap events instead of ``_do_send``) and the
+learner (a seeded consensus task instead of a jitted epoch scan) are
+deliberate stand-ins. Faults reuse :class:`FaultPlan` semantics at
+the same conceptual seam: per-edge drop/duplicate verdicts from the
+plan's per-edge streams, ``slow_nodes`` as inbound-weights latency,
+``CrashSpec(stage="AsyncTrainStage", round_no=k)`` as "dies starting its
+k-th local update".
+
+The default workload is a consensus least-squares task: node ``i`` pulls
+its model toward a seeded private target ``tᵢ``; the fleet's fixed point
+is the weighted target mean, and ``loss(global) = ‖w − t̄‖²`` measures
+convergence — enough structure to show time-to-target beating a
+barrier-synchronized fleet under stragglers, with zero ML runtime cost.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from p2pfl_tpu.federation.buffer import BufferedAggregator
+from p2pfl_tpu.federation.topology import HierarchicalTopology
+from p2pfl_tpu.learning.weights import ModelUpdate
+
+Pytree = Any
+
+
+@dataclass
+class FleetResult:
+    """What a simulated drive produced (the determinism-test surface)."""
+
+    params: Pytree  #: final global model
+    version: int  #: final global version
+    virtual_time: float  #: when the last event fired
+    time_to_target: Optional[float]  #: first global-flush time with loss < target
+    loss_curve: List[Tuple[float, int, float]]  #: (virtual t, version, loss)
+    updates_sent: int = 0
+    updates_delivered: int = 0
+    updates_dropped_wire: int = 0
+    duplicates_injected: int = 0
+    crashed: List[str] = field(default_factory=list)
+    merges: int = 0
+
+    def final_loss(self) -> float:
+        return self.loss_curve[-1][2] if self.loss_curve else float("inf")
+
+
+class _SimNode:
+    __slots__ = (
+        "addr", "idx", "model", "base_version", "known_version",
+        "pending_global", "seq", "updates_done", "crashed", "num_samples",
+        "duration",
+    )
+
+    def __init__(self, addr: str, idx: int, model: Pytree, num_samples: int, duration: float) -> None:
+        self.addr = addr
+        self.idx = idx
+        self.model = model
+        self.base_version = 0
+        self.known_version = 0
+        self.pending_global: Optional[Tuple[Pytree, int]] = None
+        self.seq = itertools.count(1)
+        self.updates_done = 0
+        self.crashed = False
+        self.num_samples = num_samples
+        self.duration = duration
+
+
+class SimulatedAsyncFleet:
+    """One simulated fleet; :meth:`run` drives it to completion.
+
+    ``train_fn(idx, params, rng) -> params`` and ``loss_fn(params) ->
+    float`` default to the consensus task. ``plan`` (a
+    :class:`~p2pfl_tpu.communication.faults.FaultPlan`) injects
+    drop/duplicate/slow/crash exactly as the threaded chaos suite would;
+    ``slow_frac``/``slow_factor`` additionally stretch a deterministic
+    subset of nodes' train durations (the straggler population the async
+    plane exists for).
+    """
+
+    def __init__(
+        self,
+        n_nodes: int,
+        *,
+        seed: int = 0,
+        cluster_size: int = 0,
+        k: Optional[int] = None,
+        alpha: Optional[float] = None,
+        server_lr: Optional[float] = None,
+        max_staleness: Optional[int] = None,
+        updates_per_node: int = 4,
+        base_duration: float = 1.0,
+        link_delay: float = 0.01,
+        slow_frac: float = 0.0,
+        slow_factor: float = 10.0,
+        plan=None,
+        dim: int = 16,
+        local_lr: float = 0.5,
+        target_loss: float = 0.0,
+        train_fn: Optional[Callable] = None,
+        loss_fn: Optional[Callable] = None,
+        init_params: Optional[Pytree] = None,
+    ) -> None:
+        self.seed = int(seed)
+        self.n = int(n_nodes)
+        self.updates_per_node = int(updates_per_node)
+        self.link_delay = float(link_delay)
+        self.plan = plan
+        self.target_loss = float(target_loss)
+        addrs = [f"sim-{i:04d}" for i in range(self.n)]
+        self.topo = HierarchicalTopology(addrs, cluster_size)
+
+        # seeded consensus task (see module docs): every node's target is
+        # a SHARED offset plus private noise — the fleet's fixed point is
+        # ≈ the offset, so a zero-initialized global has an O(dim) loss to
+        # close and "converged" is a real statement even at n=1000 (pure
+        # zero-mean targets would average to a fixed point at the origin)
+        base = np.random.default_rng([self.seed, 5]).normal(size=dim).astype(np.float32) * 2.0
+        self._targets = {
+            i: base
+            + np.random.default_rng([self.seed, 7, i]).normal(size=dim).astype(np.float32)
+            for i in range(self.n)
+        }
+        self._local_lr = float(local_lr)
+        if init_params is None:
+            init_params = {"w": np.zeros(dim, dtype=np.float32)}
+        self.train_fn = train_fn or self._default_train
+        self.loss_fn = loss_fn or self._default_loss
+
+        # per-node deterministic shape: duration jitter, slow membership,
+        # sample weights — each from its own stream, FaultPlan-style
+        self.nodes: Dict[str, _SimNode] = {}
+        for i, addr in enumerate(addrs):
+            rng = np.random.default_rng([self.seed, 11, i])
+            dur = base_duration * (0.8 + 0.4 * float(rng.random()))
+            if slow_frac > 0.0 and float(rng.random()) < slow_frac:
+                dur *= slow_factor
+            self.nodes[addr] = _SimNode(
+                addr, i, _copy_tree(init_params), 1 + i % 3, dur
+            )
+
+        kk = k
+        self._buffers: Dict[str, Dict[str, BufferedAggregator]] = {}
+        for regional in self.topo.regionals:
+            bufs: Dict[str, BufferedAggregator] = {}
+            if regional == self.topo.global_root and self.topo.is_flat():
+                bufs["global"] = BufferedAggregator(
+                    regional, _copy_tree(init_params),
+                    k=_clamp_k(kk, len(self.topo.members)), alpha=alpha,
+                    server_lr=server_lr, max_staleness=max_staleness,
+                )
+            else:
+                bufs["regional"] = BufferedAggregator(
+                    regional, _copy_tree(init_params),
+                    k=_clamp_k(kk, len(self.topo.cluster_of(regional))), alpha=alpha,
+                    server_lr=server_lr, max_staleness=max_staleness,
+                    bump_on_flush=False,
+                )
+                if regional == self.topo.global_root:
+                    bufs["global"] = BufferedAggregator(
+                        regional, _copy_tree(init_params),
+                        k=_clamp_k(kk, len(self.topo.regionals)), alpha=alpha,
+                        server_lr=server_lr, max_staleness=max_staleness,
+                    )
+            self._buffers[regional] = bufs
+        self._up_seq = {r: itertools.count(1) for r in self.topo.regionals}
+
+        # event heap: (time, insertion seq, kind, payload) — the seq makes
+        # pop order total and therefore the whole run deterministic
+        self._heap: list = []
+        self._evseq = itertools.count()
+        self.result = FleetResult(
+            params=_copy_tree(init_params), version=0, virtual_time=0.0,
+            time_to_target=None, loss_curve=[],
+        )
+
+    # ---- default workload ----
+
+    def _default_train(self, idx: int, params: Pytree, rng: np.random.Generator) -> Pytree:
+        t = self._targets[idx]
+        w = params["w"]
+        return {"w": (w + self._local_lr * (t - np.asarray(w, np.float32))).astype(np.float32)}
+
+    def _default_loss(self, params: Pytree) -> float:
+        weights = np.asarray([self.nodes[a].num_samples for a in self.topo.members], np.float32)
+        targets = np.stack([self._targets[self.nodes[a].idx] for a in self.topo.members])
+        t_mean = (weights[:, None] * targets).sum(0) / weights.sum()
+        diff = np.asarray(params["w"], np.float32) - t_mean
+        return float(diff @ diff)
+
+    # ---- fault plumbing (FaultPlan semantics on the virtual wire) ----
+
+    def _edge_verdict(self, src: str, dst: str) -> Tuple[bool, bool, float]:
+        """(dropped, duplicated, extra inbound latency) for one delivery."""
+        slow = 0.0
+        if self.plan is None:
+            return False, False, slow
+        slow = float(self.plan.slow_nodes.get(dst, 0.0))
+        if self.plan.partitioned(src, dst):
+            return True, False, slow
+        fault = self.plan.edge_fault(src, dst)
+        rng = self.plan.rng(src, dst)
+        drop_u, dup_u, _jit_u = rng.random(), rng.random(), rng.random()
+        dropped = bool(fault.drop) and drop_u < fault.drop
+        dup = (not dropped) and bool(fault.duplicate) and dup_u < fault.duplicate
+        return dropped, dup, slow + fault.delay
+
+    def _crash_spec(self, addr: str):
+        if self.plan is None:
+            return None
+        return self.plan.crashes.get(addr)
+
+    # ---- event loop ----
+
+    def _push(self, t: float, kind: str, payload: tuple) -> None:
+        heapq.heappush(self._heap, (t, next(self._evseq), kind, payload))
+
+    def run(self) -> FleetResult:
+        for addr, node in self.nodes.items():
+            self._push(node.duration, "train_done", (addr,))
+        while self._heap:
+            t, _seq, kind, payload = heapq.heappop(self._heap)
+            self.result.virtual_time = t
+            if kind == "train_done":
+                self._on_train_done(t, *payload)
+            elif kind == "update_arrive":
+                self._on_update_arrive(t, *payload)
+            elif kind == "model_arrive":
+                self._on_model_arrive(t, *payload)
+        gbuf = self._buffers[self.topo.global_root].get("global")
+        if gbuf is not None:
+            self.result.params, self.result.version = gbuf.snapshot()
+            self.result.merges = gbuf.merges
+        return self.result
+
+    def _on_train_done(self, t: float, addr: str) -> None:
+        node = self.nodes[addr]
+        if node.crashed:
+            return
+        spec = self._crash_spec(addr)
+        if (
+            spec is not None
+            and spec.stage == "AsyncTrainStage"
+            and (spec.round_no is None or spec.round_no == node.updates_done)
+        ):
+            node.crashed = True
+            self.result.crashed.append(addr)
+            return
+        # adopt the freshest global that arrived while "training"
+        if node.pending_global is not None:
+            params, version = node.pending_global
+            node.model = params
+            node.base_version = version
+            node.pending_global = None
+        rng = np.random.default_rng([self.seed, 13, node.idx, node.updates_done])
+        node.model = self.train_fn(node.idx, node.model, rng)
+        node.updates_done += 1
+        upd = ModelUpdate(_copy_tree(node.model), [addr], node.num_samples)
+        upd.version = (addr, next(node.seq), node.base_version)
+        self.result.updates_sent += 1
+        target = self.topo.aggregator_for(addr)
+        self._deliver_update(t, addr, target, upd)
+        if node.updates_done < self.updates_per_node:
+            self._push(t + node.duration, "train_done", (addr,))
+
+    def _deliver_update(self, t: float, src: str, dst: str, upd: ModelUpdate) -> None:
+        if src == dst:
+            self._push(t, "update_arrive", (dst, upd))
+            return
+        dropped, dup, extra = self._edge_verdict(src, dst)
+        if dropped:
+            self.result.updates_dropped_wire += 1
+            return
+        self._push(t + self.link_delay + extra, "update_arrive", (dst, upd))
+        if dup:
+            self.result.duplicates_injected += 1
+            fault = self.plan.edge_fault(src, dst)
+            self._push(
+                t + self.link_delay + extra + max(fault.duplicate_delay, 1e-6),
+                "update_arrive",
+                (dst, upd),
+            )
+
+    def _on_update_arrive(self, t: float, dst: str, upd: ModelUpdate) -> None:
+        if self.nodes[dst].crashed:
+            return
+        bufs = self._buffers.get(dst)
+        if bufs is None:
+            return  # mis-route: only aggregators hold buffers
+        self.result.updates_delivered += 1
+        origin = str(upd.version[0]) if upd.version else ""
+        if "global" in bufs and (
+            self.topo.is_flat() or (origin in self.topo.regionals and origin != dst)
+        ):
+            res = bufs["global"].offer(upd)
+            if res:
+                self._on_global_flush(t, res)
+            return
+        res = bufs["regional"].offer(upd)
+        if res:
+            up = ModelUpdate(res.params, res.contributors, res.num_samples)
+            up.version = (dst, next(self._up_seq[dst]), res.version)
+            if dst == self.topo.global_root:
+                gres = bufs["global"].offer(up)
+                if gres:
+                    self._on_global_flush(t, gres)
+            else:
+                self._deliver_update(t, dst, self.topo.global_root, up)
+
+    def _on_global_flush(self, t: float, res) -> None:
+        loss = float(self.loss_fn(res.params))
+        self.result.loss_curve.append((t, res.version, loss))
+        if self.result.time_to_target is None and loss <= self.target_loss:
+            self.result.time_to_target = t
+        root = self.topo.global_root
+        self._adopt(t, root, res.params, res.version, forward=False)
+        for child in self.topo.children_of(root):
+            self._deliver_model(t, root, child, res.params, res.version)
+
+    def _deliver_model(self, t: float, src: str, dst: str, params: Pytree, version: int) -> None:
+        dropped, dup, extra = self._edge_verdict(src, dst)
+        if dropped:
+            return
+        self._push(t + self.link_delay + extra, "model_arrive", (dst, params, version, src))
+        if dup:
+            fault = self.plan.edge_fault(src, dst)
+            self._push(
+                t + self.link_delay + extra + max(fault.duplicate_delay, 1e-6),
+                "model_arrive",
+                (dst, params, version, src),
+            )
+
+    def _on_model_arrive(self, t: float, dst: str, params: Pytree, version: int, src: str) -> None:
+        self._adopt(t, dst, params, version, forward=True, source=src)
+
+    def _adopt(
+        self, t: float, addr: str, params: Pytree, version: int,
+        forward: bool, source: Optional[str] = None,
+    ) -> None:
+        node = self.nodes[addr]
+        if node.crashed or version <= node.known_version:
+            return
+        node.known_version = version
+        node.pending_global = (params, version)
+        bufs = self._buffers.get(addr)
+        if bufs is not None and "regional" in bufs:
+            bufs["regional"].set_global(params, version)
+        if forward:
+            for child in self.topo.children_of(addr):
+                if child != source:
+                    self._deliver_model(t, addr, child, params, version)
+
+
+def _copy_tree(tree: Pytree) -> Pytree:
+    return {k: np.array(v, copy=True) for k, v in tree.items()}
+
+
+def _clamp_k(k: Optional[int], fan_in: int):
+    from p2pfl_tpu.settings import Settings
+
+    base = Settings.FEDBUFF_K if k is None else int(k)
+    return max(1, min(base, fan_in))

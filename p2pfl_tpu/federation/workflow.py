@@ -1,0 +1,572 @@
+"""The async learning workflow: train → push → merge, no round barrier.
+
+Selected by ``Settings.FEDERATION_MODE == "async"`` in
+``Node._run_learning`` — the learning thread runs this instead of the
+stages FSM. Control flow per node:
+
+1. **Init sync** — identical to the sync plane
+   (``stages.learning_stages.sync_initial_model``): everyone starts from
+   the initiator's weights, version 0.
+2. **Topology** — every node derives the same
+   :class:`~p2pfl_tpu.federation.topology.HierarchicalTopology` from the
+   sorted overlay membership (``Settings.HIER_CLUSTER_SIZE``).
+3. **Local loop** — each node trains ``total_rounds`` local updates
+   (reusing the fused-round learner path where the learner supports it),
+   stamps each with its version triple, and pushes it to its cluster's
+   regional aggregator. Between updates it adopts the freshest global
+   model that arrived (``async_model`` push) — it never *waits* for one.
+4. **Aggregation duties** — regional/global buffers
+   (:class:`~p2pfl_tpu.federation.buffer.BufferedAggregator`) run inside
+   the receive handlers (``commands/federation.py``): a flush at a
+   regional pushes ONE aggregate up; a flush at the global root mints a
+   new global version and pushes it down the tiers.
+5. **Drain** — a node that finished its budget broadcasts ``async_done``;
+   aggregators keep serving until every member is done or dead (bounded
+   by ``Settings.ASYNC_DRAIN_TIMEOUT``), so slow members' tails still
+   merge.
+
+Every push rides ``protocol.send`` / the gossiper's concurrent dispatch
+pool over the single ``_do_send`` seam — FaultPlan chaos, breaker-fed
+eviction, retry accounting and telemetry send spans all apply unchanged.
+Fan-outs (a fresh global to N children) go through
+``Gossiper._dispatch_sends`` so one slow child costs a worker slot, not
+the push.
+
+Not composed in this control plane (guarded loudly at start):
+``SECURE_AGGREGATION`` (pairwise masks need a fixed cohort per merge —
+a buffer of whoever-arrived breaks cancellation) and
+``WIRE_COMPRESSION="topk8"`` (delta anchors are pinned per sync round;
+the async plane has no shared round to anchor on). Dense and ``int8``
+wire compression work as-is.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import TYPE_CHECKING, Any, List, Optional, Tuple
+
+from p2pfl_tpu.federation.buffer import BufferedAggregator, FlushResult
+from p2pfl_tpu.federation.staleness import as_version
+from p2pfl_tpu.federation.topology import HierarchicalTopology
+from p2pfl_tpu.learning.weights import ModelUpdate
+from p2pfl_tpu.management.logger import logger
+from p2pfl_tpu.management.telemetry import telemetry
+from p2pfl_tpu.settings import Settings
+
+if TYPE_CHECKING:
+    from p2pfl_tpu.node import Node
+
+Pytree = Any
+
+#: outbound action: (weights command, target address, update)
+Action = Tuple[str, str, ModelUpdate]
+
+
+
+
+class AsyncContext:
+    """Per-experiment async state attached to the node (``node.async_ctx``).
+
+    Owns the node's aggregation buffers (by topology role) and the
+    freshest-global mailbox. The locking contract that keeps the
+    in-memory transport's synchronous delivery chains deadlock-free:
+    **no lock is ever held across a send** — handlers compute under
+    locks, collect :data:`Action` tuples, and :meth:`execute_actions`
+    runs outside every lock.
+    """
+
+    def __init__(self, node: "Node", topo: HierarchicalTopology, params: Pytree) -> None:
+        self.node = node
+        self.topo = topo
+        self.addr = node.addr
+        self.lock = threading.Lock()
+        self.accepting = True
+        #: the newest global version this node KNOWS about (its learner
+        #: may lag until the loop adopts pending_global)
+        self.global_version = 0
+        #: the version the learner's current params came from — what the
+        #: node stamps as base_version on its next update
+        self.base_version = 0
+        self.pending_global: Optional[Tuple[Pytree, int]] = None
+        #: last adopted/minted global (params, version) — what the drain's
+        #: final-sync re-pushes carry
+        self.last_global: Optional[Tuple[Pytree, int]] = None
+        #: encode-once for drain re-pushes: one ModelUpdate per version,
+        #: reused across ticks/children so byte transports serialize the
+        #: full model once per version, not once per re-push fan-out
+        self._final_push: Optional[Tuple[int, ModelUpdate]] = None
+        #: members this node observed evicted (K-repair bookkeeping)
+        self._dead: set = set()
+        #: per-node monotone counters: training updates vs upward
+        #: regional aggregates are deduped in DIFFERENT version vectors,
+        #: but each stream must be monotone on its own
+        self.train_seq = itertools.count(1)
+        self._up_seq = itertools.count(1)
+        self.rbuf: Optional[BufferedAggregator] = None
+        self.gbuf: Optional[BufferedAggregator] = None
+        k = Settings.FEDBUFF_K
+        tier = topo.tier(node.addr)
+        if tier == "global":
+            if topo.is_flat():
+                self.gbuf = BufferedAggregator(
+                    node.addr, params, k=min(k, len(topo.members))
+                )
+            else:
+                self.rbuf = BufferedAggregator(
+                    node.addr, params, k=min(k, len(topo.cluster_of(node.addr))),
+                    bump_on_flush=False,
+                )
+                self.gbuf = BufferedAggregator(
+                    node.addr, params, k=min(k, len(topo.regionals))
+                )
+        elif tier == "regional":
+            self.rbuf = BufferedAggregator(
+                node.addr, params, k=min(k, len(topo.cluster_of(node.addr))),
+                bump_on_flush=False,
+            )
+
+    @property
+    def is_aggregator(self) -> bool:
+        return self.rbuf is not None or self.gbuf is not None
+
+    # ---- mailbox ----
+
+    def take_pending_global(self) -> Optional[Tuple[Pytree, int]]:
+        with self.lock:
+            pend, self.pending_global = self.pending_global, None
+        return pend
+
+    def _adopt(self, params: Pytree, version: int) -> bool:
+        """Record a newer global: mailbox for the learner + regional
+        buffer re-base. False for stale pushes."""
+        with self.lock:
+            if version <= self.global_version:
+                return False
+            self.global_version = version
+            self.pending_global = (params, version)
+            self.last_global = (params, version)
+        if self.rbuf is not None:
+            self.rbuf.set_global(params, version)
+        return True
+
+    # ---- receive paths (commands + local offers) ----
+
+    def handle_update(self, update: ModelUpdate) -> List[Action]:
+        """Route a contribution into the right buffer; returns the sends
+        its flush (if any) produced."""
+        if self.gbuf is not None and self.topo.is_flat():
+            res = self.gbuf.offer(update)
+            return self._global_flush(res) if res else []
+        ver = as_version(update.version)
+        if (
+            self.gbuf is not None
+            and ver is not None
+            and ver.origin != self.addr
+            and ver.origin in self.topo.regionals
+        ):
+            # a peer regional's aggregate reaching the global tier
+            res = self.gbuf.offer(update)
+            return self._global_flush(res) if res else []
+        if self.rbuf is None:
+            logger.log_comm_metric(self.addr, "async_misrouted_drop")
+            logger.debug(
+                self.addr, "async_update received by a non-aggregator — dropped"
+            )
+            return []
+        res = self.rbuf.offer(update)
+        return self._regional_flush(res) if res else []
+
+    def live_children(self) -> List[str]:
+        """This node's push-down fan-out, membership-repaired: dead
+        children are dropped, and the global root ADOPTS the edges of a
+        dead regional's cluster (they re-route their updates to the root
+        — see ``push_target`` — and must keep receiving fresh globals, or
+        a regional crash would orphan its whole cluster for the rest of
+        the run). Root failover itself stays open (ROADMAP 3)."""
+        with self.lock:
+            dead = set(self._dead)
+        children = [c for c in self.topo.children_of(self.addr) if c not in dead]
+        if self.addr == self.topo.global_root:
+            for r in self.topo.regionals:
+                if r != self.addr and r in dead:
+                    children += [
+                        m for m in self.topo.cluster_of(r) if m != r and m not in dead
+                    ]
+        return children
+
+    def push_target(self) -> str:
+        """Where this node's training updates go: its regional — or the
+        global root once that regional is known dead (the update then
+        folds into the root's own cluster buffer: the orphaned edges
+        effectively join the root's cluster)."""
+        target = self.topo.aggregator_for(self.addr)
+        if target != self.addr:
+            with self.lock:
+                if target in self._dead:
+                    return self.topo.global_root
+        return target
+
+    def handle_model(self, update: ModelUpdate, source: str) -> List[Action]:
+        """A fresh global pushed down from above: adopt + forward one
+        tier further down."""
+        ver = as_version(update.version)
+        version = ver.base_version if ver is not None else 0
+        if not self._adopt(update.params, version):
+            logger.log_comm_metric(self.addr, "async_model_stale")
+            return []
+        logger.log_comm_metric(self.addr, "async_model_adopt")
+        telemetry.event(
+            self.addr, "async_model_adopt", kind="stage", attrs={"version": version}
+        )
+        return [
+            ("async_model", child, update)
+            for child in self.live_children()
+            if child != source
+        ]
+
+    # ---- flush propagation ----
+
+    def _regional_flush(self, res: FlushResult) -> List[Action]:
+        """A regional buffer filled: one merged aggregate goes UP."""
+        upd = ModelUpdate(res.params, res.contributors, res.num_samples)
+        upd.version = (self.addr, next(self._up_seq), res.version)
+        if self.gbuf is not None:  # the root's own cluster feeding its global tier
+            gres = self.gbuf.offer(upd)
+            return self._global_flush(gres) if gres else []
+        return [("async_update", self.topo.global_root, upd)]
+
+    def _global_flush(self, res: FlushResult) -> List[Action]:
+        """The global buffer filled: a new global version exists — adopt
+        locally and push it down every child tier."""
+        self._adopt(res.params, res.version)
+        upd = ModelUpdate(res.params, [self.addr], 1)
+        upd.version = (self.addr, res.version, res.version)
+        return [("async_model", child, upd) for child in self.live_children()]
+
+    # ---- repair + drain support ----
+
+    def on_peer_evicted(self, addr: str) -> List[Action]:
+        """A member died: shrink the affected tiers' K to the live fan-in
+        (the async twin of mid-round train-set repair) — a dead edge must
+        not leave its cluster's buffer permanently under-filled. May
+        trigger the flush the corpse was blocking; returns its sends."""
+        if addr not in self.topo._cluster_of:
+            return []
+        with self.lock:
+            if addr in self._dead:
+                return []
+            self._dead.add(addr)
+            dead = set(self._dead)
+        actions: List[Action] = []
+        if self.rbuf is not None and addr in self.topo.cluster_of(self.addr):
+            live = [m for m in self.topo.cluster_of(self.addr) if m not in dead]
+            res = self.rbuf.set_k(min(self.rbuf.k, max(1, len(live))))
+            if res:
+                actions += self._regional_flush(res)
+        if self.gbuf is not None:
+            fan = (
+                [m for m in self.topo.members if m not in dead]
+                if self.topo.is_flat()
+                else [r for r in self.topo.regionals if r not in dead]
+            )
+            res = self.gbuf.set_k(min(self.gbuf.k, max(1, len(fan))))
+            if res:
+                actions += self._global_flush(res)
+        if actions:
+            logger.log_comm_metric(self.addr, "async_k_repair")
+            logger.warning(
+                self.addr,
+                f"Async K-repair: {addr} evicted — flushed the buffer it was blocking",
+            )
+        return actions
+
+    def final_sync_actions(self) -> List[Action]:
+        """Re-push the last-known global to this node's children (drain
+        phase): a fresh-global push is fire-and-forget — superseded by the
+        next merge in steady state — but at the END of a run there is no
+        next merge, so a single dropped push would strand a subtree on an
+        old version. Children already at the version ignore it."""
+        children = self.live_children()
+        with self.lock:
+            lg = self.last_global
+            if lg is None or not children:
+                return []
+            params, version = lg
+            if self._final_push is not None and self._final_push[0] == version:
+                upd = self._final_push[1]  # encode-once: reuse across ticks
+            else:
+                upd = ModelUpdate(params, [self.addr], 1)
+                upd.version = (self.addr, version, version)
+                self._final_push = (version, upd)
+        return [("async_model", child, upd) for child in children]
+
+    # ---- outbound ----
+
+    def execute_actions(self, actions: List[Action]) -> None:
+        """Send the collected pushes through the gossiper's concurrent
+        dispatch pool (stalled-peer skip, per-send budget, breaker
+        feedback) — one slow child must not serialize a global push."""
+        if not actions:
+            return
+        proto = self.node.protocol
+        sends = []
+        for cmd, target, upd in actions:
+            ver = as_version(upd.version)
+            sends.append((target, proto.build_weights(cmd, ver.seq if ver else 0, upd)))
+        results, skipped = proto.gossiper._dispatch_sends(sends, create_connection=True)
+        for ok in results:
+            if ok is False:
+                logger.log_comm_metric(self.addr, "async_push_fail")
+        if skipped:
+            logger.log_comm_metric(self.addr, "async_push_skipped", len(skipped))
+
+
+class AsyncLearningWorkflow:
+    """Drives one node's async experiment end to end (see module docs)."""
+
+    def run(self, node: "Node") -> None:
+        from p2pfl_tpu.communication.faults import FaultCrash
+        from p2pfl_tpu.stages.learning_stages import (
+            RoundFinishedStage,
+            sync_initial_model,
+        )
+
+        state = node.state
+        state.set_experiment(node.experiment_name, node.total_rounds)
+        logger.experiment_started(node.addr)
+        node.learner.set_epochs(node.epochs)
+        node.learner.set_addr(node.addr)
+        node.learner.pop_round_metrics()
+
+        if Settings.SECURE_AGGREGATION:
+            logger.error(
+                node.addr,
+                "FEDERATION_MODE='async' does not compose with "
+                "SECURE_AGGREGATION (pairwise masks need a fixed cohort "
+                "per merge; a staleness-weighted buffer breaks exact "
+                "cancellation) — aborting the experiment",
+            )
+            state.clear()
+            return
+        if Settings.WIRE_COMPRESSION == "topk8":
+            logger.error(
+                node.addr,
+                "FEDERATION_MODE='async' does not support topk8 wire "
+                "compression (delta anchors are pinned per sync round; "
+                "the async plane has no shared round) — aborting; use "
+                "'none' or 'int8'",
+            )
+            state.clear()
+            return
+
+        ctx: Optional[AsyncContext] = None
+        try:
+            if not sync_initial_model(node):
+                return
+            # let heartbeats flood so every node derives the topology from
+            # the same membership (agreement on membership IS agreement on
+            # topology — the deterministic-derivation trick)
+            time.sleep(Settings.WAIT_HEARTBEATS_CONVERGENCE)
+            members = sorted(
+                set(node.protocol.get_neighbors(only_direct=False)) | {node.addr}
+            )
+            topo = HierarchicalTopology(members, Settings.HIER_CLUSTER_SIZE)
+            ctx = AsyncContext(node, topo, node.learner.get_parameters())
+            node.async_ctx = ctx
+            logger.info(
+                node.addr,
+                f"Async federation: tier={topo.tier(node.addr)} "
+                f"topology={topo.describe()}",
+            )
+            # drain updates that raced ahead of the context (fast edges
+            # finishing their first local update during our init gossip);
+            # the stash's epoch/TTL filters already dropped a previous
+            # experiment's retried stragglers
+            from p2pfl_tpu.commands.federation import drain_async_stash
+
+            drain_async_stash(node, ctx)
+            self._local_loop(node, ctx)
+            if node.learning_interrupted():
+                return
+            node.protocol.broadcast(node.protocol.build_msg("async_done"))
+            self._drain(node, ctx)
+            # the experiment's RESULT is the latest global model this node
+            # knows — not its local tail update (which it already pushed;
+            # whether that merged or was discarded with a partial buffer,
+            # the canonical fleet model is the last minted version), so
+            # every node's final evaluation measures the same model modulo
+            # lost pushes
+            with ctx.lock:
+                lg = ctx.last_global
+            if lg is not None and not node.learning_interrupted():
+                node.learner.set_parameters(lg[0])
+        except FaultCrash as exc:
+            # injected hard crash: stop executing like a killed process —
+            # no drain, no metrics flush, no state.clear
+            if node.learner is not None:
+                node.learner.pop_round_metrics()
+            logger.info(node.addr, f"{exc}")
+            return
+        except Exception as exc:  # noqa: BLE001 — workflow failure ends learning, not the node
+            if node.learning_interrupted():
+                logger.info(node.addr, "Async learning interrupted")
+            else:
+                logger.error(node.addr, f"Async workflow failed: {exc!r}")
+                state.clear()
+            return
+        finally:
+            if ctx is not None:
+                ctx.accepting = False
+                node.async_ctx = None
+            # a straggler stashed during teardown must not sit until the
+            # next experiment (its TTL bounds the damage; this bounds the
+            # memory)
+            node.take_async_stash()
+            try:
+                RoundFinishedStage._flush_round_metrics(node)
+            except Exception:  # noqa: BLE001 — abort-path flush never masks the exit
+                pass
+        # natural finish: final evaluation, clear state (mirrors
+        # RoundFinishedStage's experiment-over path)
+        metrics = node.learner.evaluate()
+        for k, v in (metrics or {}).items():
+            logger.log_metric(
+                node.addr, k, float(v), round=state.round, experiment=state.experiment_name
+            )
+        logger.experiment_finished(node.addr)
+        state.clear()
+
+    # ---- phases ----
+
+    def _local_loop(self, node: "Node", ctx: AsyncContext) -> None:
+        from p2pfl_tpu.stages.learning_stages import RoundFinishedStage
+
+        state = node.state
+        budget = node.total_rounds
+        for i in range(budget):
+            if node.learning_interrupted():
+                return
+            # stall-watchdog + crash-at-stage seams, same as the FSM loop
+            state.current_stage = "AsyncTrainStage"
+            state.last_transition = time.monotonic()
+            for hook in node.stage_hooks:
+                hook(node, "AsyncTrainStage")
+            # adopt the freshest global that arrived while training — the
+            # pull happens HERE, on the learning thread, so the learner is
+            # never mutated mid-fit by a handler thread
+            pend = ctx.take_pending_global()
+            if pend is not None:
+                params, version = pend
+                node.learner.set_parameters(params)
+                ctx.base_version = version
+            trace_id = (
+                f"{state.experiment_name or 'exp'}:"
+                f"{state.experiment_epoch}:u{i}"
+            )
+            with telemetry.span(
+                node.addr,
+                "AsyncTrainStage",
+                kind="stage",
+                attrs={
+                    "round": i,
+                    "experiment": state.experiment_name,
+                    "base_version": ctx.base_version,
+                },
+                trace_id=trace_id,
+            ):
+                own = None
+                if Settings.ROUND_FUSED and not node.learning_interrupted():
+                    own = node.learner.fused_round()
+                if own is None:
+                    if node.learning_interrupted():
+                        return
+                    node.learner.fit()
+                    own = node.learner.get_model_update()
+                # the fused path's device-resident partial fold belongs to
+                # the sync FedAvg seam; the buffer folds staleness-weighted
+                own.partial_acc = None
+                own.version = (node.addr, next(ctx.train_seq), ctx.base_version)
+            if node.learning_interrupted():
+                return
+            # one batched metric flush per local update (fused path stash)
+            RoundFinishedStage._flush_round_metrics(node)
+            state.round = i + 1
+            # the regular target is this node's regional; once that
+            # regional is known dead the update re-routes to the global
+            # root instead of feeding a corpse for the rest of the run
+            target = ctx.push_target()
+            if target == node.addr:
+                ctx.execute_actions(ctx.handle_update(own))
+            else:
+                env = node.protocol.build_weights("async_update", i, own)
+                ok = node.protocol.send(target, env, create_connection=True)
+                # protocol.send skips breaker feedback on the
+                # create_connection path — feed it explicitly, or a dead
+                # aggregator's edges would never accelerate its eviction
+                # (and with it the K-repair and re-route above)
+                node.protocol._record_send_outcome(target, ok)
+                if not ok:
+                    # dropped, not retried: the next local update
+                    # supersedes this one anyway
+                    logger.log_comm_metric(node.addr, "async_push_fail")
+
+    def _drain(self, node: "Node", ctx: AsyncContext) -> None:
+        """Every node serves until the whole fleet is done or dead:
+        aggregators keep merging slower members' tails, edges keep
+        adopting the globals those tail merges mint — so in the common
+        case the run ends with everyone holding the latest version.
+        Bounded by ``ASYNC_DRAIN_TIMEOUT``; a dead member (eviction took
+        it out of the overlay) releases the wait. Buffered-but-unflushed
+        updates at exit are discarded — FedBuff semantics, a partial
+        buffer is not a merge."""
+        state = node.state
+        others = set(ctx.topo.members) - {node.addr}
+        deadline = time.monotonic() + Settings.ASYNC_DRAIN_TIMEOUT
+        graceful = False
+        tick = 0
+        pushed_version = -1
+        with telemetry.span(node.addr, "async_drain", kind="stage"):
+            while time.monotonic() < deadline and not node.learning_interrupted():
+                self._adopt_pending(node, ctx)
+                # aggregators re-push the latest global so a dropped push
+                # cannot strand a subtree at run end — when the VERSION
+                # CHANGED since the last re-push, plus a slow (~2 s)
+                # fallback cadence covering the dropped-re-push case
+                # (every tick would fan the full model out 20×/s for
+                # children that just drop it as stale)
+                with ctx.lock:
+                    current = ctx.last_global[1] if ctx.last_global else -1
+                if current != pushed_version or tick % 40 == 0:
+                    ctx.execute_actions(ctx.final_sync_actions())
+                    pushed_version = current
+                tick += 1
+                with state.status_merge_lock:
+                    done = set(state.async_done_peers)
+                live = set(node.protocol.get_neighbors(only_direct=False))
+                waiting = {m for m in others if m not in done and m in live}
+                if not waiting:
+                    graceful = True
+                    break
+                time.sleep(0.05)
+            if graceful:
+                # grace window: merges triggered by the LAST members' final
+                # updates are still propagating down the tiers
+                time.sleep(min(0.5, Settings.ASYNC_DRAIN_TIMEOUT / 10))
+                ctx.execute_actions(ctx.final_sync_actions())
+                time.sleep(0.1)
+            else:
+                logger.info(
+                    node.addr,
+                    "Async drain window closed with members still pending — exiting",
+                )
+            self._adopt_pending(node, ctx)
+
+    @staticmethod
+    def _adopt_pending(node: "Node", ctx: AsyncContext) -> None:
+        pend = ctx.take_pending_global()
+        if pend is not None:
+            params, version = pend
+            node.learner.set_parameters(params)
+            ctx.base_version = version

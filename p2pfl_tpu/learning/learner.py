@@ -191,6 +191,8 @@ class NodeLearner(ABC):
         # relays re-encode fresh aggregates against the same shared anchor
         out.anchor = anchor
         out.anchor_tag = tag
+        # the async version triple travels with the payload it describes
+        out.version = update.version
         return out
 
 

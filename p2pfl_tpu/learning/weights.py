@@ -616,6 +616,19 @@ class ModelUpdate:
     #: device arrays end to end. Never serialized, never set on wire
     #: updates, dropped by aggregation results and secagg masking.
     partial_acc: Optional[tuple] = None
+    #: async-federation version triple ``(origin, seq, base_version)``
+    #: (``federation/staleness.py`` UpdateVersion): ``origin`` is the
+    #: producing node, ``seq`` its monotone per-node update counter (the
+    #: receiver-side version vector dedups on it — duplicate/reordered
+    #: delivery, e.g. FaultPlan duplicates, can never double-merge), and
+    #: ``base_version`` the global model version the update was trained
+    #: FROM (the aggregator computes staleness τ = current − base with no
+    #: global clock). OPTIONAL wire field, same backward-compat pattern
+    #: as the telemetry ``trace_ctx``: serialized as ``"vv"`` in the gRPC
+    #: envelope header only when set, absent frames decode unchanged, and
+    #: the protobuf interop schema never carries it. Unused (None) by the
+    #: sync round FSM.
+    version: Optional[tuple] = None
     #: encode-once plumbing (module docstring) — the learner's shared
     #: :class:`PayloadCache` plus its model-version counter at the time
     #: this update was handed out; ``cache_round`` is stamped by

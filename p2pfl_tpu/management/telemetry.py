@@ -246,6 +246,54 @@ class LatencyHistogram:
         }
 
 
+class ValueHistogram:
+    """Exact counts over small non-negative integer values (thread-safe).
+
+    The unit-agnostic sibling of :class:`LatencyHistogram` for quantities
+    with a naturally tiny support — e.g. the async federation's staleness
+    τ in *model versions* (0, 1, 2, …, bounded by
+    ``Settings.ASYNC_MAX_STALENESS``) — where log2 latency buckets would
+    both blur the distribution and mislabel the units as time.
+    """
+
+    __slots__ = ("_lock", "counts", "count", "total")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.counts: Dict[int, int] = {}
+        self.count = 0
+        self.total = 0
+
+    def record(self, value: int) -> None:
+        value = max(int(value), 0)
+        with self._lock:
+            self.counts[value] = self.counts.get(value, 0) + 1
+            self.count += 1
+            self.total += value
+
+    def summary(self) -> dict:
+        with self._lock:
+            if self.count == 0:
+                return {"count": 0}
+            values = sorted(self.counts)
+            cum, p50, p95 = 0, values[-1], values[-1]
+            for v in values:
+                cum += self.counts[v]
+                if p50 == values[-1] and cum >= 0.50 * self.count:
+                    p50 = v
+                if cum >= 0.95 * self.count:
+                    p95 = v
+                    break
+            return {
+                "count": self.count,
+                "mean": round(self.total / self.count, 4),
+                "p50": p50,
+                "p95": p95,
+                "max": values[-1],
+                "counts": {str(v): self.counts[v] for v in values},
+            }
+
+
 class Telemetry:
     """Process-wide registry. Use the module-level :data:`telemetry`."""
 
@@ -259,6 +307,8 @@ class Telemetry:
         self._counters: Dict[str, Dict[str, Dict[str, float]]] = {}
         # (node, name) → LatencyHistogram (span durations auto-feed these)
         self._hists: Dict[Tuple[str, str], LatencyHistogram] = {}
+        # (node, name) → ValueHistogram (e.g. async staleness per merge)
+        self._value_hists: Dict[Tuple[str, str], ValueHistogram] = {}
         self._tls = threading.local()
 
     # ---- span API ----
@@ -433,9 +483,34 @@ class Telemetry:
                 out[f"{n}/{name}"] = hist.summary()
         return out
 
+    def observe_value(self, node: str, name: str, value: int) -> None:
+        """Record a raw (unit-agnostic, small non-negative integer) sample
+        into a :class:`ValueHistogram` — always on, like counters: the
+        async staleness distribution is load-bearing for tests/benches."""
+        key = (node, name)
+        hist = self._value_hists.get(key)
+        if hist is None:
+            with self._lock:
+                hist = self._value_hists.setdefault(key, ValueHistogram())
+        hist.record(value)
+
+    def value_histograms(self, node: Optional[str] = None) -> Dict[str, dict]:
+        """Like :meth:`histograms` but for the raw-value family."""
+        with self._lock:
+            items = list(self._value_hists.items())
+        out: Dict[str, dict] = {}
+        for (n, name), hist in items:
+            if node is not None:
+                if n == node:
+                    out[name] = hist.summary()
+            else:
+                out[f"{n}/{name}"] = hist.summary()
+        return out
+
     def reset_histograms(self) -> None:
         with self._lock:
             self._hists.clear()
+            self._value_hists.clear()
 
     def reset(self) -> None:
         """Full wipe: spans, every counter group, histograms."""
@@ -443,6 +518,7 @@ class Telemetry:
             self._rings.clear()
             self._counters.clear()
             self._hists.clear()
+            self._value_hists.clear()
 
     # ---- Chrome trace-event export (Perfetto-loadable) ----
 
@@ -763,6 +839,15 @@ def dump_flight_record(out_dir: str) -> List[str]:
     with open(report_path, "w") as f:
         json.dump(reports, f, indent=1)
     paths.append(report_path)
+    # async runs: the per-node staleness distribution (empty dict on sync
+    # runs — written only when something was observed, keeping sync-mode
+    # artifacts byte-stable)
+    value_hists = telemetry.value_histograms()
+    if value_hists:
+        vh_path = os.path.join(out_dir, "value_histograms.json")
+        with open(vh_path, "w") as f:
+            json.dump(value_hists, f, indent=1)
+        paths.append(vh_path)
     return paths
 
 

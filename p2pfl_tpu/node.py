@@ -14,6 +14,9 @@ from typing import Any, Optional, Type, Union
 
 from p2pfl_tpu.commands import (
     AddModelCommand,
+    AsyncDoneCommand,
+    AsyncModelCommand,
+    AsyncUpdateCommand,
     HeartbeatCommand,
     InitModelCommand,
     MetricsCommand,
@@ -105,6 +108,18 @@ class Node:
         # round-start global stash for secagg dropout fallback
         # (stages/learning_stages.py TrainStage / GossipModelStage)
         self.round_start_params: Optional[Any] = None
+        # async control plane (Settings.FEDERATION_MODE == "async"):
+        # per-experiment AsyncContext (federation/workflow.py) — buffers,
+        # version mailbox, topology role. None outside an async experiment;
+        # the async_* commands drop their payloads while it is None.
+        self.async_ctx: Optional[Any] = None
+        # async_updates that raced ahead of this aggregator's context
+        # (a fast edge finishes its first local update while we are still
+        # in the init gossip push — the async twin of the early-init
+        # stash): bounded FIFO, drained right after the context installs,
+        # cleared on stop. Guarded by _early_async_lock.
+        self._early_async_lock = threading.Lock()
+        self._early_async: list = []
         self._interrupt = threading.Event()
         self._learning_thread: Optional[threading.Thread] = None
         self._running = False
@@ -135,6 +150,9 @@ class Node:
             SecAggRevealCommand(self.state),
             InitModelCommand(self),
             AddModelCommand(self),
+            AsyncUpdateCommand(self),
+            AsyncModelCommand(self),
+            AsyncDoneCommand(self.state),
         ):
             self.protocol.add_command(cmd)
 
@@ -231,6 +249,13 @@ class Node:
             self._learning_thread.start()
 
     def _run_learning(self) -> None:
+        # control-plane selection: the sync round FSM (the reference
+        # semantics) or the async bounded-staleness plane (ROADMAP 3)
+        if Settings.FEDERATION_MODE == "async":
+            from p2pfl_tpu.federation.workflow import AsyncLearningWorkflow
+
+            AsyncLearningWorkflow().run(self)
+            return
         from p2pfl_tpu.stages.workflow import LearningWorkflow
 
         LearningWorkflow().run(self)
@@ -274,6 +299,44 @@ class Node:
             return None
         return update
 
+    def stash_async_update(self, update: ModelUpdate) -> None:
+        """Hold an async_update that beat the AsyncContext's creation
+        (commands/federation.py) for the workflow to drain — bounded: in
+        async-land a superseded update is droppable by design, so overflow
+        evicts the oldest instead of growing."""
+        with self._early_async_lock:
+            self._early_async.append(
+                (self.state.experiment_epoch, time.monotonic(), update)
+            )
+            while len(self._early_async) > 64:
+                self._early_async.pop(0)
+
+    def take_async_stash(self) -> list:
+        """Pop the stash, keeping only THIS experiment's fresh entries.
+
+        Two filters against a previous experiment's retried/duplicated
+        tail update draining into the next experiment's fresh buffers
+        (whose version vector has never seen its ``(origin, seq)`` and
+        would merge a stale experiment's params at τ=0 full weight):
+        the ``experiment_epoch`` stamped at stash time (catches anything
+        stashed before this experiment's ``set_experiment``) and the
+        ``EARLY_INIT_TTL`` freshness window. A straggler delivered AFTER
+        this experiment's start passes both — the wire carries no
+        experiment identity, the same documented residual as the
+        early-init stash; the TTL keeps that window short.
+        """
+        with self._early_async_lock:
+            entries, self._early_async = self._early_async, []
+        now = time.monotonic()
+        epoch = self.state.experiment_epoch
+        fresh = [
+            u for e, t, u in entries
+            if e == epoch and now - t <= Settings.EARLY_INIT_TTL
+        ]
+        if len(fresh) < len(entries):
+            logger.debug(self.addr, "Discarded stale early async_update stash entries")
+        return fresh
+
     def _on_peer_evicted(self, addr: str) -> None:
         """Mid-round train-set repair (ISSUE 5): a train-set member was
         heartbeat-evicted. If it has not contributed, shrink the round's
@@ -288,6 +351,34 @@ class Node:
         dropouts there (stages/learning_stages.py).
         """
         st = self.state
+        # wake a vote-collection loop blocked on the evicted peer's vote:
+        # the loop re-derives the live candidate set per iteration
+        # (VoteTrainSetStage), so the wake alone lets it stop waiting for
+        # a corpse without burning the remaining VOTE_TIMEOUT
+        st.votes_ready_event.set()
+        ctx = self.async_ctx
+        if ctx is not None:
+            # async control plane: eviction repair means shrinking the dead
+            # member's aggregation tiers to the live fan-in
+            # (federation/workflow.py AsyncContext.on_peer_evicted). The
+            # listener runs on the HEARTBEATER thread, and the repair may
+            # flush a buffer — a jitted merge plus full-model pushes whose
+            # dispatch can block up to GOSSIP_SEND_TIMEOUT (≈ a whole
+            # HEARTBEAT_TIMEOUT): doing that inline would silence our own
+            # beats exactly during a failure window and get THIS live node
+            # evicted, cascading the fault — so the repair runs on its own
+            # daemon thread (sends outside every context/buffer lock, per
+            # the deadlock contract).
+            def _repair(ctx=ctx, addr=addr) -> None:
+                try:
+                    ctx.execute_actions(ctx.on_peer_evicted(addr))
+                except Exception as exc:  # noqa: BLE001 — repair is best-effort
+                    logger.error(self.addr, f"Async eviction repair failed for {addr}: {exc!r}")
+
+            threading.Thread(
+                target=_repair, name=f"async-repair-{self.addr}", daemon=True
+            ).start()
+            return
         if not Settings.TRAIN_SET_REPAIR or Settings.SECURE_AGGREGATION:
             return
         with st.train_set_lock:
@@ -318,6 +409,8 @@ class Node:
         self._interrupt.set()
         with self._early_init_lock:
             self._early_init = None
+        with self._early_async_lock:
+            self._early_async = []
         if self.learner is not None:
             self.learner.interrupt_fit()
         self.aggregator.clear()

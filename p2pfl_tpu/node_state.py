@@ -74,6 +74,12 @@ class NodeState:
         # whose pair seeds round r may have been disclosed
         self.secagg_round_dropped: set = set()
 
+        # async federation (federation/workflow.py): peers that announced
+        # their local update budget is spent (async_done, TTL-flooded) —
+        # releases aggregators' drain waits. Union-merged under
+        # status_merge_lock like every control-plane lattice.
+        self.async_done_peers: set = set()
+
         # monotonically counts experiments entered; lets harnesses distinguish
         # "never started" from "finished" (both have round None)
         self.experiment_epoch = 0
@@ -110,6 +116,12 @@ class NodeState:
         self.total_rounds = total_rounds
         self.round = 0
         self.experiment_epoch += 1
+        # a late async_done (slow peer's broadcast, TTL-relayed duplicate)
+        # landing AFTER the previous experiment's clear() must not mark
+        # that peer done for THIS experiment — the drain would skip the
+        # window that merges its tail updates
+        with self.status_merge_lock:
+            self.async_done_peers = set()
 
     def increase_round(self) -> None:
         """Advance the round; clears per-round caches (``node_state.py:97``)."""
@@ -144,5 +156,7 @@ class NodeState:
         self.secagg_share_reveals = {}
         self.secagg_reveal_sent = set()
         self.secagg_round_dropped = set()
+        with self.status_merge_lock:
+            self.async_done_peers = set()
         self.votes_ready_event.clear()
         self.model_initialized_event.clear()

@@ -73,6 +73,25 @@ def fedavg_fold_acc(
     return jax.tree.map(lambda s, r: (s / wsum).astype(r.dtype), psum, ref)
 
 
+@partial(jax.jit, static_argnames=("lr", "agg_dtype"))
+def server_merge(prev: Pytree, avg: Pytree, lr: float = 1.0, agg_dtype: str = "float32") -> Pytree:
+    """FedBuff server step: ``new = (1−η)·prev + η·avg`` in ``agg_dtype``.
+
+    ``avg`` is the buffer's staleness-weighted average (:func:`fedavg`
+    over effective weights ``num_samples × w(τ)`` — the weighting lives
+    in the weights vector, so the reduction kernel is shared with the
+    sync path). ``lr`` (η) is the server mixing rate; at 1.0 the merge
+    degenerates to adopting the average. One fused elementwise program;
+    output dtypes follow ``prev``.
+    """
+
+    def mix(p, a):
+        out = (1.0 - lr) * p.astype(agg_dtype) + lr * a.astype(agg_dtype)
+        return out.astype(p.dtype)
+
+    return jax.tree.map(mix, prev, avg)
+
+
 @jax.jit
 def fedmedian(stacked: Pytree) -> Pytree:
     """Coordinate-wise median across the node axis."""

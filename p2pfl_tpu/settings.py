@@ -192,6 +192,42 @@ class Settings:
     # Error feedback for topk8: dropped coordinates accumulate locally and
     # re-enter the next round's delta (Seide et al. 2014).
     TOPK_ERROR_FEEDBACK: bool = True
+
+    # --- async bounded-staleness federation (p2pfl_tpu/federation/) ---
+    # Which control plane drives the learning thread: "sync" is the round
+    # FSM (stages/learning_stages.py — barrier-synchronized rounds, the
+    # reference semantics); "async" is the FedBuff-style buffered control
+    # plane (federation/workflow.py — contributions apply as they arrive
+    # with a staleness weight, no round barrier; Nguyen et al. 2022).
+    FEDERATION_MODE: str = "sync"
+    # Buffer size K: an aggregator merges once K accepted contributions
+    # are buffered (FedBuff's one tunable). Aggregator tiers clamp it to
+    # their fan-in (min(K, #children)) so a small cluster still flushes.
+    FEDBUFF_K: int = 4
+    # Staleness-weight exponent α in w(τ) = 1/(1+τ)^α: τ is how many
+    # global model versions elapsed between the version a contribution
+    # was trained FROM and the version it merges INTO. 0 disables
+    # down-weighting; 0.5 is FedBuff's default polynomial weighting.
+    FEDBUFF_ALPHA: float = 0.5
+    # Server mixing rate η: new_global = (1-η)·global + η·weighted_avg.
+    # 1.0 replaces the global with the buffer's staleness-weighted average
+    # (the FedAvg-like limit); lower values damp each merge.
+    FEDBUFF_SERVER_LR: float = 1.0
+    # BOUNDED staleness: contributions older than this many global
+    # versions are dropped (counted async_stale_drop) instead of merged
+    # with a vanishing weight — the bound that keeps a wedged straggler's
+    # months-old update from ever touching the model.
+    ASYNC_MAX_STALENESS: int = 16
+    # Hierarchical topology (federation/topology.py): members are chunked
+    # into edge clusters of this size, each with an elected regional
+    # aggregator buffering locally and pushing one aggregate per flush to
+    # the global tier. 0 = flat (single global aggregator, FedBuff
+    # classic). Clamped to the fleet size.
+    HIER_CLUSTER_SIZE: int = 0
+    # How long an aggregator keeps serving after finishing its own local
+    # update budget, waiting for slower members' async_done announcements
+    # (eviction of a dead member also releases it) before it exits.
+    ASYNC_DRAIN_TIMEOUT: float = 30.0
     # Secure aggregation (pairwise masking, learning/secagg.py): when True,
     # train-set nodes Diffie-Hellman a seed per peer at experiment start and
     # mask their model contribution; masks cancel in the FedAvg sum, so no
@@ -380,6 +416,13 @@ def set_test_settings() -> None:
     Settings.TELEMETRY_ENABLED = True
     Settings.TELEMETRY_RING_SPANS = 4096
     Settings.TELEMETRY_BEAT_SPANS = False
+    Settings.FEDERATION_MODE = "sync"
+    Settings.FEDBUFF_K = 4
+    Settings.FEDBUFF_ALPHA = 0.5
+    Settings.FEDBUFF_SERVER_LR = 1.0
+    Settings.ASYNC_MAX_STALENESS = 16
+    Settings.HIER_CLUSTER_SIZE = 0
+    Settings.ASYNC_DRAIN_TIMEOUT = 15.0
     Settings.TRAIN_SET_SIZE = 4
     Settings.VOTE_TIMEOUT = 10.0
     Settings.AGGREGATION_TIMEOUT = 10.0

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from typing import Any, Callable, Optional
 
+from p2pfl_tpu.federation.simfleet import FleetResult, SimulatedAsyncFleet  # noqa: F401 — re-export: the 1k-node simulated fleet driver
 from p2pfl_tpu.learning.dataset import FederatedDataset
 from p2pfl_tpu.management.logger import logger
 from p2pfl_tpu.node import Node
@@ -69,6 +70,11 @@ class Simulation:
         return self
 
     def learn(self, rounds: int = 1, epochs: int = 1, timeout: float = 600.0) -> "Simulation":
+        """Run one experiment. Under ``Settings.FEDERATION_MODE="async"``
+        the same call drives the async control plane (``rounds`` is then
+        each node's local update budget — there are no global rounds);
+        for 1k+-node *virtual* fleets use :class:`SimulatedAsyncFleet`
+        instead of instantiating real nodes."""
         self.nodes[0].set_start_learning(rounds=rounds, epochs=epochs)
         wait_to_finish(self.nodes, timeout=timeout)
         return self

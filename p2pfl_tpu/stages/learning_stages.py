@@ -53,6 +53,89 @@ def broadcast_metrics(node: "Node", metrics: dict) -> None:
     )
 
 
+def sync_initial_model(node: "Node") -> bool:
+    """Synchronize the experiment's initial weights across the overlay.
+
+    The shared first act of BOTH control planes (the sync FSM's
+    ``StartLearningStage`` and the async workflow in
+    ``federation/workflow.py``): consume an init_model that raced ahead of
+    ``start_learning``, wait for the ``model_initialized`` latch, apply
+    the pending init, then push init weights to peers that have not
+    announced initialization. Returns False when the experiment cannot
+    proceed (init timeout → graceful abort with ``state.clear()``;
+    architecture mismatch → ``stop_async``; interrupt) — side effects
+    identical to the historical in-stage behavior.
+    """
+    state = node.state
+    # an init_model may have raced ahead of our start_learning (weights
+    # plane vs TTL-flooded control broadcast): consume the fresh stash
+    # (commands/learning.py InitModelCommand) instead of waiting for a
+    # redelivery the initiator's exited push loop will never make
+    early = node.take_early_init()
+    if early is not None and not state.model_initialized_event.is_set():
+        try:
+            if early.params is None:
+                early = node.learner.materialize(early)
+            node.pending_init_update = early
+            state.model_initialized_event.set()
+            node.protocol.broadcast(node.protocol.build_msg("model_initialized"))
+        except Exception as exc:  # noqa: BLE001 — a bad stash falls back to the normal wait
+            logger.info(
+                node.addr,
+                f"Stashed early init_model unusable ({exc!r}) — waiting for redelivery",
+            )
+
+    # wait for initial weights: the initiator's event was set by
+    # set_start_learning(); everyone else blocks until init_model arrives
+    # (reference blocks on model_initialized_lock, start_learning_stage.py:78)
+    if not state.model_initialized_event.wait(timeout=Settings.AGGREGATION_TIMEOUT):
+        # graceful abort, not an escaping TimeoutError: the initiator may
+        # have died before its init_model reached us — this node clears
+        # the experiment and keeps serving the overlay (it can join the
+        # next start_learning normally)
+        logger.error(
+            node.addr,
+            "Initial model never arrived within AGGREGATION_TIMEOUT — "
+            "aborting the experiment (node keeps serving)",
+        )
+        # an init that straggles in DURING the abort is this (dead)
+        # experiment's — it must not sit in the stash and seed the
+        # next one (anything later than this is bounded by the
+        # EARLY_INIT_TTL freshness check)
+        node.take_early_init()
+        state.clear()
+        return False
+    if node.pending_init_update is not None:
+        try:
+            node.learner.set_parameters(node.pending_init_update.params)
+        except Exception as exc:  # noqa: BLE001 — mismatched init stops the node (reference :106-117)
+            logger.error(node.addr, f"Initial model does not match architecture: {exc} — stopping")
+            node.stop_async()
+            return False
+        node.pending_init_update = None
+
+    # push init weights to peers that haven't announced initialization
+    # (reference start_learning_stage.py:80,94-136)
+    def candidates() -> list[str]:
+        neis = node.protocol.get_neighbors(only_direct=True)
+        return [n for n in neis if state.nei_status.get(n, 0) != -1]
+
+    def model_fn(nei: str):
+        # encode-once: the update carries the learner's payload cache,
+        # so byte transports serialize once per model version — not once
+        # per candidate per tick (learning/weights.py)
+        update = node.learner.get_model_update()
+        return node.protocol.build_weights("init_model", 0, update)
+
+    node.protocol.gossip_weights(
+        early_stopping_fn=node.learning_interrupted,
+        get_candidates_fn=candidates,
+        status_fn=lambda: sorted(candidates()),
+        model_fn=model_fn,
+    )
+    return not node.learning_interrupted()
+
+
 class StartLearningStage(Stage):
     """Set up the experiment, synchronize initial weights across the overlay."""
 
@@ -112,73 +195,9 @@ class StartLearningStage(Stage):
                 )
             )
 
-        # an init_model may have raced ahead of our start_learning (weights
-        # plane vs TTL-flooded control broadcast): consume the fresh stash
-        # (commands/learning.py InitModelCommand) instead of waiting for a
-        # redelivery the initiator's exited push loop will never make
-        early = node.take_early_init()
-        if early is not None and not state.model_initialized_event.is_set():
-            try:
-                if early.params is None:
-                    early = node.learner.materialize(early)
-                node.pending_init_update = early
-                state.model_initialized_event.set()
-                node.protocol.broadcast(node.protocol.build_msg("model_initialized"))
-            except Exception as exc:  # noqa: BLE001 — a bad stash falls back to the normal wait
-                logger.info(
-                    node.addr,
-                    f"Stashed early init_model unusable ({exc!r}) — waiting for redelivery",
-                )
-
-        # wait for initial weights: the initiator's event was set by
-        # set_start_learning(); everyone else blocks until init_model arrives
-        # (reference blocks on model_initialized_lock, start_learning_stage.py:78)
-        if not state.model_initialized_event.wait(timeout=Settings.AGGREGATION_TIMEOUT):
-            # graceful abort, not an escaping TimeoutError: the initiator may
-            # have died before its init_model reached us — this node clears
-            # the experiment and keeps serving the overlay (it can join the
-            # next start_learning normally)
-            logger.error(
-                node.addr,
-                "Initial model never arrived within AGGREGATION_TIMEOUT — "
-                "aborting the experiment (node keeps serving)",
-            )
-            # an init that straggles in DURING the abort is this (dead)
-            # experiment's — it must not sit in the stash and seed the
-            # next one (anything later than this is bounded by the
-            # EARLY_INIT_TTL freshness check)
-            node.take_early_init()
-            state.clear()
-            return None
-        if node.pending_init_update is not None:
-            try:
-                node.learner.set_parameters(node.pending_init_update.params)
-            except Exception as exc:  # noqa: BLE001 — mismatched init stops the node (reference :106-117)
-                logger.error(node.addr, f"Initial model does not match architecture: {exc} — stopping")
-                node.stop_async()
-                return None
-            node.pending_init_update = None
-
-        # push init weights to peers that haven't announced initialization
-        # (reference start_learning_stage.py:80,94-136)
-        def candidates() -> list[str]:
-            neis = node.protocol.get_neighbors(only_direct=True)
-            return [n for n in neis if state.nei_status.get(n, 0) != -1]
-
-        def model_fn(nei: str):
-            # encode-once: the update carries the learner's payload cache,
-            # so byte transports serialize once per model version — not once
-            # per candidate per tick (learning/weights.py)
-            update = node.learner.get_model_update()
-            return node.protocol.build_weights("init_model", 0, update)
-
-        node.protocol.gossip_weights(
-            early_stopping_fn=node.learning_interrupted,
-            get_candidates_fn=candidates,
-            status_fn=lambda: sorted(candidates()),
-            model_fn=model_fn,
-        )
-        if node.learning_interrupted():
+        # init-weights sync (shared with the async control plane): early
+        # stash consume → latch wait → apply → init gossip push
+        if not sync_initial_model(node):
             return None
 
         # every node now holds the round's shared init weights: pin them as
@@ -217,13 +236,31 @@ class VoteTrainSetStage(Stage):
             node.protocol.build_msg("vote_train_set", flat, round=state.round or 0)
         )
 
-        # collect until every candidate voted or VOTE_TIMEOUT
-        # (reference poll loop :107-165)
+        # collect until every LIVE candidate voted or VOTE_TIMEOUT
+        # (reference poll loop :107-165). Liveness is re-checked every
+        # iteration, NOT snapshotted at stage entry: a candidate killed
+        # mid-startup (crashed after start_learning, before voting) is
+        # heartbeat-evicted within ~HEARTBEAT_TIMEOUT, and waiting out the
+        # full VOTE_TIMEOUT for a corpse's vote was the root cause of the
+        # kill-a-node-mid-startup wedge — every survivor sat in
+        # VoteTrainSetStage for the whole window (60 s at defaults) while
+        # the flight recorder showed the eviction landing in the first
+        # two seconds. Votes that DID arrive from a since-evicted node
+        # still count in the tally (same as the timeout path).
         deadline = time.monotonic() + Settings.VOTE_TIMEOUT
         while not node.learning_interrupted():
             with state.train_set_votes_lock:
                 voted = set(state.train_set_votes)
-            if set(candidates) <= voted:
+            live = set(node.protocol.get_neighbors(only_direct=False)) | {node.addr}
+            waiting = (set(candidates) & live) - voted
+            if not waiting:
+                dead = sorted(set(candidates) - live)
+                if dead:
+                    logger.info(
+                        node.addr,
+                        f"Vote: all live candidates voted — proceeding without "
+                        f"evicted candidate(s) {dead}",
+                    )
                 break
             if time.monotonic() >= deadline:
                 logger.info(
@@ -231,6 +268,8 @@ class VoteTrainSetStage(Stage):
                     f"Vote timeout — proceeding with {len(voted)}/{len(candidates)} votes",
                 )
                 break
+            # woken by arriving votes AND by evictions (Node._on_peer_evicted
+            # sets the event so a corpse releases this wait immediately)
             state.votes_ready_event.wait(timeout=2)
             state.votes_ready_event.clear()
         if node.learning_interrupted():

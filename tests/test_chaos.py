@@ -553,6 +553,55 @@ def test_round0_wedge_regression():
 
 
 # ---------------------------------------------------------------------------
+# the pinned kill-a-node-mid-startup wedge regression (ISSUE 9)
+# ---------------------------------------------------------------------------
+
+#: chaos seed for the startup-kill regression. The wedge needed no edge
+#: faults at all — any node hard-crashing AFTER start_learning but BEFORE
+#: casting its vote reproduced it: every survivor's VoteTrainSetStage
+#: waited out the full VOTE_TIMEOUT (60 s at defaults) for the corpse's
+#: vote, because the vote-collection loop snapshotted its candidate set at
+#: stage entry and never re-checked liveness — while the PR-7 flight
+#: record showed neighbor_evicted landing within the first two seconds
+#: and 9+ s of retry backoff burned against the dead peer. ~1/3 of manual
+#: probe runs hit it because the kill had to land in the pre-vote window.
+STARTUP_WEDGE_SEED = 2206
+
+
+def test_startup_kill_wedge_regression():
+    """A node killed mid-startup (entering VoteTrainSetStage, i.e. before
+    it votes) must delay the survivors by roughly one eviction window —
+    NOT by VOTE_TIMEOUT. Pre-fix this takes > VOTE_TIMEOUT wall-clock;
+    the bound asserts the whole 2-round run completes well inside it."""
+    old_vote = Settings.VOTE_TIMEOUT
+    Settings.VOTE_TIMEOUT = 30.0  # the pre-fix burn — generous vs the bound below
+    nodes = _mk_nodes(5)
+    victim = nodes[2]
+    plan = FaultPlan(
+        seed=STARTUP_WEDGE_SEED,
+        crashes={victim.addr: CrashSpec(stage="VoteTrainSetStage", round_no=0)},
+    )
+    install_fault_plan(nodes, plan)
+    survivors = [n for n in nodes if n is not victim]
+    try:
+        t0 = time.monotonic()
+        nodes[0].set_start_learning(rounds=2, epochs=1)
+        wait_to_finish(survivors, timeout=25)
+        elapsed = time.monotonic() - t0
+        assert not victim._running, "crash spec never fired"
+        # eviction window (~breaker suspect + heartbeat) + 2 fast rounds:
+        # an order of magnitude under the 30 s VOTE_TIMEOUT the corpse's
+        # vote would otherwise have burned
+        assert elapsed < 15.0, f"startup kill still gates the vote ({elapsed:.1f}s)"
+        for n in survivors:
+            assert n.state.round is None
+    finally:
+        Settings.VOTE_TIMEOUT = old_vote
+        remove_fault_plan(nodes)
+        _stop_all(nodes)
+
+
+# ---------------------------------------------------------------------------
 # StartLearningStage graceful abort (satellite)
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,483 @@
+"""Async bounded-staleness federation (ISSUE 9).
+
+Four layers, mirroring the subsystem's structure:
+
+- staleness math + version-vector dedup (pure units — including dedup
+  under the fault layer's DUPLICATE delivery semantics),
+- the BufferedAggregator's merge algebra against a numpy reference,
+- determinism: the same seed + fault plan replays a simulated fleet
+  bit-identically, and a 1k-node hierarchical fleet completes an
+  end-to-end convergence drive with no round barrier,
+- real nodes: an async federation over the in-memory transport (flat and
+  hierarchical) finishing under drop + slow + crash chaos.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from p2pfl_tpu.communication.faults import (
+    CrashSpec,
+    EdgeFault,
+    FaultInjector,
+    FaultPlan,
+    install_fault_plan,
+    remove_fault_plan,
+)
+from p2pfl_tpu.communication.grpc_transport import decode_weights, encode_weights
+from p2pfl_tpu.communication.memory import MemoryRegistry
+from p2pfl_tpu.communication.message import WeightsEnvelope
+from p2pfl_tpu.federation import (
+    BufferedAggregator,
+    HierarchicalTopology,
+    SimulatedAsyncFleet,
+    VersionVector,
+    staleness_weight,
+)
+from p2pfl_tpu.learning.learner import DummyLearner
+from p2pfl_tpu.learning.weights import ModelUpdate
+from p2pfl_tpu.management.logger import logger
+from p2pfl_tpu.node import Node
+from p2pfl_tpu.settings import Settings
+from p2pfl_tpu.utils import full_connection, wait_convergence, wait_to_finish
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    MemoryRegistry.reset()
+    logger.reset_comm_metrics()
+    yield
+    Settings.FEDERATION_MODE = "sync"
+    Settings.HIER_CLUSTER_SIZE = 0
+    MemoryRegistry.reset()
+
+
+def _update(value, contributors, num_samples=1, version=None, dim=4):
+    upd = ModelUpdate({"w": np.full(dim, value, np.float32)}, list(contributors), num_samples)
+    upd.version = version
+    return upd
+
+
+# ---------------------------------------------------------------------------
+# staleness weight math
+# ---------------------------------------------------------------------------
+
+
+def test_staleness_weight_math():
+    # w(0) = 1 at any alpha; monotone decreasing in tau; alpha controls decay
+    for alpha in (0.0, 0.5, 1.0, 2.0):
+        assert staleness_weight(0, alpha) == 1.0
+    taus = [staleness_weight(t, 0.5) for t in range(10)]
+    assert all(a > b for a, b in zip(taus, taus[1:]))
+    assert staleness_weight(3, 1.0) == pytest.approx(1 / 4)
+    assert staleness_weight(3, 0.5) == pytest.approx(1 / 2)
+    assert staleness_weight(8, 2.0) == pytest.approx(1 / 81)
+    # alpha=0 disables down-weighting entirely
+    assert staleness_weight(1000, 0.0) == 1.0
+    # negative tau (merging tier lagging the producer) clamps to fresh
+    assert staleness_weight(-3, 0.5) == 1.0
+
+
+def test_version_vector_dedup_and_reorder():
+    vv = VersionVector()
+    assert vv.observe("a", 1)
+    assert not vv.observe("a", 1), "exact duplicate accepted"
+    # out-of-order AHEAD is accepted (seq 2 lost on the wire), then the
+    # late straggler is rejected as superseded
+    assert vv.observe("a", 3)
+    assert not vv.observe("a", 2), "superseded seq accepted after a newer one"
+    assert vv.last("a") == 3
+    # origins are independent
+    assert vv.observe("b", 1)
+    vv.merge({"a": 10, "c": 2})
+    assert vv.last("a") == 10 and vv.last("c") == 2
+    vv.merge({"a": 5})  # monotone: merge never regresses
+    assert vv.last("a") == 10
+
+
+# ---------------------------------------------------------------------------
+# BufferedAggregator: merge algebra, dedup, bounded staleness
+# ---------------------------------------------------------------------------
+
+
+def test_buffer_merge_matches_numpy_reference():
+    """K staleness-weighted updates merge to the closed-form weighted
+    average (alpha and sample counts both active), mixed by server_lr."""
+    alpha, lr = 1.0, 0.5
+    start = np.full(4, 10.0, np.float32)
+    buf = BufferedAggregator(
+        "me", {"w": start.copy()}, k=3, alpha=alpha, server_lr=lr, max_staleness=16
+    )
+    # advance the global twice so offered updates carry real staleness
+    buf.set_global({"w": start.copy()}, 2)
+    entries = [  # (value, samples, base_version) → tau = 2 - base
+        (1.0, 2, 2),  # tau 0, w = 2·1
+        (4.0, 1, 1),  # tau 1, w = 1·(1/2)
+        (7.0, 3, 0),  # tau 2, w = 3·(1/3) = 1
+    ]
+    for i, (val, ns, base) in enumerate(entries):
+        res = buf.offer(_update(val, [f"n{i}"], ns, version=(f"n{i}", 1, base)))
+    assert res is not None
+    weights = np.array([2 * 1.0, 1 * 0.5, 3 * (1 / 3)], np.float32)
+    avg = (weights * np.array([1.0, 4.0, 7.0], np.float32)).sum() / weights.sum()
+    expect = (1 - lr) * 10.0 + lr * avg
+    np.testing.assert_allclose(np.asarray(res.params["w"]), expect, rtol=1e-6)
+    assert res.version == 3  # set_global took it to 2, the flush minted 3
+    assert res.contributors == ["n0", "n1", "n2"]
+    assert sorted(res.taus) == [0, 1, 2]
+
+
+def test_buffer_dedup_under_fault_plan_duplicate_delivery():
+    """FaultPlan duplicate semantics end to end: a duplicated weights
+    envelope is re-delivered verbatim (faults.py _stale_copy) — the
+    version vector must reject the copy, so K counts distinct updates."""
+    buf = BufferedAggregator("me", {"w": np.zeros(4, np.float32)}, k=3, alpha=0.0)
+    delivered = []
+
+    def transport(nei, env, create_connection=False):
+        delivered.append(env)
+        buf.offer(env.update)
+        return True
+
+    plan = FaultPlan(seed=5, default=EdgeFault(duplicate=1.0, duplicate_delay=0.02))
+    inj = FaultInjector(plan, "src")
+    for i in range(2):
+        upd = _update(float(i), [f"n{i}"], version=(f"n{i}", 1, 0))
+        env = WeightsEnvelope("src", 0, "async_update", upd)
+        assert inj("dst", env, False, transport)
+    deadline = time.monotonic() + 2.0
+    while len(delivered) < 4 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert len(delivered) == 4, "duplicate copies never delivered"
+    # 4 deliveries, but only 2 DISTINCT updates are buffered: no flush at
+    # k=3, and the metrics name the two replays
+    assert buf.pending() == 2
+    m = logger.get_comm_metrics("me")
+    assert m.get("async_dup_drop", 0) == 2
+    assert m.get("async_update_buffered", 0) == 2
+
+
+def test_buffer_bounded_staleness_drop():
+    buf = BufferedAggregator(
+        "me", {"w": np.zeros(4, np.float32)}, k=2, alpha=0.5, max_staleness=3
+    )
+    buf.set_global({"w": np.zeros(4, np.float32)}, 10)
+    # tau = 10 - 6 = 4 > 3: dropped, not merged at a vanishing weight
+    assert buf.offer(_update(1.0, ["a"], version=("a", 1, 6))) is None
+    assert buf.pending() == 0
+    assert logger.get_comm_metrics("me").get("async_stale_drop", 0) == 1
+    # tau = 3 is still within the bound
+    assert buf.offer(_update(1.0, ["a"], version=("a", 2, 7))) is None
+    assert buf.pending() == 1
+
+
+def test_buffer_set_k_repair_flushes_blocked_buffer():
+    """The eviction-repair hook: a dead member leaves the buffer one
+    short of K forever — shrinking K to the live fan-in fires the merge
+    it was blocking."""
+    buf = BufferedAggregator("me", {"w": np.zeros(4, np.float32)}, k=3, alpha=0.0)
+    buf.offer(_update(2.0, ["a"], version=("a", 1, 0)))
+    assert buf.offer(_update(4.0, ["b"], version=("b", 1, 0))) is None
+    res = buf.set_k(2)
+    assert res is not None and res.version == 1
+    np.testing.assert_allclose(np.asarray(res.params["w"]), 3.0)
+
+
+def test_flush_order_is_arrival_order_independent():
+    """The determinism contract: within one buffer window the fold order
+    is (origin, seq)-sorted, so two arrival interleavings of the same
+    updates produce bit-identical merges."""
+
+    def run(order):
+        buf = BufferedAggregator(
+            "me", {"w": np.arange(4, dtype=np.float32)}, k=3, alpha=0.5
+        )
+        buf.set_global({"w": np.arange(4, dtype=np.float32)}, 1)
+        ups = {
+            "a": _update(1.25, ["a"], 2, version=("a", 1, 0)),
+            "b": _update(-3.5, ["b"], 1, version=("b", 1, 1)),
+            "c": _update(0.75, ["c"], 3, version=("c", 1, 1)),
+        }
+        res = None
+        for key in order:
+            res = buf.offer(ups[key])
+        return np.asarray(res.params["w"])
+
+    first = run(["a", "b", "c"])
+    for order in (["c", "a", "b"], ["b", "c", "a"]):
+        np.testing.assert_array_equal(first, run(order))
+
+
+# ---------------------------------------------------------------------------
+# topology
+# ---------------------------------------------------------------------------
+
+
+def test_hierarchical_topology_deterministic_and_connected():
+    members = [f"n{i:03d}" for i in range(37)]
+    import random as _random
+
+    shuffled = list(members)
+    _random.Random(0).shuffle(shuffled)
+    t1 = HierarchicalTopology(members, 8)
+    t2 = HierarchicalTopology(shuffled, 8)  # order-independent derivation
+    assert t1.clusters == t2.clusters and t1.global_root == t2.global_root
+    # every member reaches the global root in <= 2 hops; children/parent agree
+    for m in members:
+        hops, cur = 0, m
+        while t1.parent_of(cur) is not None:
+            parent = t1.parent_of(cur)
+            assert cur in t1.children_of(parent) or t1.aggregator_for(cur) == cur
+            cur = parent
+            hops += 1
+        assert cur == t1.global_root and hops <= 2
+    # no singleton trailing cluster (folded into the previous one)
+    assert all(len(c) >= 2 for c in t1.clusters)
+    # flat collapse
+    flat = HierarchicalTopology(members, 0)
+    assert flat.is_flat() and flat.regionals == [flat.global_root]
+
+
+# ---------------------------------------------------------------------------
+# wire: the optional "vv" field
+# ---------------------------------------------------------------------------
+
+
+def test_wire_version_roundtrip_and_old_frame_compat():
+    upd = ModelUpdate({"w": np.ones(3, np.float32)}, ["a"], 2)
+    upd.version = ("a", 7, 3)
+    env = WeightsEnvelope("a", 0, "async_update", upd)
+    out = decode_weights(encode_weights(env))
+    assert out.update.version == ("a", 7, 3)
+    # a sync-plane frame (no version) decodes with version None — and a
+    # pre-PR frame never carries the key at all
+    upd2 = ModelUpdate({"w": np.ones(3, np.float32)}, ["a"], 2)
+    env2 = WeightsEnvelope("a", 0, "add_model", upd2)
+    raw = encode_weights(env2)
+    assert b'"vv"' not in raw
+    assert decode_weights(raw).update.version is None
+
+
+# ---------------------------------------------------------------------------
+# simulated fleet: determinism + 1k-node hierarchical convergence
+# ---------------------------------------------------------------------------
+
+
+def _chaos_plan(n, seed=1905):
+    """10% slow / ~1% crash over the simulated addresses, plus a lossy wire."""
+    addrs = [f"sim-{i:04d}" for i in range(n)]
+    slow = {a: 0.5 for a in addrs[::10][: max(1, n // 10)]}  # every 10th
+    crashes = {
+        a: CrashSpec(stage="AsyncTrainStage", round_no=2)
+        for a in addrs[5::100][: max(1, n // 100)]  # offset: disjoint from slow
+    }
+    return FaultPlan(
+        seed=seed,
+        default=EdgeFault(drop=0.02, duplicate=0.05, duplicate_delay=0.3),
+        slow_nodes=slow,
+        crashes=crashes,
+    )
+
+
+def test_simfleet_same_seed_and_plan_replays_bit_identical():
+    def run():
+        return SimulatedAsyncFleet(
+            64,
+            seed=42,
+            cluster_size=8,
+            updates_per_node=4,
+            slow_frac=0.1,
+            slow_factor=8.0,
+            plan=_chaos_plan(64, seed=1905),
+        ).run()
+
+    a, b = run(), run()
+    assert a.version == b.version and a.version > 0
+    np.testing.assert_array_equal(np.asarray(a.params["w"]), np.asarray(b.params["w"]))
+    assert a.loss_curve == b.loss_curve  # exact floats, exact virtual times
+    assert a.updates_dropped_wire == b.updates_dropped_wire
+    assert a.duplicates_injected == b.duplicates_injected
+    assert a.crashed == b.crashed
+    # a different seed diverges (the test has teeth)
+    c = SimulatedAsyncFleet(
+        64, seed=43, cluster_size=8, updates_per_node=4, slow_frac=0.1,
+        slow_factor=8.0, plan=_chaos_plan(64, seed=1905),
+    ).run()
+    assert not np.array_equal(np.asarray(a.params["w"]), np.asarray(c.params["w"]))
+
+
+def test_simfleet_1k_hierarchical_converges_without_round_barrier():
+    """ISSUE 9 acceptance: a 1k-node hierarchical fleet completes an
+    end-to-end convergence drive, and its makespan tracks the MEDIAN
+    node, not the straggler: with 10% of nodes 20× slower, a
+    barrier-synchronized fleet would take ≥ budget × slow duration."""
+    n, budget, slow_factor = 1000, 4, 20.0
+    fleet = SimulatedAsyncFleet(
+        n,
+        seed=7,
+        cluster_size=32,
+        updates_per_node=budget,
+        base_duration=1.0,
+        slow_frac=0.10,
+        slow_factor=slow_factor,
+        local_lr=0.7,
+    )
+    res = fleet.run()
+    assert res.version > 10, "global model barely advanced"
+    assert res.merges == res.version
+    # convergence: the consensus loss fell by >10x from the cold start
+    start_loss = fleet.loss_fn({"w": np.zeros_like(np.asarray(res.params["w"]))})
+    assert res.final_loss() < start_loss / 10
+    # no round barrier: a sync fleet's rounds are gated by the slowest
+    # node (≈ budget × 0.8·base×slow_factor at minimum); the async fleet's
+    # healthy majority finished its whole budget well before that
+    sync_floor = budget * 0.8 * slow_factor
+    healthy_done = [
+        t for t, _v, _l in res.loss_curve if t < sync_floor / 2
+    ]
+    assert healthy_done, "no merges landed before the sync floor"
+    assert res.time_to_target is None or res.time_to_target < sync_floor
+    # the staleness histogram saw real spread (slow nodes merge late)
+    from p2pfl_tpu.management.telemetry import telemetry
+
+    hists = telemetry.value_histograms()
+    stale = [v for k, v in hists.items() if k.endswith("/staleness") and v.get("count")]
+    assert stale, "no staleness observations recorded"
+
+
+# ---------------------------------------------------------------------------
+# real nodes: async federation over the in-memory transport
+# ---------------------------------------------------------------------------
+
+
+def _mk_nodes(n):
+    nodes = [Node(learner=DummyLearner(value=float(i))) for i in range(n)]
+    for node in nodes:
+        node.start()
+    for node in nodes:
+        full_connection(node, nodes)
+    wait_convergence(nodes, n - 1, only_direct=True, wait=10)
+    return nodes
+
+
+def _stop_all(nodes):
+    for n in nodes:
+        n.stop()
+
+
+def _sum_metric(metric):
+    return sum(d.get(metric, 0.0) for d in logger.get_comm_metrics().values())
+
+
+def test_async_federation_flat_e2e():
+    """4 nodes, flat FedBuff: every update merges (stash covers the
+    context race), everyone ends on the same final global version."""
+    Settings.FEDERATION_MODE = "async"
+    Settings.FEDBUFF_K = 3
+    Settings.HIER_CLUSTER_SIZE = 0
+    nodes = _mk_nodes(4)
+    try:
+        nodes[0].set_start_learning(rounds=3, epochs=1)
+        wait_to_finish(nodes, timeout=40)
+        assert _sum_metric("async_merge") >= 3
+        assert _sum_metric("async_model_adopt") >= 1
+        params = [np.asarray(n.learner.get_parameters()["w"]) for n in nodes]
+        for p in params[1:]:
+            np.testing.assert_allclose(p, params[0], atol=1e-6)
+        # a second experiment on the same overlay works (state cleared)
+        nodes[1].set_start_learning(rounds=1, epochs=1)
+        wait_to_finish(nodes, timeout=30, min_experiments=2)
+    finally:
+        _stop_all(nodes)
+
+
+def test_async_federation_hierarchical_chaos():
+    """ISSUE 9 acceptance (threaded half): 6 nodes in 2 clusters under
+    5% drop + slow peer + mid-run edge crash — survivors finish their
+    budgets, merges happen at both tiers, and the fleet ends converged
+    on one global, well inside the drain/timeout ceilings."""
+    Settings.FEDERATION_MODE = "async"
+    Settings.FEDBUFF_K = 3
+    Settings.HIER_CLUSTER_SIZE = 3
+    nodes = _mk_nodes(6)
+    victim, slow = nodes[4], nodes[5]
+    plan = FaultPlan(
+        seed=1905,
+        default=EdgeFault(drop=0.05),
+        slow_nodes={slow.addr: 0.2},
+        crashes={victim.addr: CrashSpec(stage="AsyncTrainStage", round_no=1)},
+    )
+    install_fault_plan(nodes, plan)
+    survivors = [n for n in nodes if n is not victim]
+    try:
+        t0 = time.monotonic()
+        nodes[0].set_start_learning(rounds=3, epochs=1)
+        wait_to_finish(survivors, timeout=45)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 40.0
+        assert not victim._running
+        for n in survivors:
+            assert n.state.round is None
+        assert _sum_metric("async_merge") >= 2
+        assert _sum_metric("fault_crash") == 1
+        params = [np.asarray(n.learner.get_parameters()["w"]) for n in survivors]
+        for p in params[1:]:
+            np.testing.assert_allclose(p, params[0], atol=1e-5)
+    finally:
+        remove_fault_plan(nodes)
+        _stop_all(nodes)
+
+
+def test_async_regional_crash_fails_over_to_root():
+    """A dead REGIONAL must not orphan its cluster: once eviction lands,
+    its edges re-route updates to the global root (push_target) and the
+    root adopts them into its push-down fan-out (live_children), so the
+    orphaned edges keep merging and keep receiving fresh globals."""
+    Settings.FEDERATION_MODE = "async"
+    Settings.FEDBUFF_K = 3
+    Settings.HIER_CLUSTER_SIZE = 3
+    nodes = _mk_nodes(6)
+    # members sort node-1..node-6 → clusters [1,2,3], [4,5,6]; node-4 is
+    # the non-root regional — crash IT mid-run
+    by_addr = {n.addr: n for n in nodes}
+    regional = by_addr[sorted(by_addr)[3]]
+    plan = FaultPlan(
+        seed=1905,
+        crashes={regional.addr: CrashSpec(stage="AsyncTrainStage", round_no=1)},
+    )
+    install_fault_plan(nodes, plan)
+    survivors = [n for n in nodes if n is not regional]
+    try:
+        nodes[0].set_start_learning(rounds=4, epochs=1)
+        wait_to_finish(survivors, timeout=60)
+        assert not regional._running
+        # the orphaned cluster's edges still ended on the fleet's final
+        # global (root adopted them), and merges continued after the crash
+        params = [np.asarray(n.learner.get_parameters()["w"]) for n in survivors]
+        for p in params[1:]:
+            np.testing.assert_allclose(p, params[0], atol=1e-5)
+        assert _sum_metric("async_merge") >= 2
+    finally:
+        remove_fault_plan(nodes)
+        _stop_all(nodes)
+
+
+def test_async_rejects_unsupported_compositions():
+    """secagg and topk8 abort the async experiment loudly at start."""
+    Settings.FEDERATION_MODE = "async"
+    nodes = _mk_nodes(2)
+    try:
+        old = Settings.SECURE_AGGREGATION
+        Settings.SECURE_AGGREGATION = True
+        try:
+            nodes[0].set_start_learning(rounds=1, epochs=1)
+            deadline = time.monotonic() + 10
+            while nodes[0].learning_active() and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert nodes[0].state.round is None
+            assert nodes[0]._running
+        finally:
+            Settings.SECURE_AGGREGATION = old
+    finally:
+        _stop_all(nodes)
