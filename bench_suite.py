@@ -1076,6 +1076,180 @@ def config5_nameplate_1b() -> None:
     })
 
 
+def _sharded_1b_hbm_projection() -> dict:
+    """Per-device params+Adam-moments bytes for the 1B nameplate tree under
+    the default partition rules, at model_parallel 1/4/8.
+
+    Pure accounting: the tree comes from ``jax.eval_shape`` (nothing is
+    allocated) and the per-device share from the rule engine's specs +
+    divisibility logic — exact on any backend, which is what makes a
+    per-node HBM column honest from a CPU-only bench container.
+    """
+    import jax.numpy as jnp
+    import optax
+
+    from p2pfl_tpu.models.transformer import (
+        CausalLM, TransformerConfig, resolve_attention,
+    )
+    from p2pfl_tpu.parallel.mesh import node_slices, submesh_federation_mesh
+    from p2pfl_tpu.parallel.sharding import DEFAULT_TRANSFORMER_RULES, tree_shardings
+
+    cfg = TransformerConfig(
+        vocab_size=4096, dim=2048, n_heads=32, n_kv_heads=4, n_layers=22,
+        ffn_hidden=5632, lora_rank=8, lora_mlp=True,
+    )
+    module = CausalLM(cfg, resolve_attention("dense"))
+    params = jax.eval_shape(
+        module.init, jax.random.PRNGKey(0), jnp.zeros((1, 1024), jnp.int32)
+    )["params"]
+    opt = jax.eval_shape(optax.adam(1e-3).init, params)
+    out = {"n_params": int(sum(np.prod(s.shape) for s in jax.tree.leaves(params)))}
+    for m in (1, 4, 8):
+        # the same engine that PLACES tensors computes the share: build the
+        # one-node (data=1, model=m) slice and ask each NamedSharding for
+        # its per-device shard shape — no hand-rolled divisibility copy
+        slice_mesh = node_slices(
+            submesh_federation_mesh(1, m, devices=jax.devices()[:m])
+        )[0]
+        total = 0
+        for tree in (params, opt):
+            shardings = tree_shardings(
+                slice_mesh, tree, DEFAULT_TRANSFORMER_RULES, on_unmatched="replicate"
+            )
+
+            def bytes_one(sharding, leaf):
+                shard = sharding.shard_shape(tuple(leaf.shape))
+                size = int(np.prod(shard)) if shard else 1
+                return size * np.dtype(leaf.dtype).itemsize
+
+            total += sum(jax.tree.leaves(jax.tree.map(bytes_one, shardings, tree)))
+        out[f"bytes_per_device_m{m}"] = int(total)
+        out[f"gb_per_device_m{m}"] = round(total / 2**30, 3)
+    return out
+
+
+def config5_sharded() -> None:
+    """Config 5's SHARDED-NODE row (ISSUE 10): one federation node = a
+    pjit submesh, cross-slice FedAvg fold — vs the single-chip path on
+    the same task, same steps/round, same target.
+
+    Two honest parts:
+
+    - an EXECUTED anchor on this container's backend: a small dense LM
+      (the nameplate architecture family) federated 2 nodes x
+      model_parallel=4 (8 virtual CPU devices) against the single-chip
+      SpmdFederation, identical seeds/steps-per-round/target, reporting
+      sec/round, rounds-to-target and the measured per-device live bytes
+      (the no-full-model-anywhere contract, measured not asserted). On
+      the CPU anchor ``mfu`` is null like every CPU row and wall-clock
+      favors the single-chip path (GSPMD partitioning overhead without
+      real ICI) — the dispatch structure, parity and memory split are
+      what transfer;
+    - the 1B NAMEPLATE projection: exact per-device params+opt bytes for
+      the 0.98B tree under the default partition rules at model_parallel
+      1/4/8 (``jax.eval_shape`` + the rule engine — no allocation, no
+      chip needed). m=1 is the single-chip row's footprint; m=4/8 is what
+      a v4/v5 slice per node buys.
+    """
+    if jax.default_backend() != "cpu" or len(jax.devices()) < 8:
+        _reexec("5sharded", timeout=1500, virtual_devices=8)
+        return
+
+    from p2pfl_tpu.learning.dataset import FederatedDataset
+    from p2pfl_tpu.models.transformer import TransformerConfig, tiny_transformer
+    from p2pfl_tpu.parallel import ShardedNodeFederation, SpmdFederation
+    from p2pfl_tpu.parallel.submesh import per_device_bytes
+
+    n = 2
+    target = 0.50
+    cap = 12
+    cfg = TransformerConfig(
+        vocab_size=256, dim=128, n_layers=2, n_heads=4, n_kv_heads=2, ffn_hidden=344
+    )
+    data = FederatedDataset.synthetic_lm(
+        vocab_size=256, seq_len=64, n_train=64, n_test=32, seed=7
+    )
+
+    sharded = ShardedNodeFederation.from_dataset(
+        tiny_transformer(seq_len=64, cfg=cfg), data, n_nodes=n,
+        model_parallel=4, batch_size=4, vote=False, seed=3,
+    )
+    # steady state measured on a fresh object (no reset on the sharded
+    # driver yet); rounds-to-target measured from round 0 on a new one
+    sharded.run_round(epochs=1)
+    sec_sharded = _steady_state(sharded, rounds=3)
+    sharded2 = ShardedNodeFederation.from_dataset(
+        tiny_transformer(seq_len=64, cfg=cfg), data, n_nodes=n,
+        model_parallel=4, batch_size=4, vote=False, seed=3,
+    )
+    accs_sh, r2t_sh = [], None
+    for r in range(cap):
+        sharded2.run_round(epochs=1)
+        accs_sh.append(round(sharded2.evaluate()["test_acc"], 4))
+        if accs_sh[-1] >= target:
+            r2t_sh = r + 1
+            break
+    hbm = per_device_bytes(sharded2.params, sharded2.opt_state)
+    max_dev_bytes = max(hbm.values())
+    full_bytes = sum(
+        int(np.prod(x.shape)) * np.dtype(x.dtype).itemsize
+        for x in jax.tree.leaves(sharded2.model.params)
+    )
+    log(f"config5_sharded: sharded {sec_sharded:.3f} s/round, "
+        f"target {target} in {r2t_sh} rounds, max dev bytes {max_dev_bytes}")
+
+    single = SpmdFederation.from_dataset(
+        tiny_transformer(seq_len=64, cfg=cfg), data, n_nodes=n,
+        batch_size=4, vote=False, seed=3,
+    )
+    single.run_round(epochs=1)
+    force_execution(single.params)
+    sec_single = _steady_state(single, rounds=3)
+    single.reset(seed=3)
+    accs_si, r2t_si = [], None
+    for r in range(cap):
+        single.run_round(epochs=1)
+        accs_si.append(round(single.evaluate()["test_acc"], 4))
+        if accs_si[-1] >= target:
+            r2t_si = r + 1
+            break
+    log(f"config5_sharded: single-chip {sec_single:.3f} s/round, "
+        f"target {target} in {r2t_si} rounds")
+
+    emit({
+        "metric": "config5_sharded",
+        "value": round(sec_sharded, 4),
+        "unit": "sec_per_round",
+        "cpu_anchor": True,
+        "model": "2L/128d/4h(kv2) SwiGLU-344 vocab-256 seq-64 (nameplate "
+                 "architecture family at CPU-anchor scale)",
+        "n_nodes": n,
+        "model_parallel": 4,
+        "steps_per_round": sharded2._nb,
+        "target_acc": target,
+        "rounds_to_target": r2t_sh,
+        "rounds_to_target_single_chip": r2t_si,
+        "next_token_acc_curve": accs_sh,
+        "next_token_acc_curve_single_chip": accs_si,
+        "sec_per_round_single_chip": round(sec_single, 4),
+        "mfu": None,
+        "max_device_bytes": int(max_dev_bytes),
+        "full_model_bytes": int(full_bytes),
+        "device_bytes_fraction": round(max_dev_bytes / (3 * full_bytes), 3),
+        "nameplate_1b_projection": _sharded_1b_hbm_projection(),
+        "note": "CPU anchor: same seeds/steps-per-round/target as the "
+                "single-chip comparison; GSPMD partitioning overhead "
+                "without real ICI makes sharded wall-clock LOSE on CPU — "
+                "the per-device memory split (max_device_bytes vs 3x "
+                "full_model_bytes for params+adam) and the 1B projection "
+                "are the accelerator-facing result. The 1B projection "
+                "uses the exact config5_nameplate_1b tree (same "
+                "steps/round and 0.65 target apply when run on hardware).",
+        "data": "synthetic-lm (markov, vocab 256)",
+        "devices": len(jax.devices()),
+    })
+
+
 def config6_heterogeneous_algorithms() -> None:
     """Beyond-reference breadth: FedAvg vs FedProx vs SCAFFOLD vs FedAdam on
     Dirichlet(0.3) non-IID shards (the reference ships FedAvg only).
@@ -1995,6 +2169,7 @@ CONFIGS = {
     "5": config5_lora_32node,
     "5scale": config5_scale_lm,
     "5b": config5_nameplate_1b,
+    "5sharded": config5_sharded,
     "6": config6_heterogeneous_algorithms,
     "7": config7_long_context_flash,
     "8": config8_wire_compression,

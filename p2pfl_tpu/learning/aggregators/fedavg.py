@@ -16,7 +16,7 @@ import jax.numpy as jnp
 from p2pfl_tpu.learning.aggregators.aggregator import Aggregator
 from p2pfl_tpu.learning.weights import ModelUpdate
 from p2pfl_tpu.ops.aggregation import fedavg, fedavg_fold_acc
-from p2pfl_tpu.ops.tree import tree_stack
+from p2pfl_tpu.ops.tree import tree_align_devices, tree_stack
 from p2pfl_tpu.settings import Settings
 
 
@@ -37,13 +37,18 @@ class FedAvg(Aggregator):
             params = fedavg_fold_acc(
                 psum,
                 wsum,
-                tuple(m.params for m in others),
+                # zero-copy in-memory peers may sit on ANOTHER submesh
+                # learner's device slice — align to the own accumulator's
+                # placement before the fold jit sees them
+                tuple(tree_align_devices(m.params, own.params) for m in others),
                 jnp.asarray([float(m.num_samples) for m in others], jnp.float32),
                 own.params,
                 Settings.AGG_DTYPE,
             )
             return ModelUpdate(params, contributors, total)
-        stacked = tree_stack([m.params for m in models])
+        stacked = tree_stack(
+            [tree_align_devices(m.params, models[0].params) for m in models]
+        )
         weights = jnp.asarray([float(m.num_samples) for m in models])
         params = fedavg(stacked, weights, Settings.AGG_DTYPE)
         return ModelUpdate(params, contributors, total)

@@ -277,7 +277,19 @@ def eval_step(params, x, y, module):
 
 
 class JaxLearner(NodeLearner):
-    """JAX/flax learner: jitted epoch scan + jitted eval (one chip)."""
+    """JAX/flax learner: jitted epoch scan + jitted eval.
+
+    One chip by default. Passing ``mesh`` (usually a node's ``(data,
+    model)`` submesh — :func:`~p2pfl_tpu.parallel.mesh.node_slices`)
+    places params AND optimizer state by partition rules
+    (``parallel/sharding.py``; ``partition_rules=None`` uses the
+    transformer defaults) and every jitted step — ``train_epoch``,
+    ``eval_step``, the fused round — compiles as a GSPMD program over
+    that mesh: computation follows the placed arguments, no learner code
+    changes. The rule set is linted against the model and mesh here, at
+    construction, so a typo'd regex fails at node startup rather than
+    silently replicating the model.
+    """
 
     def __init__(
         self,
@@ -292,6 +304,8 @@ class JaxLearner(NodeLearner):
         prox_mu: float = 0.0,
         dp_clip: float = 0.0,
         dp_noise: float = 0.0,
+        mesh=None,
+        partition_rules=None,
     ) -> None:
         self.model = model
         self.data = data
@@ -319,11 +333,45 @@ class JaxLearner(NodeLearner):
             if self.dp_noise > 0.0:
                 q = min(1.0, batch_size / max(1, data.num_samples))
                 self.accountant = PrivacyAccountant(self.dp_noise, q)
-        self.params: Pytree = model.params
-        self.opt_state = self.tx.init(self.params)
+        self.mesh = mesh
+        self._param_placement = None
+        self._opt_init = self.tx.init
+        if mesh is not None:
+            from p2pfl_tpu.parallel.sharding import (
+                DEFAULT_TRANSFORMER_RULES,
+                check_partition_rules,
+                tree_shardings,
+            )
+
+            rules = (
+                tuple(partition_rules)
+                if partition_rules is not None
+                else DEFAULT_TRANSFORMER_RULES
+            )
+            # loud at construction: unmatched paths / dead rules / unknown
+            # axes are a startup error, not an hour of silent replication
+            check_partition_rules(
+                rules, model.params, mesh, allow_dead=partition_rules is None
+            )
+            self._param_placement = tree_shardings(mesh, model.params, rules)
+            opt_struct = jax.eval_shape(self.tx.init, model.params)
+            # the same rules place the optimizer state (optax paths embed
+            # the param path); init runs jitted so the fresh state lands
+            # directly in its mesh layout
+            self._opt_init = jax.jit(
+                self.tx.init, out_shardings=tree_shardings(mesh, opt_struct, rules)
+            )
+        self.params: Pytree = self._place(model.params)
+        self.opt_state = self._opt_init(self.params)
         self._rng = np.random.default_rng(seed)
         self._interrupt = threading.Event()
         self._steps_done = 0
+
+    def _place(self, params: Pytree) -> Pytree:
+        """Incoming params → the learner's mesh layout (no-op unplaced)."""
+        if self._param_placement is None:
+            return params
+        return jax.device_put(params, self._param_placement)
 
     # ---- params ----
 
@@ -333,14 +381,14 @@ class JaxLearner(NodeLearner):
             from p2pfl_tpu.exceptions import ModelNotMatchingError
 
             raise ModelNotMatchingError("incoming params do not match model structure")
-        self.params = params
+        self.params = self._place(params)
         self.bump_model_version()
         if not self.keep_opt_state:
             # reference behavior: a fresh Trainer (and optimizer) per round
             # (lightning_learner.py:180-198). keep_opt_state=True carries the
             # Adam moments across rounds instead — the same documented
             # improvement knob as SpmdFederation(keep_opt_state=True)
-            self.opt_state = self.tx.init(params)
+            self.opt_state = self._opt_init(self.params)
 
     def get_parameters(self) -> Pytree:
         return self.params
@@ -453,7 +501,8 @@ class JaxLearner(NodeLearner):
             if tree_has_deleted(self.opt_state):
                 # the dispatch consumed the donated opt state before dying:
                 # rebuild instead of leaving deleted arrays in the store
-                self.opt_state = self.tx.init(self.params)
+                # (via the placed init so a submesh learner keeps its layout)
+                self.opt_state = self._opt_init(self.params)
             logger.error(
                 self.addr,
                 f"Fused round dispatch failed ({exc!r}) — opt state "

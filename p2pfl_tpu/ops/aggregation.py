@@ -73,6 +73,35 @@ def fedavg_fold_acc(
     return jax.tree.map(lambda s, r: (s / wsum).astype(r.dtype), psum, ref)
 
 
+def fedavg_fold_stacked(stacked_psum: Pytree, stacked_wsum: jax.Array, ref: Pytree) -> Pytree:
+    """Finish a FedAvg from node-stacked partial accumulators.
+
+    ``stacked_psum`` leaves are ``[N, ...]`` stacks of per-node
+    ``weight × params`` terms (each node's :func:`~p2pfl_tpu.parallel.
+    spmd.fused_node_round` ``psum`` output, already in ``AGG_DTYPE``);
+    ``stacked_wsum`` is the matching ``[N]`` weight vector. Reduces the
+    node axis then divides — the :func:`fedavg_fold_acc` algebra with the
+    peer fold expressed as an axis reduction, so under ``jit`` with the
+    node axis SHARDED over a mesh the reduction lowers to one per-shard
+    partial sum + all-reduce and no device ever holds more than its own
+    shard of the aggregate (the submesh federation's cross-slice fold,
+    ``parallel/submesh.py``).
+
+    Numerics: accumulate-then-divide, like :func:`fedavg_fold_acc` —
+    agrees with :func:`fedavg`'s normalize-then-tensordot to
+    summation-order ulp in the accumulate dtype, and bit-for-bit when the
+    node weights are equal (scaling by the common factor commutes with
+    every rounding step). ``ref`` gives the output dtypes. Deliberately
+    NOT jitted here: callers wrap it with their own ``out_shardings``
+    (zero masked-out contributions enter as explicit zero stacks, keeping
+    the reduction shape static per N).
+    """
+    wtot = jnp.sum(stacked_wsum)
+    return jax.tree.map(
+        lambda s, r: (jnp.sum(s, axis=0) / wtot).astype(r.dtype), stacked_psum, ref
+    )
+
+
 @partial(jax.jit, static_argnames=("lr", "agg_dtype"))
 def server_merge(prev: Pytree, avg: Pytree, lr: float = 1.0, agg_dtype: str = "float32") -> Pytree:
     """FedBuff server step: ``new = (1−η)·prev + η·avg`` in ``agg_dtype``.

@@ -42,6 +42,30 @@ def tree_stack(trees: Sequence[Pytree]) -> Pytree:
     return jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
 
 
+def tree_align_devices(tree: Pytree, like: Pytree) -> Pytree:
+    """Re-place ``tree``'s committed arrays onto ``like``'s shardings.
+
+    The zero-copy in-memory transport hands aggregators the sender's
+    actual device buffers; when learners are submesh-placed
+    (``JaxLearner(mesh=...)``) those live on ANOTHER node's slice, and a
+    jit mixing them with local state refuses with "incompatible devices".
+    One ``device_put`` per differing leaf re-places them (device-to-device
+    over ICI on a pod). Host numpy leaves and already-aligned arrays pass
+    through untouched, so the common single-device path pays nothing.
+    """
+
+    def one(x, l):  # noqa: E741 — like-leaf
+        if (
+            isinstance(x, jax.Array)
+            and isinstance(l, jax.Array)
+            and x.sharding != l.sharding
+        ):
+            return jax.device_put(x, l.sharding)
+        return x
+
+    return jax.tree.map(one, tree, like)
+
+
 def tree_unstack(stacked: Pytree, n: int) -> list[Pytree]:
     """Inverse of :func:`tree_stack`."""
     return [jax.tree.map(lambda x, i=i: x[i], stacked) for i in range(n)]

@@ -10,7 +10,11 @@ reduction that XLA lowers to an all-reduce over ICI. Control decisions
 boundary inside a round.
 """
 
-from p2pfl_tpu.parallel.mesh import federation_mesh
+from p2pfl_tpu.parallel.mesh import (
+    federation_mesh,
+    node_slices,
+    submesh_federation_mesh,
+)
 from p2pfl_tpu.parallel.pipeline import (
     pipeline_apply,
     pipeline_mesh,
@@ -22,18 +26,22 @@ from p2pfl_tpu.parallel.spmd import SpmdFederation
 __all__ = [
     "ChunkedFederation",
     "PipelineFederation",
+    "ShardedNodeFederation",
     "SpmdFederation",
     "SpmdLmFederation",
     "SpmdLoraFederation",
     "federation_mesh",
+    "node_slices",
     "pipeline_apply",
     "pipeline_mesh",
     "pipelined_lm_apply",
     "stack_layers",
+    "submesh_federation_mesh",
 ]
 
 _LAZY = {
     "ChunkedFederation": "p2pfl_tpu.parallel.chunked",
+    "ShardedNodeFederation": "p2pfl_tpu.parallel.submesh",
     "SpmdLoraFederation": "p2pfl_tpu.parallel.spmd_lora",
     "SpmdLmFederation": "p2pfl_tpu.parallel.spmd_lm",
     "PipelineFederation": "p2pfl_tpu.parallel.spmd_lm",

@@ -1,10 +1,22 @@
 """Mesh construction helpers.
 
-Axis convention (Settings.MESH_NODES_AXIS / MESH_MODEL_AXIS):
+Axis convention (Settings.MESH_NODES_AXIS / MESH_DATA_AXIS /
+MESH_MODEL_AXIS):
 - ``nodes``: one federated node per slot — data-parallel across the
   federation; collectives over this axis ride ICI within a slice.
+- ``data``: intra-node batch parallelism (submesh federations only).
 - ``model``: intra-node model sharding (tensor/sequence parallel) for
   models too big for one chip (BASELINE config 5). Size 1 by default.
+
+Two layouts ship:
+
+- :func:`federation_mesh` — the SPMD layout ``(nodes, model)``: logical
+  nodes fold onto slots, one jit program spans the whole mesh.
+- :func:`submesh_federation_mesh` — the sharded-node layout
+  ``(nodes, data, model)``: each node OWNS a ``(data, model)`` slice
+  (:func:`node_slices`) and runs its round as its own dispatch;
+  cross-slice aggregation is a collective over ``nodes``
+  (``parallel/submesh.py``).
 """
 
 from __future__ import annotations
@@ -29,16 +41,80 @@ def federation_mesh(
     federated nodes are folded onto slots (multiple nodes per slot when the
     federation is larger than the device count). Defaults to
     ``len(devices) // model_parallel``.
+
+    Every passed device must land in the mesh: a slot count that would
+    strand trailing devices raises instead of silently shrinking the mesh
+    (the pre-fix behavior quietly built a 2-device mesh out of 8 when
+    ``n_nodes=3`` — six chips idle with no indication). Callers that WANT
+    a subset pass ``devices=jax.devices()[:k]`` explicitly.
     """
     devices = list(devices if devices is not None else jax.devices())
     if model_parallel < 1 or len(devices) % model_parallel != 0:
         raise ValueError(f"model_parallel={model_parallel} does not divide {len(devices)} devices")
     slots = len(devices) // model_parallel
-    if n_nodes is not None:
-        slots = min(slots, n_nodes)
-        # keep the mesh rectangular: use the largest slot count that divides evenly
-        while len(devices) % (slots * model_parallel) != 0:
-            slots -= 1
-    use = devices[: slots * model_parallel]
-    arr = np.array(use).reshape(slots, model_parallel)
+    if n_nodes is not None and n_nodes < slots:
+        raise ValueError(
+            f"n_nodes={n_nodes} mesh slots would strand "
+            f"{len(devices) - n_nodes * model_parallel} of {len(devices)} devices "
+            f"(model_parallel={model_parallel}). Pass "
+            f"devices=devices[:{n_nodes * model_parallel}] to use a subset "
+            "deliberately, or let n_nodes default so logical nodes fold onto "
+            "all slots."
+        )
+    arr = np.array(devices).reshape(slots, model_parallel)
     return Mesh(arr, (Settings.MESH_NODES_AXIS, Settings.MESH_MODEL_AXIS))
+
+
+def submesh_federation_mesh(
+    n_nodes: int,
+    model_parallel: int = 1,
+    data_parallel: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the ``(nodes, data, model)`` global mesh for sharded nodes.
+
+    Exactly ``n_nodes * data_parallel * model_parallel`` devices are
+    required — every federated node owns a ``(data_parallel,
+    model_parallel)`` slice. With ``devices=None`` the first ``needed``
+    of ``jax.devices()`` are taken (and any surplus is reported loudly in
+    the error when the counts cannot work out). Device order decides
+    which node owns which chips: consecutive runs of ``data_parallel *
+    model_parallel`` devices form one node's slice, so multi-host
+    layouts can interleave processes by ordering the list.
+    """
+    if n_nodes < 1 or model_parallel < 1 or data_parallel < 1:
+        raise ValueError(
+            f"n_nodes={n_nodes}, data_parallel={data_parallel}, "
+            f"model_parallel={model_parallel} must all be >= 1"
+        )
+    needed = n_nodes * data_parallel * model_parallel
+    explicit = devices is not None
+    devices = list(devices if explicit else jax.devices())
+    if (explicit and len(devices) != needed) or len(devices) < needed:
+        raise ValueError(
+            f"submesh federation needs exactly {needed} devices "
+            f"({n_nodes} nodes x {data_parallel} data x {model_parallel} "
+            f"model), got {len(devices)}"
+        )
+    arr = np.array(devices[:needed]).reshape(n_nodes, data_parallel, model_parallel)
+    return Mesh(
+        arr,
+        (Settings.MESH_NODES_AXIS, Settings.MESH_DATA_AXIS, Settings.MESH_MODEL_AXIS),
+    )
+
+
+def node_slices(mesh: Mesh) -> list[Mesh]:
+    """Per-node ``(data, model)`` submeshes of a ``(nodes, data, model)`` mesh.
+
+    Slice ``i`` holds node ``i``'s devices; each node's training dispatch
+    targets its own slice, so slices run concurrently and independently.
+    """
+    nodes_axis = Settings.MESH_NODES_AXIS
+    if nodes_axis not in mesh.shape:
+        raise ValueError(f"mesh has no {nodes_axis!r} axis: {dict(mesh.shape)}")
+    axis_names = tuple(a for a in mesh.axis_names if a != nodes_axis)
+    node_dim = mesh.axis_names.index(nodes_axis)
+    return [
+        Mesh(np.take(mesh.devices, i, axis=node_dim), axis_names)
+        for i in range(mesh.shape[nodes_axis])
+    ]

@@ -1,24 +1,54 @@
-"""Parameter sharding rules: tensor parallelism over the ``model`` mesh axis.
+"""Partition-rule engine: regex rules over named pytrees → shardings.
 
-For models too big (or too slow) for one chip, transformer weights shard
-over ``Settings.MESH_MODEL_AXIS`` following the Megatron pattern:
+Model (and optimizer) state placement is driven by a *rule set*: an
+ordered list of ``(path regex, axis spec)`` pairs matched against each
+leaf's ``/``-joined tree path, **first match wins** (the fmengine /
+EasyLM ``match_partition_rules`` idiom). Axis specs name LOGICAL axes
+(``"model"``, ``"data"``, ``"nodes"``) which resolve to the mesh axis
+names in :class:`~p2pfl_tpu.settings.Settings` at spec-build time, so a
+rule set is mesh-layout-independent.
+
+Contract (enforced by :func:`check_partition_rules` at federation
+construction — a typo'd regex fails loudly at startup instead of
+silently replicating a 1B-param tensor):
+
+- every non-scalar leaf path is matched by some rule (scalars always
+  replicate — there is nothing to shard);
+- no dead rules: every rule is the *winning* (first) match for at least
+  one path — a rule that never wins is a shadowed or typo'd pattern;
+- every named axis in a winning spec exists in the target mesh.
+
+Placement itself stays forgiving on one point only: an axis whose mesh
+size does not divide the leaf dimension is dropped (replicated) for that
+leaf, because tiny test configs legitimately under-fill big meshes. The
+lint reports these as ``indivisible`` so real deployments can treat them
+as errors.
+
+The default transformer rule set follows the Megatron pattern:
 
 - attention q/k/v projections: column-parallel (shard the head/output dim),
 - attention output projection: row-parallel (shard the input dim),
 - MLP gate/up (w1/w3): column-parallel; down (w2): row-parallel,
 - embeddings: shard the vocab dim; norms and LoRA adapters replicate
   (adapters are tiny and are the federated payload — keeping them
-  replicated makes the FedAvg collective mesh-local).
+  replicated makes the FedAvg collective mesh-local),
+- MoE expert stacks ``[E, ...]`` shard the expert axis.
 
 XLA inserts the matching all-reduces at the row-parallel boundaries; with
 sequence sharded on the same axis (ring attention) activations stay
 distributed end to end.
+
+Optimizer state needs no separate rule set: optax state paths embed the
+param path (``0/mu/layer_0/attn/wq/kernel``), and rules use ``re.search``,
+so the same rules place both — Adam moments shard exactly like the params
+they mirror and the step counter replicates as a scalar.
 """
 
 from __future__ import annotations
 
 import re
-from typing import Any
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -27,9 +57,17 @@ from p2pfl_tpu.settings import Settings
 
 Pytree = Any
 
-# (path regex, spec builder) — first match wins; paths look like
-# "layer_0/attn/wq/kernel". LoRA params replicate (they're the federated unit).
-_RULES: list[tuple[str, tuple]] = [
+# One axis entry: None (replicate this dim), a logical axis name, or a
+# tuple of logical axis names (shard one dim over several mesh axes).
+AxisSpec = Optional[Any]
+PartitionRules = Sequence[tuple[str, Sequence[AxisSpec]]]
+
+# (path regex, spec) — first match wins; paths look like
+# "layer_0/attn/wq/kernel". LoRA params replicate (they're the federated
+# unit). The trailing catch-all replicates everything else (norm scales,
+# biases) — kept explicit so the rule set itself satisfies the "every
+# path matched" contract.
+DEFAULT_TRANSFORMER_RULES: PartitionRules = (
     (r"lora_", ()),  # replicated
     (r"attn/(wq|wk|wv)/kernel", (None, "model")),  # column-parallel
     (r"attn/wo/kernel", ("model", None)),  # row-parallel
@@ -41,17 +79,24 @@ _RULES: list[tuple[str, tuple]] = [
     (r"mlp/router$", ()),
     (r"mlp/w[123]$", ("model", None, None)),
     (r"embed", ("model", None)),  # vocab-sharded embeddings
-]
+    (r".*", ()),  # everything else replicates
+)
+
+# Logical axis tokens → Settings attribute carrying the mesh axis name.
+_LOGICAL_AXES = {
+    "model": "MESH_MODEL_AXIS",
+    "data": "MESH_DATA_AXIS",
+    "nodes": "MESH_NODES_AXIS",
+}
 
 
-def partition_spec_for(path: str) -> P:
-    for pattern, axes in _RULES:
-        if re.search(pattern, path):
-            named = tuple(
-                Settings.MESH_MODEL_AXIS if a == "model" else a for a in axes
-            )
-            return P(*named)
-    return P()  # replicate (norm scales, biases)
+def resolve_axis(token: AxisSpec) -> AxisSpec:
+    """Logical axis token → concrete mesh axis name (tuples element-wise)."""
+    if token is None:
+        return None
+    if isinstance(token, (tuple, list)):
+        return tuple(resolve_axis(t) for t in token)
+    return getattr(Settings, _LOGICAL_AXES.get(token, ""), token)
 
 
 def _path_str(key_path) -> str:
@@ -61,25 +106,255 @@ def _path_str(key_path) -> str:
     return "/".join(parts)
 
 
-def transformer_shardings(mesh: Mesh, params: Pytree) -> Pytree:
-    """NamedSharding pytree for a transformer param tree on ``mesh``."""
+def named_paths(tree: Pytree) -> list[tuple[str, Any]]:
+    """``[(slash/joined/path, leaf), ...]`` for every leaf of ``tree``."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [(_path_str(kp), leaf) for kp, leaf in flat]
+
+
+def _is_scalar(leaf) -> bool:
+    shape = getattr(leaf, "shape", ())
+    ndim = len(shape)
+    size = 1
+    for s in shape:
+        size *= s
+    return ndim == 0 or size == 1
+
+
+def _match_one(rules: PartitionRules, path: str) -> Optional[int]:
+    """Index of the first rule matching ``path`` (None = unmatched)."""
+    for i, (pattern, _) in enumerate(rules):
+        if re.search(pattern, path):
+            return i
+    return None
+
+
+def match_partition_rules(
+    rules: PartitionRules,
+    tree: Pytree,
+    *,
+    on_unmatched: str = "error",
+) -> Pytree:
+    """PartitionSpec pytree for ``tree`` under ``rules`` (first match wins).
+
+    Scalar leaves (0-d or single-element) always get ``P()`` — there is
+    nothing to shard and optimizer step counters must never trip the
+    unmatched check. ``on_unmatched``: ``"error"`` raises naming every
+    unmatched path (the loud default); ``"replicate"`` maps them to
+    ``P()`` (useful for exploratory trees).
+
+    Axis tokens in the winning spec resolve through
+    :data:`Settings.MESH_MODEL_AXIS` / ``MESH_DATA_AXIS`` /
+    ``MESH_NODES_AXIS`` at call time, so the same rule set follows a
+    renamed mesh.
+    """
+    if on_unmatched not in ("error", "replicate"):
+        raise ValueError(f"on_unmatched must be 'error'|'replicate', got {on_unmatched!r}")
+    unmatched: list[str] = []
 
     def one(key_path, leaf):
-        spec = partition_spec_for(_path_str(key_path))
-        # drop axis specs that don't divide the dim (tiny configs on big meshes)
+        path = _path_str(key_path)
+        if _is_scalar(leaf):
+            return P()
+        idx = _match_one(rules, path)
+        if idx is None:
+            unmatched.append(path)
+            return P()
+        _, axes = rules[idx]
+        return P(*(resolve_axis(a) for a in axes))
+
+    specs = jax.tree_util.tree_map_with_path(one, tree)
+    if unmatched and on_unmatched == "error":
+        raise ValueError(
+            "no partition rule matches "
+            f"{len(unmatched)} path(s): {unmatched[:8]}"
+            + (" …" if len(unmatched) > 8 else "")
+            + " — add a rule (a trailing ('.*', ()) replicates the rest)"
+        )
+    return specs
+
+
+@dataclass
+class RuleLintReport:
+    """Outcome of :func:`lint_partition_rules` — empty lists mean clean.
+
+    ``unmatched``: non-scalar paths no rule matches. ``dead_rules``: rule
+    patterns that are never the *winning* (first) match for any path —
+    shadowed or typo'd. ``unknown_axes``: ``(pattern, axis)`` pairs whose
+    resolved axis is absent from the mesh. ``indivisible``: ``(path, axis)``
+    pairs where the axis exists but its size does not divide the leaf dim
+    (placement replicates these — legitimate for tiny test models, an
+    error for a 1B deployment).
+    """
+
+    unmatched: list[str] = field(default_factory=list)
+    dead_rules: list[str] = field(default_factory=list)
+    unknown_axes: list[tuple[str, str]] = field(default_factory=list)
+    indivisible: list[tuple[str, str]] = field(default_factory=list)
+
+    @property
+    def errors(self) -> list[str]:
+        out = [f"unmatched path: {p}" for p in self.unmatched]
+        out += [f"dead rule (never first match): {r!r}" for r in self.dead_rules]
+        out += [f"rule {r!r} names axis {a!r} not in the mesh" for r, a in self.unknown_axes]
+        return out
+
+    def ok(self) -> bool:
+        return not self.errors
+
+
+def lint_partition_rules(
+    rules: PartitionRules,
+    tree: Pytree,
+    mesh: Optional[Mesh] = None,
+) -> RuleLintReport:
+    """Pure check of ``rules`` against ``tree``'s named paths (and ``mesh``).
+
+    Flags the three ways a rule set silently goes wrong — an unmatched
+    path (would replicate a tensor meant to shard), a dead rule (a typo'd
+    regex that never wins, its tensors falling through to a later rule),
+    and a spec naming an axis the mesh doesn't have. ``indivisible``
+    entries are informational: placement replicates those leaves.
+    """
+    report = RuleLintReport()
+    wins: set[int] = set()
+    for path, leaf in named_paths(tree):
+        if _is_scalar(leaf):
+            # scalars always place as P() and never count as unmatched —
+            # but a rule whose only matches are size-1 leaves is still a
+            # LIVE rule, not a dead one (e.g. an explicit rule for a
+            # (1,)-shaped logit scale must not fail the dead-rule check)
+            idx = _match_one(rules, path)
+            if idx is not None:
+                wins.add(idx)
+            continue
+        idx = _match_one(rules, path)
+        if idx is None:
+            report.unmatched.append(path)
+            continue
+        wins.add(idx)
+        _, axes = rules[idx]
+        if mesh is not None:
+            shape = getattr(leaf, "shape", ())
+            for dim, token in enumerate(axes):
+                axis = resolve_axis(token)
+                if axis is None:
+                    continue
+                group = axis if isinstance(axis, tuple) else (axis,)
+                known = [ax for ax in group if ax in mesh.shape]
+                for ax in group:
+                    if ax not in mesh.shape:
+                        report.unknown_axes.append((rules[idx][0], ax))
+                # divisibility is against the PRODUCT of the dim's mesh
+                # axes — exactly what placement (tree_shardings) divides
+                # by, so a product-indivisible tuple spec cannot lint
+                # clean while silently replicating
+                size = 1
+                for ax in known:
+                    size *= mesh.shape[ax]
+                if known and (dim >= len(shape) or shape[dim] % size != 0):
+                    report.indivisible.append((path, ax if len(group) == 1 else "+".join(group)))
+    report.dead_rules = [pat for i, (pat, _) in enumerate(rules) if i not in wins]
+    # dedupe, preserving order
+    report.unknown_axes = list(dict.fromkeys(report.unknown_axes))
+    return report
+
+
+def check_partition_rules(
+    rules: PartitionRules,
+    tree: Pytree,
+    mesh: Optional[Mesh] = None,
+    *,
+    allow_dead: bool = False,
+) -> RuleLintReport:
+    """:func:`lint_partition_rules`, raising ``ValueError`` on any error.
+
+    Run at federation construction. ``allow_dead=True`` skips the
+    dead-rule check — the built-in :data:`DEFAULT_TRANSFORMER_RULES` are
+    deliberately broader than any one model, so applying them to an MLP
+    leaves transformer rules unmatched by design.
+    """
+    report = lint_partition_rules(rules, tree, mesh)
+    errors = report.errors
+    if allow_dead:
+        errors = [e for e in errors if not e.startswith("dead rule")]
+    if errors:
+        raise ValueError(
+            "partition rule set fails lint:\n  " + "\n  ".join(errors[:16])
+            + ("\n  …" if len(errors) > 16 else "")
+        )
+    return report
+
+
+def tree_shardings(
+    mesh: Mesh,
+    tree: Pytree,
+    rules: PartitionRules = DEFAULT_TRANSFORMER_RULES,
+    *,
+    on_unmatched: str = "error",
+) -> Pytree:
+    """NamedSharding pytree placing ``tree`` on ``mesh`` per ``rules``.
+
+    The one forgiving step: an axis whose mesh size does not divide the
+    leaf dim is dropped (that dim replicates) — tiny configs on big
+    meshes. :func:`lint_partition_rules` reports exactly which leaves
+    this touched.
+    """
+    specs = match_partition_rules(rules, tree, on_unmatched=on_unmatched)
+
+    def one(spec, leaf):
+        shape = getattr(leaf, "shape", ())
         fixed = []
         for i, axis in enumerate(spec):
             if axis is None:
                 fixed.append(None)
                 continue
-            size = mesh.shape[axis]
-            if i < leaf.ndim and leaf.shape[i] % size == 0:
+            axes = axis if isinstance(axis, tuple) else (axis,)
+            size = 1
+            for ax in axes:
+                if ax not in mesh.shape:
+                    # a spec naming an axis the mesh doesn't carry must
+                    # fail HERE, not silently replicate — this is the
+                    # direct-call twin of the lint's unknown-axis error
+                    # (the pre-engine transformer_shardings raised too)
+                    raise ValueError(
+                        f"partition spec names axis {ax!r} not in the mesh "
+                        f"(axes: {tuple(mesh.shape)})"
+                    )
+                size *= mesh.shape[ax]
+            if i < len(shape) and shape[i] % size == 0:
                 fixed.append(axis)
             else:
                 fixed.append(None)
         return NamedSharding(mesh, P(*fixed))
 
-    return jax.tree_util.tree_map_with_path(one, params)
+    return jax.tree.map(one, specs, tree)
+
+
+def shard_tree(
+    mesh: Mesh,
+    tree: Pytree,
+    rules: PartitionRules = DEFAULT_TRANSFORMER_RULES,
+    *,
+    on_unmatched: str = "error",
+) -> Pytree:
+    """Place ``tree`` onto ``mesh`` per ``rules`` (``jax.device_put``)."""
+    return jax.device_put(tree, tree_shardings(mesh, tree, rules, on_unmatched=on_unmatched))
+
+
+# ---- transformer-rule conveniences (the pre-engine public API) ----
+
+
+def partition_spec_for(path: str) -> P:
+    """Spec for one path under :data:`DEFAULT_TRANSFORMER_RULES`."""
+    idx = _match_one(DEFAULT_TRANSFORMER_RULES, path)
+    _, axes = DEFAULT_TRANSFORMER_RULES[idx]  # catch-all: never None
+    return P(*(resolve_axis(a) for a in axes))
+
+
+def transformer_shardings(mesh: Mesh, params: Pytree) -> Pytree:
+    """NamedSharding pytree for a transformer param tree on ``mesh``."""
+    return tree_shardings(mesh, params, DEFAULT_TRANSFORMER_RULES)
 
 
 def shard_transformer(mesh: Mesh, params: Pytree) -> Pytree:

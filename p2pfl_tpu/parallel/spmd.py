@@ -141,6 +141,64 @@ def _local_epoch(
     return params, opt_state, jnp.mean(losses)
 
 
+def _node_round_core(
+    params,
+    opt_state,
+    xs,  # [E, nb, bs, ...] all local epochs' batches
+    ys,  # [E, nb, bs]
+    weight,  # fp32 scalar sample count (traced: reweighting never retraces)
+    x_test=None,
+    y_test=None,
+    *,
+    module,
+    tx,
+    prox_mu: float = 0.0,
+    with_acc: bool = True,
+    agg_dtype: str = "float32",
+):
+    """Trace-time body of :func:`fused_node_round` — one node's round.
+
+    Shared by the overlay fused round (single-chip dispatch) and the
+    submesh federation's per-slice dispatch
+    (``parallel/submesh.py submesh_node_round``), so the two paths cannot
+    drift: at ``model_parallel=1`` the sharded program IS this program,
+    which is the bit-parity contract.
+    """
+    out = {}
+    if x_test is not None:
+        e_loss, logits = ce_eval(params, module, x_test, y_test)
+        out["eval_loss"] = e_loss
+        out["eval_acc"] = jnp.mean(
+            (jnp.argmax(logits, axis=-1) == y_test).astype(jnp.float32)
+        )
+    anchor = params if prox_mu > 0.0 else None
+
+    def epoch(carry, batch):
+        p, o = carry
+        exs, eys = batch
+        p, o, loss = _local_epoch(
+            p, o, exs, eys, module, tx, False, prox_mu=prox_mu, anchor=anchor
+        )
+        return (p, o), loss
+
+    (params, opt_state), losses = jax.lax.scan(epoch, (params, opt_state), (xs, ys))
+    out["params"] = params
+    out["opt_state"] = opt_state
+    # [E] per-epoch mean losses — the caller logs the same per-epoch
+    # series the staged fit() produces (one metric point per epoch)
+    out["train_losses"] = losses
+    if with_acc:
+        # weighted fold in Settings.AGG_DTYPE (the same accumulate dtype
+        # the staged fedavg kernel uses), zero-init order identical to the
+        # staged aggregate's ``w·p`` term (0 + w·p ≡ w·p) — the bit-parity
+        # anchor for tests/test_fused_round.py
+        out["psum"] = jax.tree.map(
+            lambda p: p.astype(agg_dtype) * weight.astype(agg_dtype), params
+        )
+        out["wsum"] = weight.astype(agg_dtype)
+    return out
+
+
 @partial(
     jax.jit,
     static_argnames=("module", "tx", "prox_mu", "with_acc", "agg_dtype"),
@@ -188,39 +246,11 @@ def fused_node_round(
     passed. All metrics stay device values — the caller batches their D2H
     into one flush per round instead of one sync per step.
     """
-    out = {}
-    if x_test is not None:
-        e_loss, logits = ce_eval(params, module, x_test, y_test)
-        out["eval_loss"] = e_loss
-        out["eval_acc"] = jnp.mean(
-            (jnp.argmax(logits, axis=-1) == y_test).astype(jnp.float32)
-        )
-    anchor = params if prox_mu > 0.0 else None
-
-    def epoch(carry, batch):
-        p, o = carry
-        exs, eys = batch
-        p, o, loss = _local_epoch(
-            p, o, exs, eys, module, tx, False, prox_mu=prox_mu, anchor=anchor
-        )
-        return (p, o), loss
-
-    (params, opt_state), losses = jax.lax.scan(epoch, (params, opt_state), (xs, ys))
-    out["params"] = params
-    out["opt_state"] = opt_state
-    # [E] per-epoch mean losses — the caller logs the same per-epoch
-    # series the staged fit() produces (one metric point per epoch)
-    out["train_losses"] = losses
-    if with_acc:
-        # weighted fold in Settings.AGG_DTYPE (the same accumulate dtype
-        # the staged fedavg kernel uses), zero-init order identical to the
-        # staged aggregate's ``w·p`` term (0 + w·p ≡ w·p) — the bit-parity
-        # anchor for tests/test_fused_round.py
-        out["psum"] = jax.tree.map(
-            lambda p: p.astype(agg_dtype) * weight.astype(agg_dtype), params
-        )
-        out["wsum"] = weight.astype(agg_dtype)
-    return out
+    return _node_round_core(
+        params, opt_state, xs, ys, weight, x_test, y_test,
+        module=module, tx=tx, prox_mu=prox_mu, with_acc=with_acc,
+        agg_dtype=agg_dtype,
+    )
 
 
 def _aggregate(p_used, mask, weights, sel_idx, agg: str, trim: int, center=None, clip_tau: float = 1.0):
@@ -643,6 +673,70 @@ def tree_has_deleted(tree) -> bool:
     return False
 
 
+def stage_node_shards(datasets, batch_size: int) -> dict:
+    """Host-side shard staging policy shared by every node-stacked driver.
+
+    Pads each node's train shard to the common max by wrap-around, clips
+    test shards to the common min, and sizes the per-round batch count
+    from the common MIN shard (every node's per-round shuffle draws from
+    its OWN sample range — see :func:`draw_node_perms`). One
+    implementation, because the bit-parity contract between
+    :class:`SpmdFederation` and
+    :class:`~p2pfl_tpu.parallel.submesh.ShardedNodeFederation` depends on
+    both drivers sizing ``nb`` and padding shards identically — a policy
+    change here reaches both or neither.
+
+    Returns ``{"x": [N x np [S, ...]], "y": ..., "x_test": ..., "y_test":
+    ..., "sizes": [N], "nb": int}``.
+    """
+    sizes = [d.num_samples for d in datasets]
+    tr_min, tr_max = min(sizes), max(sizes)
+    te_min = min(len(d.y_test) for d in datasets)
+    if tr_min < batch_size:
+        raise ValueError(f"smallest shard ({tr_min}) < batch size ({batch_size})")
+
+    def wrap(a: np.ndarray, target: int) -> np.ndarray:
+        if len(a) == target:
+            return a
+        reps = -(-target // len(a))
+        return np.concatenate([a] * reps, axis=0)[:target]
+
+    return {
+        "x": [wrap(d.x_train, tr_max) for d in datasets],
+        "y": [wrap(d.y_train, tr_max) for d in datasets],
+        "x_test": [d.x_test[:te_min] for d in datasets],
+        "y_test": [d.y_test[:te_min] for d in datasets],
+        "sizes": sizes,
+        "nb": tr_min // batch_size,
+    }
+
+
+def draw_node_perms(
+    rng: np.random.Generator, sizes: list[int], nb: int, batch_size: int, epochs: int
+) -> np.ndarray:
+    """Per-node per-epoch shuffle indices ``[N, epochs, nb, bs]`` (int32).
+
+    Single source of the round's batch-draw rng stream: node-major, then
+    epoch-major, each draw one ``rng.permutation`` over the node's OWN
+    sample range. Shared by :class:`SpmdFederation` and
+    :class:`~p2pfl_tpu.parallel.submesh.ShardedNodeFederation` so the two
+    drivers consume identical rng state — the bit-parity tests compare
+    them round for round on one seed.
+    """
+    take = nb * batch_size  # always <= min shard size
+    return np.stack(
+        [
+            np.stack(
+                [
+                    rng.permutation(sizes[i])[:take].reshape(nb, batch_size)
+                    for _ in range(epochs)
+                ]
+            )
+            for i in range(len(sizes))
+        ]
+    ).astype(np.int32)
+
+
 def elect_train_set_mask(n: int, py_rng) -> np.ndarray:
     """Round-0 election: every node casts weighted random votes
     (``vote_train_set_stage.py:78-81``); top ``TRAIN_SET_SIZE`` win.
@@ -856,37 +950,21 @@ class SpmdFederation:
         # they stack into one [N, S, ...] array, but each node's per-round
         # shuffle indices are drawn from its OWN sample range (``_make_perm``)
         # — so the FedAvg sample-count weights match the data each node
-        # actually trains on (over rounds, every node covers its full shard)
-        sizes = [d.num_samples for d in self.datasets]
-        tr_min, tr_max = min(sizes), max(sizes)
-        te_min = min(len(d.y_test) for d in self.datasets)
-        if tr_min < self.batch_size:
-            raise ValueError(f"smallest shard ({tr_min}) < batch size ({self.batch_size})")
-
-        def wrap(a: np.ndarray, target: int) -> np.ndarray:
-            if len(a) == target:
-                return a
-            reps = -(-target // len(a))
-            return np.concatenate([a] * reps, axis=0)[:target]
-
-        self.x_all = jax.device_put(
-            np.stack([wrap(d.x_train, tr_max) for d in self.datasets]), self._shard
-        )
-        self.y_all = jax.device_put(
-            np.stack([wrap(d.y_train, tr_max) for d in self.datasets]), self._shard
-        )
-        self.x_test = jax.device_put(
-            np.stack([d.x_test[:te_min] for d in self.datasets]), self._shard
-        )
-        self.y_test = jax.device_put(
-            np.stack([d.y_test[:te_min] for d in self.datasets]), self._shard
-        )
+        # actually trains on (over rounds, every node covers its full shard).
+        # Policy (padding/clipping/nb sizing) lives in the shared
+        # :func:`stage_node_shards` — the submesh driver consumes the same
+        # helper, which is what keeps the two drivers' rng streams parity.
+        staged = stage_node_shards(self.datasets, self.batch_size)
+        self.x_all = jax.device_put(np.stack(staged["x"]), self._shard)
+        self.y_all = jax.device_put(np.stack(staged["y"]), self._shard)
+        self.x_test = jax.device_put(np.stack(staged["x_test"]), self._shard)
+        self.y_test = jax.device_put(np.stack(staged["y_test"]), self._shard)
         self._samples = jax.device_put(
-            jnp.asarray([float(s) for s in sizes]), self._shard
+            jnp.asarray([float(s) for s in staged["sizes"]]), self._shard
         )
-        self._sizes = sizes
-        self._tr_size = tr_max
-        self._nb = tr_min // self.batch_size
+        self._sizes = staged["sizes"]
+        self._tr_size = len(staged["x"][0])
+        self._nb = staged["nb"]
 
     # ---- election (host control plane — reference vote semantics) ----
 
@@ -898,20 +976,7 @@ class SpmdFederation:
     # ---- round driver ----
 
     def _make_perm_np(self, epochs: int) -> np.ndarray:
-        take = self._nb * self.batch_size  # always <= min shard size
-        return np.stack(
-            [
-                np.stack(
-                    [
-                        self._rng.permutation(self._sizes[i])[:take].reshape(
-                            self._nb, self.batch_size
-                        )
-                        for _ in range(epochs)
-                    ]
-                )
-                for i in range(self.n)
-            ]
-        ).astype(np.int32)
+        return draw_node_perms(self._rng, self._sizes, self._nb, self.batch_size, epochs)
 
     def _make_perm(self, epochs: int):
         return jax.device_put(self._make_perm_np(epochs), self._shard)

@@ -204,8 +204,27 @@ class SpmdLmFederation(SpmdFederation):
             if kwargs.get(unsupported):
                 raise ValueError(f"SpmdLmFederation does not support {unsupported}")
         if mesh is None:
+            # mirror SpmdFederation._default_mesh: pick the largest slot
+            # count the logical nodes fold onto evenly, and pass the exact
+            # device subset — federation_mesh refuses to strand devices
+            # silently (ISSUE 10 satellite), so the subset is explicit here
+            devices = jax.devices()
+            n = len(datasets)
+            slots = min(n, len(devices) // expert_parallel)
+            if slots < 1:
+                # expert_parallel wider than the device count: the old
+                # direct federation_mesh call raised here too — keep the
+                # failure at construction, not as a 0-slot mesh downstream
+                raise ValueError(
+                    f"expert_parallel={expert_parallel} needs at least "
+                    f"{expert_parallel} devices, have {len(devices)}"
+                )
+            while slots > 1 and n % slots != 0:
+                slots -= 1
             mesh = federation_mesh(
-                n_nodes=len(datasets), model_parallel=expert_parallel
+                n_nodes=slots,
+                model_parallel=expert_parallel,
+                devices=devices[: slots * expert_parallel],
             )
         super().__init__(model, datasets, mesh=mesh, **kwargs)
 

@@ -143,9 +143,14 @@ class Settings:
     AGG_DTYPE: str = "float32"
     # Donate weight buffers into jitted aggregation / train steps.
     DONATE_BUFFERS: bool = True
-    # Mesh axis names used by the parallel runtime.
+    # Mesh axis names used by the parallel runtime. ``nodes`` indexes
+    # federated nodes (or node slices), ``model`` is intra-node tensor
+    # parallelism, ``data`` is intra-node batch parallelism (submesh
+    # federations — parallel/submesh.py — give every node a
+    # ``(data, model)`` slice of the global ``(nodes, data, model)`` mesh).
     MESH_NODES_AXIS: str = "nodes"
     MESH_MODEL_AXIS: str = "model"
+    MESH_DATA_AXIS: str = "data"
     # Outgoing gRPC frame format: "envelope" (compact JSON-header frames,
     # the default) | "protobuf" (the reference's node.proto schema —
     # communication/proto_wire.py; control plane fully interoperable with

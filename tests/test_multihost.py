@@ -116,6 +116,91 @@ print(f"OK round process {pid}: loss {loss:.4f} fingerprint {float(fp):.6f}")
 """
 
 
+_SHARDED_WORKER = r"""
+import os, sys
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the chip tunnel
+os.environ["JAX_PLATFORMS"] = "cpu"
+# two virtual devices per process: 4 global devices = 2 sharded nodes x
+# model_parallel 2, with each node's slice INTERLEAVED across the hosts
+flags = os.environ.get("XLA_FLAGS", "")
+os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=2").strip()
+pid = int(sys.argv[1])
+os.environ["JAX_COORDINATOR_ADDRESS"] = "127.0.0.1:%PORT%"
+os.environ["JAX_NUM_PROCESSES"] = "2"
+os.environ["JAX_PROCESS_ID"] = str(pid)
+
+from p2pfl_tpu.parallel.distributed import init_multihost
+
+info = init_multihost()
+assert info["initialized"] and info["process_count"] == 2, info
+assert info["global_devices"] == 4, info
+
+# the sharded-node witness: every node is a model_parallel=2 submesh that
+# SPANS both hosts (device order [p0d0, p1d0] / [p0d1, p1d1]), so the
+# row-parallel all-reduce inside each node's round AND the cross-slice
+# aggregation fold both cross the process boundary (DCN on a pod). Both
+# processes build identical host state (same seeds) and dispatch the same
+# global programs — the multi-controller SPMD contract.
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.multihost_utils import process_allgather
+
+from p2pfl_tpu.learning.dataset import FederatedDataset
+from p2pfl_tpu.models import mlp
+from p2pfl_tpu.parallel import ShardedNodeFederation
+
+devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+per_proc = [d for d in devs if d.process_index == 0], [d for d in devs if d.process_index == 1]
+order = [per_proc[0][0], per_proc[1][0], per_proc[0][1], per_proc[1][1]]
+rules = (
+    (r"Dense_0/kernel", (None, "model")),
+    (r"Dense_1/kernel", ("model", None)),
+    (r"Dense_2/kernel", (None, "model")),
+    (r".*", ()),
+)
+data = FederatedDataset.synthetic_mnist(n_train=128, n_test=16, seed=5)
+try:
+    fed = ShardedNodeFederation.from_dataset(
+        mlp(seed=0), data, n_nodes=2, rules=rules, model_parallel=2,
+        devices=order, batch_size=16, vote=False, seed=3,
+    )
+    for node_devs in (fed.slices[0], fed.slices[1]):
+        procs = {d.process_index for d in np.asarray(node_devs.devices).flat}
+        assert procs == {0, 1}, procs  # each node spans BOTH hosts
+    entry = fed.run_round(epochs=1)
+except Exception as e:  # jaxlib builds without CPU multiprocess computations
+    if "aren't implemented" not in str(e):
+        raise
+    print(f"BACKEND-NO-MULTIPROC {pid}")
+    sys.exit(0)
+
+loss = float(entry["train_loss"])
+assert np.isfinite(loss), loss
+
+# the fold's psum saw BOTH slices: the stacked accumulator is sharded over
+# the nodes axis and its total weight is both nodes' sample counts
+psum_shardings = jax.tree.leaves(
+    fed.last_fold["psum_shardings"], is_leaf=lambda x: hasattr(x, "spec")
+)
+assert all(s.spec[0] == "nodes" for s in psum_shardings), "fold input not node-sharded"
+assert float(jnp.sum(fed.last_fold["wsum"])) == float(sum(fed._sizes))
+
+# diffusion: both nodes hold the identical aggregate...
+@jax.jit
+def fingerprint(tree):
+    return sum(jnp.sum(jnp.abs(x.astype(jnp.float32))) for x in jax.tree.leaves(tree))
+
+fp0 = fingerprint(fed.node_params(0))
+fp1 = fingerprint(fed.node_params(1))
+assert float(fp0) == float(fp1), (float(fp0), float(fp1))
+# ...and BOTH processes observe the same bits of it
+got = process_allgather(jnp.float32(float(fp0)))
+assert got.shape == (2,) and float(got[0]) == float(got[1]), got
+print(f"OK sharded process {pid}: loss {loss:.4f} fingerprint {float(fp0):.6f}")
+"""
+
+
 def _run_two_process_workers(tmp_path, worker_src, ok_marker, timeout=240):
     import socket
 
@@ -174,3 +259,14 @@ def test_two_process_federated_round_equal_models(tmp_path):
     cross-process FedAvg reduce, diffusion — ends with the identical
     aggregated model on both processes."""
     _run_two_process_workers(tmp_path, _ROUND_WORKER, "OK round process")
+
+
+@pytest.mark.slow
+def test_two_process_sharded_node_round(tmp_path):
+    """The sharded-node witness (ISSUE 10): two ``model_parallel=2``
+    submesh nodes whose slices each SPAN both processes' devices — the
+    in-round row-parallel all-reduce and the cross-slice aggregation
+    psum both cross the process boundary, and both processes end holding
+    the identical diffused aggregate. Backend-gated like the allgather
+    test (CPU jaxlib without multiprocess computations skips)."""
+    _run_two_process_workers(tmp_path, _SHARDED_WORKER, "OK sharded process")

@@ -19,7 +19,11 @@ def _dataset(n_train=2048, n_test=512):
 def test_mesh_shapes():
     mesh = federation_mesh()
     assert mesh.devices.size == len(jax.devices())
-    mesh2 = federation_mesh(n_nodes=4)
+    # fewer slots than devices needs an explicit device subset — bare
+    # n_nodes used to silently strand the trailing devices (ISSUE 10
+    # satellite: the node-folding edge case now raises, pinned in
+    # tests/test_submesh.py)
+    mesh2 = federation_mesh(n_nodes=4, devices=jax.devices()[:4])
     assert mesh2.shape["nodes"] == 4
 
 

@@ -1,0 +1,481 @@
+"""Sharded nodes (ISSUE 10): partition-rule engine, submesh federation,
+cross-slice aggregation.
+
+Contracts pinned here:
+
+- rule engine: first-match-wins, scalars replicate, unmatched paths loud;
+- rule lint: dead rules / unknown axes / unmatched paths fail federation
+  and learner construction at startup;
+- ``federation_mesh`` never silently strands trailing devices;
+- ``submesh_node_round`` at ``model_parallel=1`` is bit-identical to the
+  overlay ``fused_node_round`` (params, opt state, accumulator);
+- ``ShardedNodeFederation`` at ``model_parallel=1`` is bit-identical to
+  ``SpmdFederation`` on a fixed seed; at ``model_parallel>1`` it matches
+  to summation-order ulp while no device ever holds a full model
+  (live-buffer bound + fold sharding specs);
+- shard-wise fold vs restacked FedAvg numerical parity (bit-equal at
+  equal weights, ulp otherwise).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from p2pfl_tpu.learning.dataset import FederatedDataset
+from p2pfl_tpu.models import mlp
+from p2pfl_tpu.parallel.mesh import (
+    federation_mesh,
+    node_slices,
+    submesh_federation_mesh,
+)
+from p2pfl_tpu.parallel.sharding import (
+    check_partition_rules,
+    lint_partition_rules,
+    match_partition_rules,
+    tree_shardings,
+)
+from p2pfl_tpu.settings import Settings
+
+# the MLP's Megatron-style rule set: hidden dim column- then row-parallel
+MLP_RULES = (
+    (r"Dense_0/kernel", (None, "model")),
+    (r"Dense_1/kernel", ("model", None)),
+    (r"Dense_2/kernel", (None, "model")),
+    (r".*", ()),
+)
+
+
+def _tree_bit_equal(a, b) -> bool:
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    return all(np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(la, lb))
+
+
+# ---- rule engine ----
+
+
+def test_match_rules_first_match_wins_and_scalars_replicate():
+    tree = {
+        "layer": {"attn": {"wq": {"kernel": jnp.zeros((4, 8))}}},
+        "scale": jnp.zeros(()),  # scalar: always P() even though .* matches
+    }
+    rules = (
+        (r"attn/(wq|wk)/kernel", (None, "model")),
+        (r"kernel", ("model", None)),  # shadowed for wq — first match wins
+        (r".*", ()),
+    )
+    specs = match_partition_rules(rules, tree)
+    assert specs["layer"]["attn"]["wq"]["kernel"] == P(None, Settings.MESH_MODEL_AXIS)
+    assert specs["scale"] == P()
+
+
+def test_match_rules_unmatched_raises_and_replicate_mode():
+    tree = {"odd_name": jnp.zeros((4, 4))}
+    with pytest.raises(ValueError, match="no partition rule matches"):
+        match_partition_rules(((r"kernel", (None, "model")),), tree)
+    specs = match_partition_rules(
+        ((r"kernel", (None, "model")),), tree, on_unmatched="replicate"
+    )
+    assert specs["odd_name"] == P()
+
+
+def test_lint_reports_dead_rules_unknown_axes_unmatched():
+    mesh = federation_mesh(devices=jax.devices()[:2])  # (nodes=2, model=1)
+    tree = {
+        "Dense_0": {"kernel": jnp.zeros((8, 4)), "bias": jnp.zeros((4,))},
+        "odd": jnp.zeros((2, 2)),
+    }
+    rules = (
+        (r"Dense_0/kernel", (None, "model")),
+        (r"Dnse_0/kernel", ("model", None)),  # typo: never matches = dead
+        (r"bias", ("bogus_axis",)),
+    )
+    report = lint_partition_rules(rules, tree, mesh)
+    assert report.unmatched == ["odd"]
+    assert report.dead_rules == [r"Dnse_0/kernel"]
+    assert ("bias", "bogus_axis") in report.unknown_axes
+    assert not report.ok()
+    with pytest.raises(ValueError, match="fails lint"):
+        check_partition_rules(rules, tree, mesh)
+
+
+def test_lint_clean_set_and_indivisible_is_informational():
+    mesh = node_slices(submesh_federation_mesh(1, 2, devices=jax.devices()[:2]))[0]
+    tree = {"Dense_0": {"kernel": jnp.zeros((8, 6)), "bias": jnp.zeros((3,))}}
+    rules = ((r"kernel", (None, "model")), (r".*", ()))
+    report = lint_partition_rules(rules, tree, mesh)
+    assert report.ok()
+    # 6 % 2 == 0: divisible, nothing reported
+    assert report.indivisible == []
+    odd = {"Dense_0": {"kernel": jnp.zeros((8, 5)), "bias": jnp.zeros((3,))}}
+    report2 = lint_partition_rules(rules, odd, mesh)
+    assert report2.ok()  # indivisible is not an error…
+    assert ("Dense_0/kernel", Settings.MESH_MODEL_AXIS) in report2.indivisible
+    # …and placement replicates that leaf instead of failing
+    sh = tree_shardings(mesh, odd, rules)
+    assert sh["Dense_0"]["kernel"].spec == P(None, None)
+
+
+def test_tree_shardings_raises_on_unknown_axis_and_scalar_rules_stay_live():
+    # review regressions: (a) un-linted placement entry points must fail
+    # loudly on an axis the mesh doesn't carry (the pre-engine
+    # transformer_shardings raised KeyError; silent full replication is
+    # the exact failure the engine exists to prevent); (b) a rule whose
+    # only matches are size-1 leaves is live, not dead
+    mesh = federation_mesh(devices=jax.devices()[:2])  # axes: nodes, model
+    tree = {"w": jnp.zeros((4, 4))}
+    with pytest.raises(ValueError, match="not in the mesh"):
+        tree_shardings(mesh, tree, ((r"w", ("bogus_axis", None)),))
+    scalars = {"scale": jnp.zeros((1,)), "w": jnp.zeros((8, 8))}
+    rules = ((r"scale", ()), (r"w", ("model", None)))
+    report = lint_partition_rules(rules, scalars, mesh)
+    assert report.dead_rules == []
+    check_partition_rules(rules, scalars, mesh)  # must not raise
+
+
+def test_lint_tuple_axis_product_divisibility_matches_placement():
+    # review regression: a dim sharded over a TUPLE of axes divides by the
+    # PRODUCT of their sizes at placement — the lint must report the same
+    # product-indivisible leaves, or a spec could lint clean while
+    # silently replicating
+    mesh = node_slices(submesh_federation_mesh(1, 2, 2, devices=jax.devices()[:4]))[0]
+    tree = {"w": jnp.zeros((2, 4))}
+    rules = ((r"w", (("data", "model"), None)),)
+    report = lint_partition_rules(rules, tree, mesh)
+    assert report.indivisible == [("w", "data+model")]
+    assert tree_shardings(mesh, tree, rules)["w"].spec == P(None, None)
+    # product-divisible: clean lint, sharded placement
+    ok = {"w": jnp.zeros((4, 4))}
+    assert lint_partition_rules(rules, ok, mesh).indivisible == []
+    spec = tree_shardings(mesh, ok, rules)["w"].spec
+    assert spec == P((Settings.MESH_DATA_AXIS, Settings.MESH_MODEL_AXIS), None)
+
+
+def test_spmd_lm_default_mesh_folds_nodes_without_stranding():
+    # review regression: SpmdLmFederation's default mesh passes the exact
+    # device subset (n_nodes=2 x expert_parallel=2 on 8 devices used to
+    # rely on federation_mesh's silent truncation, which now raises)
+    from p2pfl_tpu.learning.dataset import FederatedDataset
+    from p2pfl_tpu.models.transformer import TransformerConfig, tiny_transformer
+    from p2pfl_tpu.parallel import SpmdLmFederation
+
+    cfg = TransformerConfig(
+        vocab_size=64, dim=32, n_layers=1, n_heads=2, n_kv_heads=1, ffn_hidden=64
+    )
+    data = FederatedDataset.synthetic_lm(
+        vocab_size=64, seq_len=16, n_train=32, n_test=8
+    )
+    fed = SpmdLmFederation.from_dataset(
+        tiny_transformer(seq_len=16, cfg=cfg), data, n_nodes=2,
+        expert_parallel=2, batch_size=4, vote=False,
+    )
+    assert dict(fed.mesh.shape) == {
+        Settings.MESH_NODES_AXIS: 2, Settings.MESH_MODEL_AXIS: 2
+    }
+
+
+def test_opt_state_places_by_the_same_rules():
+    import optax
+
+    mesh = node_slices(submesh_federation_mesh(1, 2, devices=jax.devices()[:2]))[0]
+    params = {"Dense_0": {"kernel": jnp.ones((8, 4)), "bias": jnp.zeros((4,))}}
+    tx = optax.adam(1e-3)
+    opt_struct = jax.eval_shape(tx.init, params)
+    sh = tree_shardings(mesh, opt_struct, MLP_RULES[:1] + ((r".*", ()),))
+    placed = jax.jit(tx.init, out_shardings=sh)(
+        jax.device_put(params, tree_shardings(mesh, params, MLP_RULES[:1] + ((r".*", ()),)))
+    )
+    mu_kernel = placed[0].mu["Dense_0"]["kernel"]
+    assert mu_kernel.sharding.spec == P(None, Settings.MESH_MODEL_AXIS)
+    # Adam's step counter is a scalar: replicated, never tripping the lint
+    assert placed[0].count.sharding.spec == P()
+
+
+# ---- mesh construction ----
+
+
+def test_federation_mesh_raises_on_stranded_devices():
+    devs = jax.devices()
+    # n_nodes=3 over 8 devices used to silently build a 2-device mesh
+    with pytest.raises(ValueError, match="strand"):
+        federation_mesh(n_nodes=3, devices=devs)
+    # the explicit-subset escape stays available and exact
+    m = federation_mesh(n_nodes=3, devices=devs[:3])
+    assert m.shape[Settings.MESH_NODES_AXIS] == 3
+    # n_nodes >= slots still folds logical nodes onto all slots
+    m2 = federation_mesh(n_nodes=64, devices=devs)
+    assert m2.shape[Settings.MESH_NODES_AXIS] == len(devs)
+
+
+def test_submesh_federation_mesh_and_node_slices():
+    gm = submesh_federation_mesh(2, model_parallel=2, data_parallel=2)
+    assert dict(gm.shape) == {
+        Settings.MESH_NODES_AXIS: 2,
+        Settings.MESH_DATA_AXIS: 2,
+        Settings.MESH_MODEL_AXIS: 2,
+    }
+    slices = node_slices(gm)
+    assert len(slices) == 2
+    assert dict(slices[0].shape) == {
+        Settings.MESH_DATA_AXIS: 2,
+        Settings.MESH_MODEL_AXIS: 2,
+    }
+    # disjoint device ownership — the slices are independent dispatch targets
+    d0 = set(np.asarray(slices[0].devices).flat)
+    d1 = set(np.asarray(slices[1].devices).flat)
+    assert not (d0 & d1)
+    with pytest.raises(ValueError, match="exactly"):
+        submesh_federation_mesh(3, model_parallel=3)  # 9 > 8 devices
+
+
+# ---- node round bit-parity ----
+
+
+def test_submesh_node_round_bit_identical_to_fused_node_round():
+    from p2pfl_tpu.learning.learner import sgd
+    from p2pfl_tpu.parallel.spmd import fused_node_round
+    from p2pfl_tpu.parallel.submesh import submesh_node_round
+
+    model = mlp(seed=0)
+    tx = sgd(1e-2)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(48, 28, 28, 1)).astype(np.float32))
+    y = jnp.asarray(rng.integers(0, 10, size=(48,)).astype(np.int32))
+    perm = rng.permutation(48).reshape(1, 6, 8).repeat(2, axis=0).astype(np.int32)
+    w = jnp.float32(64.0)
+
+    def run_fused():
+        p = jax.tree.map(jnp.asarray, model.params)
+        o = tx.init(p)
+        # the overlay path receives pre-gathered batches; the submesh path
+        # gathers the SAME rows in-program — values identical by gather
+        return fused_node_round(
+            p, o, jnp.take(x, perm, axis=0), jnp.take(y, perm, axis=0), w,
+            module=model.module, tx=tx,
+        )
+
+    def run_submesh():
+        p = jax.tree.map(jnp.asarray, model.params)
+        o = tx.init(p)
+        return submesh_node_round(p, o, x, y, perm, w, module=model.module, tx=tx)
+
+    a = run_fused()
+    b = run_submesh()
+    assert _tree_bit_equal(a["params"], b["params"])
+    assert _tree_bit_equal(a["opt_state"], b["opt_state"])
+    assert _tree_bit_equal(a["train_losses"], b["train_losses"])
+    # the submesh variant's accumulator carries the stacking axis, value-equal
+    assert _tree_bit_equal(
+        a["psum"], jax.tree.map(lambda x: x[0], b["psum"])
+    )
+    assert np.asarray(b["wsum"]).shape == (1,)
+    assert float(a["wsum"]) == float(b["wsum"][0])
+
+
+# ---- federation parity ----
+
+
+def _mk_feds(optimizer="sgd", model_parallel=1, keep_opt_state=False, n=4, vote=False):
+    from p2pfl_tpu.parallel import ShardedNodeFederation, SpmdFederation
+
+    data = FederatedDataset.synthetic_mnist(n_train=64 * n, n_test=32, seed=5)
+    kw = dict(
+        batch_size=16, vote=vote, seed=3, optimizer=optimizer,
+        learning_rate=1e-2, keep_opt_state=keep_opt_state,
+    )
+    sharded = ShardedNodeFederation.from_dataset(
+        mlp(seed=0), data, n_nodes=n, rules=MLP_RULES,
+        model_parallel=model_parallel, **kw,
+    )
+    ref = SpmdFederation.from_dataset(mlp(seed=0), data, n_nodes=n, **kw)
+    return sharded, ref
+
+
+def test_sharded_federation_m1_bit_identical_to_spmd():
+    sharded, ref = _mk_feds(optimizer="adam", keep_opt_state=True)
+    for _ in range(3):
+        sharded.run_round(epochs=1)
+        ref.run_round(epochs=1)
+    for i in range(sharded.n):
+        assert _tree_bit_equal(
+            sharded.node_params(i), jax.tree.map(lambda x, i=i: x[i], ref.params)
+        )
+        assert _tree_bit_equal(
+            sharded.opt_state[i], jax.tree.map(lambda x, i=i: x[i], ref.opt_state)
+        )
+    # the round accumulator fold saw every node: total weight is the full
+    # sample count (the [N] wsum vector is the retained introspection
+    # record; the psum buffers themselves must not outlive the fold)
+    assert float(jnp.sum(sharded.last_fold["wsum"])) == float(sum(sharded._sizes))
+
+
+def test_sharded_federation_m1_vote_path_matches_spmd():
+    # partial participation: non-elected nodes contribute explicit zero
+    # accumulators — the same w=0 terms the SPMD masked reduce carries
+    Settings.TRAIN_SET_SIZE = 3
+    sharded, ref = _mk_feds(n=4, vote=True)
+    for _ in range(2):
+        e1 = sharded.run_round(epochs=1)
+        e2 = ref.run_round(epochs=1)
+    assert (sharded.train_mask == ref.train_mask).all()
+    assert sharded.train_mask.sum() == 3.0
+    # 3 of 4 elected: total weight 192 is no longer a power-of-two multiple
+    # of each node's 64, so accumulate-then-divide vs normalize-then-
+    # tensordot agree to summation-order ulp — the documented fold
+    # numerics — not bit-for-bit (that contract holds at equal weights
+    # whose normalization is exact, i.e. full participation). The second
+    # round's training compounds the round-1 ulp, hence the looser bound.
+    for x, y in zip(
+        jax.tree.leaves(sharded.node_params(0)), jax.tree.leaves(ref.params)
+    ):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y[0]), rtol=1e-3, atol=5e-6)
+    assert np.isfinite(e1["train_loss"]) and np.isfinite(float(e2["train_loss"]))
+
+
+def test_sharded_federation_m2_matches_single_chip_to_ulp():
+    sharded, ref = _mk_feds(model_parallel=2)
+    for _ in range(2):
+        sharded.run_round(epochs=1)
+        ref.run_round(epochs=1)
+    for x, y in zip(jax.tree.leaves(sharded.node_params(0)), jax.tree.leaves(ref.params)):
+        np.testing.assert_allclose(
+            np.asarray(x), np.asarray(y[0]), rtol=2e-5, atol=1e-6
+        )
+
+
+def test_sharded_federation_never_materializes_full_model_per_device():
+    from p2pfl_tpu.parallel.submesh import per_device_bytes
+
+    sharded, _ = _mk_feds(model_parallel=2)
+    sharded.run_round(epochs=1)
+    # fold inputs: every stacked accumulator leaf was sharded over nodes
+    for sharding in jax.tree.leaves(
+        sharded.last_fold["psum_shardings"],
+        is_leaf=lambda x: hasattr(x, "spec"),
+    ):
+        assert sharding.spec[0] == Settings.MESH_NODES_AXIS
+        assert not sharding.is_fully_replicated
+    assert sharded.last_fold["wsum"].sharding.spec[0] == Settings.MESH_NODES_AXIS
+    # fold outputs: the diffused aggregate stays model-sharded — the big
+    # kernels' shards are half tensors, never the whole
+    p0 = sharded.node_params(0)
+    k0 = p0["Dense_0"]["kernel"]
+    assert k0.sharding.spec == P(None, Settings.MESH_MODEL_AXIS)
+    assert k0.addressable_shards[0].data.shape == (k0.shape[0], k0.shape[1] // 2)
+    # live-buffer bound: no device holds a full params+opt copy
+    full = sum(
+        np.asarray(x).nbytes
+        for x in jax.tree.leaves(sharded.model.params)
+    ) * 2  # params + adam mu/nu would be 3x; sgd opt is empty — params alone
+    per_dev = per_device_bytes(sharded.params, sharded.opt_state)
+    assert max(per_dev.values()) < full / 2 * 1.2  # ~half + replicated slack
+
+
+def test_fold_vs_restacked_fedavg_parity():
+    from jax.sharding import NamedSharding
+
+    from p2pfl_tpu.ops.aggregation import fedavg, fedavg_fold_stacked
+
+    rng = np.random.default_rng(7)
+    n = 4
+    # node axis SHARDED like the real fold (and like SpmdFederation's
+    # stacked reduce): both reductions then lower to the same per-shard
+    # partial + all-reduce — the layout the bit-equality claim lives on
+    mesh = federation_mesh(devices=jax.devices()[:n])
+    shard = NamedSharding(mesh, P(Settings.MESH_NODES_AXIS))
+    stacked = {
+        "a": jax.device_put(rng.normal(size=(n, 6, 4)).astype(np.float32), shard),
+        "b": jax.device_put(rng.normal(size=(n, 3)).astype(np.float32), shard),
+    }
+    ref_struct = jax.tree.map(lambda x: jax.ShapeDtypeStruct(x.shape[1:], x.dtype), stacked)
+
+    def fold(weights):
+        w = jax.device_put(np.asarray(weights, np.float32), shard)
+        psum = jax.jit(
+            lambda s, ws: jax.tree.map(
+                lambda x: x * ws.reshape((n,) + (1,) * (x.ndim - 1)), s
+            )
+        )(stacked, w)
+        return jax.jit(lambda p, ws: fedavg_fold_stacked(p, ws, ref_struct))(psum, w)
+
+    # equal weights: scaling by the common factor commutes with every
+    # rounding step — bit-identical to the restacked fedavg kernel
+    eq = fold([32.0] * n)
+    restacked_eq = fedavg(stacked, jax.device_put(np.full(n, 32.0, np.float32), shard))
+    assert _tree_bit_equal(eq, restacked_eq)
+    # unequal weights: accumulate-then-divide vs normalize-then-tensordot —
+    # summation-order ulp, not bit-for-bit (the documented honest numerics)
+    uneq_w = [31.0, 64.0, 17.0, 96.0]
+    uneq = fold(uneq_w)
+    restacked = fedavg(stacked, jax.device_put(np.asarray(uneq_w, np.float32), shard))
+    for x, y in zip(jax.tree.leaves(uneq), jax.tree.leaves(restacked)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=1e-6)
+
+
+def test_sharded_federation_data_parallel_smoke():
+    from p2pfl_tpu.parallel import ShardedNodeFederation
+
+    data = FederatedDataset.synthetic_mnist(n_train=128, n_test=16, seed=5)
+    fed = ShardedNodeFederation.from_dataset(
+        mlp(seed=0), data, n_nodes=2, rules=MLP_RULES,
+        model_parallel=2, data_parallel=2, batch_size=16, vote=False, seed=3,
+    )
+    assert len(set(np.asarray(fed.mesh.devices).flat)) == 8
+    e = fed.run_round(epochs=1, eval=True)
+    assert np.isfinite(e["train_loss"]) and 0.0 <= e["test_acc"] <= 1.0
+    # diffusion: both nodes hold the identical aggregate
+    assert _tree_bit_equal(fed.node_params(0), fed.node_params(1))
+
+
+def test_sharded_federation_rejects_bad_rules_and_secagg():
+    from p2pfl_tpu.parallel import ShardedNodeFederation
+
+    data = FederatedDataset.synthetic_mnist(n_train=64, n_test=16, seed=5)
+    with pytest.raises(ValueError, match="fails lint"):
+        ShardedNodeFederation.from_dataset(
+            mlp(seed=0), data, n_nodes=2,
+            rules=((r"Dnse_0/kernel", (None, "model")), (r".*", ())),
+            batch_size=16,
+        )
+    Settings.SECURE_AGGREGATION = True
+    try:
+        with pytest.raises(ValueError, match="trust domain"):
+            ShardedNodeFederation.from_dataset(
+                mlp(seed=0), data, n_nodes=2, rules=MLP_RULES, batch_size=16
+            )
+    finally:
+        Settings.SECURE_AGGREGATION = False
+
+
+def test_jax_learner_submesh_placement_matches_plain_learner():
+    from p2pfl_tpu.learning.learner import JaxLearner
+
+    data = FederatedDataset.synthetic_mnist(n_train=64, n_test=16, seed=1)
+    gm = submesh_federation_mesh(1, model_parallel=2, devices=jax.devices()[:2])
+    sm = node_slices(gm)[0]
+    placed = JaxLearner(
+        mlp(seed=0), data, batch_size=16, seed=9, mesh=sm, partition_rules=MLP_RULES
+    )
+    plain = JaxLearner(mlp(seed=0), data, batch_size=16, seed=9)
+    # state placed per the rules, optimizer moments included
+    k = placed.params["Dense_0"]["kernel"]
+    assert k.sharding.spec == P(None, Settings.MESH_MODEL_AXIS)
+    mu_k = placed.opt_state[0].mu["Dense_0"]["kernel"]
+    assert mu_k.sharding.spec == P(None, Settings.MESH_MODEL_AXIS)
+    placed.fit()
+    plain.fit()
+    for x, y in zip(jax.tree.leaves(placed.params), jax.tree.leaves(plain.params)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=2e-5, atol=1e-6)
+    # the fused round runs sharded and its accumulator keeps the layout
+    upd = placed.fused_round()
+    assert upd is not None
+    psum, _ = upd.partial_acc
+    assert psum["Dense_0"]["kernel"].sharding.spec == P(None, Settings.MESH_MODEL_AXIS)
+    # a typo'd rule set fails at learner construction
+    with pytest.raises(ValueError, match="fails lint"):
+        JaxLearner(
+            mlp(seed=0), data, batch_size=16, mesh=sm,
+            partition_rules=((r"Dnse/kernel", ("model",)),),
+        )
