@@ -24,6 +24,12 @@ clock, bit-identical replay) and compares against the sync fleet's
 analytic floor — a barrier fleet cannot finish a round faster than its
 slowest member trains.
 
+The ``churn_1k`` section (ISSUE 11) drives the same 1k-node simulated
+fleet under a seeded elastic-churn plan — 5% leaves (graceful + abrupt),
+5% joins, one mid-convergence GLOBAL-ROOT kill — against the static
+fleet, so the disruption cost of membership churn is a measured
+time-to-target ratio, not a claim.
+
 Usage: ``JAX_PLATFORMS=cpu python bench_async.py [--smoke] [--out BENCH_ASYNC.json]``
 """
 
@@ -279,6 +285,93 @@ def run_simulated(n: int = 1000, updates: int = 6, smoke: bool = False) -> dict:
     }
 
 
+def run_churn(n: int = 1000, updates: int = 6, smoke: bool = False) -> dict:
+    """ISSUE 11: the disruption cost of elastic churn as a number.
+
+    The same 1k-node hierarchical consensus fleet driven twice — static
+    membership vs a seeded churn plan (5% graceful+abrupt leaves, 5%
+    joins, one GLOBAL-ROOT kill) — comparing time-to-loss-target and
+    merge counts. The churn fleet must still reach the target: successor
+    roots self-elect, buffers migrate, joiners bootstrap from the
+    current global, and version minting stays monotone through the
+    failover (federation/routing.py).
+    """
+    from p2pfl_tpu.communication.faults import FaultPlan, JoinSpec, LeaveSpec
+    from p2pfl_tpu.federation.simfleet import SimulatedAsyncFleet
+
+    if smoke:
+        n, updates = 100, 4
+    addrs = [f"sim-{i:04d}" for i in range(n)]
+    n_churn = max(2, n // 20)  # 5%
+    leaves = {
+        a: LeaveSpec(at_s=0.4 + 0.02 * j, graceful=(j % 2 == 0))
+        for j, a in enumerate(addrs[3 :: max(1, n // n_churn)][:n_churn])
+    }
+    # the ROOT KILL, time-targeted mid-convergence: an abrupt
+    # (graceful=False) leave is a killed process — no announcement,
+    # survivors discover it a full evict_delay later. t=0.9 lands in the
+    # middle of the first convergence waterfall while the root is the
+    # only node minting globals, so the measured disruption is the real
+    # failover cost: a stall of ~evict_delay, then the successor root
+    # resumes minting from the version high-water mark.
+    leaves[addrs[0]] = LeaveSpec(at_s=0.9, graceful=False)
+    plan = FaultPlan(
+        seed=SEED,
+        leaves=leaves,
+        joins={f"sim-j{j:03d}": JoinSpec(at_s=0.6 + 0.02 * j) for j in range(n_churn)},
+    )
+
+    def make_fleet(churn: bool) -> SimulatedAsyncFleet:
+        # local_lr 0.3 (vs run_simulated's 0.7): convergence then takes
+        # several merge generations instead of one wave, so the churn
+        # window (leaves/joins from 0.4s, the root kill at 0.9s) sits
+        # INSIDE the measured time-to-target interval — at 0.7 every
+        # target tight enough to matter is hit in the first wave and the
+        # disruption ratio is vacuously 1.0
+        return SimulatedAsyncFleet(
+            n, seed=SEED, cluster_size=32, updates_per_node=updates,
+            local_lr=0.3, plan=plan if churn else None,
+        )
+
+    probe = make_fleet(False)
+    dim = len(np.asarray(probe.nodes[addrs[0]].model["w"]))
+    start_loss = probe.loss_fn({"w": np.zeros(dim, np.float32)})
+    target = float(start_loss) * 0.05
+
+    def drive(churn: bool) -> dict:
+        fleet = make_fleet(churn)
+        fleet.target_loss = target
+        res = fleet.run()
+        versions = [v for _t, v, _l in res.loss_curve]
+        return {
+            "time_to_target_s": round(res.time_to_target, 3) if res.time_to_target else None,
+            "makespan_virtual_s": round(res.virtual_time, 3),
+            "global_versions": res.version,
+            "merges": res.merges,
+            "final_loss": round(res.final_loss(), 5),
+            "joined": len(res.joined),
+            "left": len(res.left),
+            "crashed": len(res.crashed),
+            "root_failovers": res.failovers,
+            "version_monotone": versions == sorted(versions) and len(set(versions)) == len(versions),
+        }
+
+    static, churn = drive(False), drive(True)
+    disruption = None
+    if static["time_to_target_s"] and churn["time_to_target_s"]:
+        disruption = round(churn["time_to_target_s"] / static["time_to_target_s"], 3)
+    return {
+        "n_nodes": n,
+        "updates_per_node": updates,
+        "plan": {"leave_frac": 0.05, "join_frac": 0.05, "root_kill": True, "seed": SEED},
+        "start_loss": round(float(start_loss), 5),
+        "target_loss": round(target, 5),
+        "static": static,
+        "churn": churn,
+        "disruption_time_to_target_ratio": disruption,
+    }
+
+
 def main() -> int:
     smoke = "--smoke" in sys.argv
     out_path = "BENCH_ASYNC.json"
@@ -298,6 +391,9 @@ def main() -> int:
     log("=== simulated 1k ===")
     simulated = run_simulated(smoke=smoke)
 
+    log("=== churn 1k ===")
+    churn = run_churn(smoke=smoke)
+
     doc = {
         "bench": "async_federation_time_to_accuracy",
         "fleet": {
@@ -311,6 +407,7 @@ def main() -> int:
         },
         "threaded": rows,
         "simulated_1k": simulated,
+        "churn_1k": churn,
         "smoke": smoke,
     }
     with open(out_path, "w") as f:

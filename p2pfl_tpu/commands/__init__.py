@@ -21,8 +21,12 @@ from p2pfl_tpu.commands.control import (
 )
 from p2pfl_tpu.commands.federation import (
     AsyncDoneCommand,
+    AsyncJoinCommand,
+    AsyncLeaveCommand,
     AsyncModelCommand,
+    AsyncPullCommand,
     AsyncUpdateCommand,
+    AsyncViewCommand,
 )
 from p2pfl_tpu.commands.heartbeat import HeartbeatCommand
 from p2pfl_tpu.commands.learning import (
@@ -34,8 +38,12 @@ from p2pfl_tpu.commands.learning import (
 
 __all__ = [
     "AsyncDoneCommand",
+    "AsyncJoinCommand",
+    "AsyncLeaveCommand",
     "AsyncModelCommand",
+    "AsyncPullCommand",
     "AsyncUpdateCommand",
+    "AsyncViewCommand",
     "Command",
     "HeartbeatCommand",
     "StartLearningCommand",

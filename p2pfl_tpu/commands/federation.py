@@ -1,13 +1,23 @@
-"""Async-federation commands: update push, model push, done announcement.
+"""Async-federation commands: update/model push, done/leave/pull verbs.
 
 The async control plane's wire verbs (``federation/workflow.py``):
 
 - ``async_update`` (weights plane) — a node's training update, or a
   regional's merged aggregate, pushed to the next aggregation tier up;
 - ``async_model`` (weights plane) — a freshly minted global model pushed
-  down the tiers;
+  down the tiers (also the reply to an ``async_pull``);
 - ``async_done`` (control plane, TTL-flooded) — a node announcing its
-  local update budget is spent, releasing aggregators' drain waits.
+  local update budget is spent, releasing aggregators' drain waits;
+- ``async_join`` (control plane, TTL-flooded) — a joiner announcing it
+  is ENTERING the running experiment: members fold it into the topology
+  on this announcement (mere overlay presence is not membership — a
+  monitor connecting mid-run must not be elected aggregator);
+- ``async_pull`` (control plane, direct) — a joiner asking its nearest
+  aggregator for the current global (the elastic-membership bootstrap);
+- ``async_leave`` (control plane, TTL-flooded) — a member announcing a
+  GRACEFUL departure: receivers mark it done AND dead, re-deriving the
+  topology around the hole immediately instead of waiting a heartbeat
+  eviction window.
 
 Both weights handlers drop (never stop the node) on malformed payloads:
 an async fleet is long-running by design, and one garbage frame from a
@@ -22,6 +32,7 @@ from typing import TYPE_CHECKING
 
 from p2pfl_tpu.commands.command import Command
 from p2pfl_tpu.exceptions import DecodingParamsError, ModelNotMatchingError
+from p2pfl_tpu.federation.staleness import xp_mismatch
 from p2pfl_tpu.learning.weights import ModelUpdate
 from p2pfl_tpu.management.logger import logger
 
@@ -129,7 +140,134 @@ class AsyncDoneCommand(Command):
 
     def execute(self, source: str, round: int, *args, **kwargs) -> None:  # noqa: A002
         st = self._state
+        # experiment-identity gate: a slow peer's done broadcast from the
+        # PREVIOUS experiment (TTL-relayed duplicate landing after our
+        # set_experiment) must not pre-mark it done for THIS one — the
+        # drain would skip the window that merges its tail. Frames
+        # without the header fall back to the set-reset at experiment
+        # boundaries alone.
+        if xp_mismatch(st.addr, kwargs.get("xp"), st.experiment_xid):
+            return
         # monotone set-union under the same merge lock as the other
         # control-plane lattices; cleared at experiment boundaries
         with st.status_merge_lock:
             st.async_done_peers.add(source)
+
+
+class AsyncJoinCommand(Command):
+    """A joiner announced itself: membership grows, topology re-derives."""
+
+    def __init__(self, node: "Node") -> None:
+        self._node = node
+
+    @staticmethod
+    def get_name() -> str:
+        return "async_join"
+
+    def execute(self, source: str, round: int, *args, **kwargs) -> None:  # noqa: A002
+        node = self._node
+        ctx = node.async_ctx
+        if ctx is None or not ctx.accepting:
+            return
+        if xp_mismatch(node.addr, kwargs.get("xp"), node.state.experiment_xid):
+            return
+        ctx.execute_actions(ctx.add_member(source))
+        if ctx.accepting and ctx.take_stash_dirty():
+            drain_async_stash(node, ctx)
+
+
+class AsyncPullCommand(Command):
+    """A joiner's bootstrap request: push it the current global."""
+
+    def __init__(self, node: "Node") -> None:
+        self._node = node
+
+    @staticmethod
+    def get_name() -> str:
+        return "async_pull"
+
+    def execute(self, source: str, round: int, *args, **kwargs) -> None:  # noqa: A002
+        node = self._node
+        ctx = node.async_ctx
+        if ctx is not None and ctx.accepting:
+            logger.log_comm_metric(node.addr, "async_pull_served")
+            # ship our (members, dead) view alongside the global: the
+            # puller (a joiner) derives its topology from a live overlay
+            # view that lacks the dead members everyone else keeps as
+            # cluster holes — without the merge its chunking would
+            # diverge from the fleet's for the rest of the run
+            members, dead = ctx.view_snapshot()
+            node.protocol.send(
+                source,
+                node.protocol.build_msg("async_view", [";".join(members), ";".join(dead)]),
+                create_connection=True,
+            )
+            ctx.execute_actions(ctx.bootstrap_reply(source))
+            return
+        # the workflow already exited: serve the finished experiment's
+        # canonical result (a peer's EXIT pull — its every inbound push
+        # targeted a corpse — may arrive after our teardown; exit timing
+        # across the fleet is jittered by per-node eviction clocks)
+        last = node._last_async_global
+        if last is not None:
+            params, version, xid = last
+            upd = ModelUpdate(params, [node.addr], 1)
+            upd.version = (node.addr, version, version)
+            upd.xp = xid
+            env = node.protocol.build_weights("async_model", version, upd)
+            node.protocol.send(source, env, create_connection=True)
+            logger.log_comm_metric(node.addr, "async_pull_served")
+            return
+        logger.log_comm_metric(node.addr, "async_pull_dropped")
+
+
+class AsyncViewCommand(Command):
+    """A peer's (members, dead) membership view — merged monotonically."""
+
+    def __init__(self, node: "Node") -> None:
+        self._node = node
+
+    @staticmethod
+    def get_name() -> str:
+        return "async_view"
+
+    def execute(self, source: str, round: int, *args, **kwargs) -> None:  # noqa: A002
+        node = self._node
+        ctx = node.async_ctx
+        if ctx is None or not ctx.accepting:
+            return
+        if xp_mismatch(node.addr, kwargs.get("xp"), node.state.experiment_xid):
+            return
+        members = [m for m in (args[0] if args else "").split(";") if m]
+        dead = [d for d in (args[1] if len(args) > 1 else "").split(";") if d]
+        ctx.execute_actions(ctx.merge_view(members, dead))
+        if ctx.accepting and ctx.take_stash_dirty():
+            drain_async_stash(node, ctx)
+
+
+class AsyncLeaveCommand(Command):
+    """A member left gracefully: done + dead in one announcement."""
+
+    def __init__(self, node: "Node") -> None:
+        self._node = node
+
+    @staticmethod
+    def get_name() -> str:
+        return "async_leave"
+
+    def execute(self, source: str, round: int, *args, **kwargs) -> None:  # noqa: A002
+        node = self._node
+        st = node.state
+        if xp_mismatch(st.addr, kwargs.get("xp"), st.experiment_xid):
+            return
+        with st.status_merge_lock:
+            st.async_done_peers.add(source)
+        ctx = node.async_ctx
+        if ctx is None or not ctx.accepting:
+            return
+        # same membership event as an eviction, minus the detection
+        # latency (the leaver TOLD us); may promote this node / fire the
+        # flush the leaver's contributions were part of
+        ctx.execute_actions(ctx.mark_dead(source, reason="left"))
+        if ctx.accepting and ctx.take_stash_dirty():
+            drain_async_stash(node, ctx)

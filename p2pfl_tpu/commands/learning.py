@@ -32,6 +32,10 @@ class StartLearningCommand(Command):
     def execute(self, source: str, round: int, *args, **kwargs) -> None:  # noqa: A002
         rounds = int(args[0]) if args else 1
         epochs = int(args[1]) if len(args) > 1 else 1
+        # optional third arg: the initiator's experiment identity (old
+        # initiators send two args — everything downstream treats a None
+        # id as "filter by heuristics instead")
+        self._node._pending_xid = args[2] if len(args) > 2 else None
         self._node._start_learning_thread(rounds, epochs)
 
 

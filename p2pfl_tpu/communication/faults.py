@@ -97,6 +97,37 @@ class CrashSpec:
     after_s: float = 0.0
 
 
+@dataclass(frozen=True)
+class JoinSpec:
+    """A member JOINS the running experiment at ``at_s`` (seconds — the
+    virtual clock in :mod:`~p2pfl_tpu.federation.simfleet`, wall clock
+    after :func:`schedule_churn` on a live fleet).
+
+    The same conceptual seam as :class:`CrashSpec`: a churn plan keyed by
+    address, replayed bit-exact by the simulator and driven by timers on
+    the live fleet. The joiner bootstraps by pulling its aggregator's
+    current global (``async_pull``) before contributing — see
+    ``federation/workflow.py``.
+    """
+
+    at_s: float
+
+
+@dataclass(frozen=True)
+class LeaveSpec:
+    """A member LEAVES the running experiment at ``at_s``.
+
+    ``graceful=True`` is an announced departure (``async_leave``): an
+    aggregator forwards its partial buffer to the successor tier before
+    exiting, so no buffered contribution is lost. ``graceful=False`` is
+    an abrupt exit discovered like a crash — through heartbeat silence
+    (live fleet) or the simulator's ``evict_delay``.
+    """
+
+    at_s: float
+    graceful: bool = True
+
+
 class FaultCrash(Exception):
     """Raised on the learning thread of a node crashed by a CrashSpec —
     unwinds the stage workflow the way a killed process stops executing."""
@@ -121,6 +152,8 @@ class FaultPlan:
         partitions: Iterable[tuple[str, str]] = (),
         slow_nodes: Optional[dict[str, float]] = None,
         crashes: Optional[dict[str, CrashSpec]] = None,
+        joins: Optional[dict[str, "JoinSpec"]] = None,
+        leaves: Optional[dict[str, "LeaveSpec"]] = None,
     ) -> None:
         self.seed = seed
         self.default = default
@@ -128,6 +161,9 @@ class FaultPlan:
         self.partitions = set(partitions)
         self.slow_nodes = dict(slow_nodes or {})
         self.crashes = dict(crashes or {})
+        #: churn events (elastic membership): addr -> JoinSpec / LeaveSpec
+        self.joins = dict(joins or {})
+        self.leaves = dict(leaves or {})
         self._rngs: dict[tuple[str, str], random.Random] = {}
         self._rng_lock = threading.Lock()
         #: crash specs already fired (addr) — a spec fires exactly once
@@ -234,7 +270,8 @@ def _stale_copy(env: object) -> object:
     """
     if isinstance(env, Message):
         return Message(
-            env.source, env.cmd, env.args, env.round, ttl=1, trace_ctx=env.trace_ctx
+            env.source, env.cmd, env.args, env.round, ttl=1,
+            trace_ctx=env.trace_ctx, xp=env.xp,
         )
     return env
 
@@ -325,3 +362,30 @@ def remove_fault_plan(nodes: Iterable["Node"]) -> None:
     for node in nodes:
         node.protocol.fault_injector = None
         node.stage_hooks.clear()
+
+
+def schedule_churn(plan: FaultPlan, join_fn, leave_fn) -> list:
+    """Arm a plan's churn events on a LIVE fleet (wall-clock timers).
+
+    The live half of the seam :class:`JoinSpec`/:class:`LeaveSpec` share
+    with the simulator: ``join_fn(addr)`` is called at each join's
+    ``at_s`` (the caller constructs/connects the joining node — only it
+    knows models and datasets), ``leave_fn(addr, graceful)`` at each
+    leave's. Returns the started timers so a test can cancel them on
+    teardown. Crash specs stay on the stage-hook seam
+    (:func:`install_fault_plan`) — they are driven by the victim's own
+    learning thread, not the clock.
+    """
+    timers = []
+    for addr in sorted(plan.joins):
+        t = threading.Timer(plan.joins[addr].at_s, join_fn, args=(addr,))
+        t.daemon = True
+        t.start()
+        timers.append(t)
+    for addr in sorted(plan.leaves):
+        spec = plan.leaves[addr]
+        t = threading.Timer(spec.at_s, leave_fn, args=(addr, spec.graceful))
+        t.daemon = True
+        t.start()
+        timers.append(t)
+    return timers

@@ -70,6 +70,11 @@ def encode_message(msg: Message) -> bytes:
         # key — absent on old senders, ignored by old receivers, so both
         # wire directions stay compatible with pre-telemetry frames
         d["tc"] = list(msg.trace_ctx)
+    if msg.xp is not None:
+        # experiment identity (Node.set_start_learning) — optional like
+        # "tc": old frames decode unchanged, receivers use it to filter
+        # cross-experiment stragglers exactly
+        d["xp"] = msg.xp
     return json.dumps(d).encode()
 
 
@@ -82,7 +87,7 @@ def decode_message(data: bytes) -> Message:
     d = json.loads(data.decode())
     return Message(
         d["src"], d["cmd"], tuple(d["args"]), d["round"], d["ttl"], d["id"],
-        trace_ctx=_trace_ctx(d),
+        trace_ctx=_trace_ctx(d), xp=d.get("xp"),
     )
 
 
@@ -105,6 +110,11 @@ def encode_weights(env: WeightsEnvelope) -> bytes:
         # optional like "tc": absent on sync senders, ignored by old
         # receivers; the protobuf interop schema never carries it
         d["vv"] = list(env.update.version)
+    xp = env.xp or env.update.xp
+    if xp is not None:
+        # experiment identity — optional like "tc"/"vv"; rides BOTH the
+        # envelope and the decoded update so stash filters see it
+        d["xp"] = xp
     header = json.dumps(d).encode()
     return b"".join((len(header).to_bytes(4, "little"), header, env.update.encode()))
 
@@ -119,9 +129,11 @@ def decode_weights(data: bytes) -> WeightsEnvelope:
         num_samples=int(d["num_samples"]),
         encoded=data[4 + hlen :],
         version=(str(vv[0]), int(vv[1]), int(vv[2])) if vv else None,
+        xp=d.get("xp"),
     )
     return WeightsEnvelope(
-        d["src"], d["round"], d["cmd"], update, d["id"], trace_ctx=_trace_ctx(d)
+        d["src"], d["round"], d["cmd"], update, d["id"], trace_ctx=_trace_ctx(d),
+        xp=d.get("xp"),
     )
 
 

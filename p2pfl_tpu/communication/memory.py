@@ -130,10 +130,11 @@ class InMemoryProtocol(CommunicationProtocol):
                         num_samples=env.update.num_samples,
                         encoded=env.update.encode(),
                         version=env.update.version,
+                        xp=env.update.xp,
                     )
                     env = WeightsEnvelope(
                         env.source, env.round, env.cmd, wire, env.msg_id,
-                        trace_ctx=env.trace_ctx,
+                        trace_ctx=env.trace_ctx, xp=env.xp,
                     )
                 return peer.handle_weights(env).ok
             if isinstance(env, Message):

@@ -44,6 +44,13 @@ class Message:
     receiver's dispatch span joins the sender's causal tree. Optional end
     to end: ``None`` is never serialized, and a frame without the field
     decodes exactly as before.
+
+    ``xp`` is the experiment identity (the fleet-wide id minted by the
+    ``start_learning`` initiator, ``Node.set_start_learning``) — the same
+    optional-key contract as ``trace_ctx``: absent frames decode
+    unchanged, the protobuf interop schema never carries it. Receivers
+    use it to filter cross-experiment stragglers EXACTLY instead of by
+    TTL + epoch heuristics alone.
     """
 
     source: str
@@ -53,6 +60,7 @@ class Message:
     ttl: int = 1
     msg_id: str = ""
     trace_ctx: Optional[tuple[str, str]] = None
+    xp: Optional[str] = None
 
     def __post_init__(self) -> None:
         self.args = tuple(str(a) for a in self.args)
@@ -67,7 +75,9 @@ class WeightsEnvelope:
     ``update`` may hold a live pytree (in-process transports — zero copy,
     device-resident) or only ``update.encoded`` bytes (network transports).
     ``trace_ctx`` carries the sender's trace context exactly like
-    :class:`Message` (stamped by ``protocol.build_weights``).
+    :class:`Message` (stamped by ``protocol.build_weights``); ``xp`` the
+    experiment identity (same optional-key contract — it also rides
+    ``update.xp`` so stash filters see it after decode).
     """
 
     source: str
@@ -76,6 +86,7 @@ class WeightsEnvelope:
     update: ModelUpdate
     msg_id: str = field(default="")
     trace_ctx: Optional[tuple[str, str]] = None
+    xp: Optional[str] = None
 
     def __post_init__(self) -> None:
         if not self.msg_id:

@@ -45,6 +45,13 @@ class CommunicationProtocol(ABC):
         #: callbacks fired with the address of every heartbeat-evicted
         #: neighbor (Node hooks mid-round train-set repair here)
         self._evict_listeners: list[Callable[[str], None]] = []
+        #: current experiment identity (set by the workflows from
+        #: NodeState.experiment_xid): stamped as the optional "xp" header
+        #: on every outgoing envelope so receivers can filter
+        #: cross-experiment stragglers exactly. Deliberately NOT cleared
+        #: at experiment end — a tail frame between experiments carrying
+        #: the OLD id is precisely what the filter exists to reject.
+        self.experiment_xid: Optional[str] = None
         self.neighbors: Neighbors = self._make_neighbors()
         self.neighbors.on_evict = self._neighbor_evicted
         self.gossiper = Gossiper(
@@ -112,6 +119,7 @@ class CommunicationProtocol(ABC):
             round,
             ttl=Settings.TTL,
             trace_ctx=telemetry.current_ctx(),
+            xp=self.experiment_xid,
         )
 
     def build_weights(
@@ -121,8 +129,14 @@ class CommunicationProtocol(ABC):
         # byte transports then reuse the encode across candidates and ticks
         # for as long as the learner's model version is unchanged
         update.cache_round = round
+        # experiment identity rides both the envelope and the update (the
+        # update is what stash filters hold after decode); one update may
+        # be shared across a broadcast — identical stamp, benign
+        if update.xp is None and self.experiment_xid is not None:
+            update.xp = self.experiment_xid
         return WeightsEnvelope(
-            self._address, round, cmd, update, trace_ctx=telemetry.current_ctx()
+            self._address, round, cmd, update, trace_ctx=telemetry.current_ctx(),
+            xp=update.xp or self.experiment_xid,
         )
 
     # ---- sending ----
@@ -269,18 +283,20 @@ class CommunicationProtocol(ABC):
             # TTL flood stays one causal tree rooted at the first sender
             relay = Message(
                 msg.source, msg.cmd, msg.args, msg.round, msg.ttl - 1, msg.msg_id,
-                trace_ctx=msg.trace_ctx,
+                trace_ctx=msg.trace_ctx, xp=msg.xp,
             )
             pending = [n for n in self.neighbors.get_all(only_direct=True) if n != msg.source]
             self.gossiper.add_message(relay, pending)
         return self._dispatch(
-            msg.cmd, msg.source, msg.round, list(msg.args), None, trace_ctx=msg.trace_ctx
+            msg.cmd, msg.source, msg.round, list(msg.args), None,
+            trace_ctx=msg.trace_ctx, xp=msg.xp,
         )
 
     def handle_weights(self, env: WeightsEnvelope) -> CommandResult:
         """Data-plane receive: direct dispatch, no TTL/dedup (``grpc_server.py:168-197``)."""
         return self._dispatch(
-            env.cmd, env.source, env.round, [], env.update, trace_ctx=env.trace_ctx
+            env.cmd, env.source, env.round, [], env.update,
+            trace_ctx=env.trace_ctx, xp=env.xp or env.update.xp,
         )
 
     def _dispatch(
@@ -291,6 +307,7 @@ class CommunicationProtocol(ABC):
         args: list[str],
         update: Optional[ModelUpdate],
         trace_ctx: Optional[tuple[str, str]] = None,
+        xp: Optional[str] = None,
     ) -> CommandResult:
         from p2pfl_tpu.settings import Settings
 
@@ -317,10 +334,13 @@ class CommunicationProtocol(ABC):
             span_cm = contextlib.nullcontext()
         try:
             with span_cm:
+                # xp: the frame's experiment identity (optional — None on
+                # old/sync frames); commands that gate on experiment
+                # boundaries read it from kwargs
                 if update is not None:
-                    handler.execute(source, round, update=update)
+                    handler.execute(source, round, update=update, xp=xp)
                 else:
-                    handler.execute(source, round, *args)
+                    handler.execute(source, round, *args, xp=xp)
             return CommandResult(ok=True)
         except Exception as exc:  # noqa: BLE001 — commands must not kill the server thread
             logger.error(self._address, f"Error executing {cmd} from {source}: {exc!r}")

@@ -14,6 +14,13 @@ the speed of the **median** instead:
 - :mod:`~p2pfl_tpu.federation.topology` — :class:`HierarchicalTopology`
   (HierFAVG, Liu et al., ICC 2020): edge clusters → elected regional
   aggregators → a global tier;
+- :mod:`~p2pfl_tpu.federation.routing` — the node-free
+  :class:`TierRouter`: tier/role derivation, buffer placement, update
+  sinks and successor election as a pure function of
+  ``(membership, dead set, cluster size)`` — consumed by BOTH the
+  production workflow and the simulator, which is what makes elastic
+  membership (joins, graceful leaves, root failover) testable at 10k
+  simulated nodes before it touches a wire;
 - :mod:`~p2pfl_tpu.federation.workflow` — the async learning workflow
   real nodes run when ``Settings.FEDERATION_MODE == "async"`` (selected
   in ``Node._run_learning``; all sends ride the ``_do_send`` seam, so
@@ -24,6 +31,7 @@ the speed of the **median** instead:
 """
 
 from p2pfl_tpu.federation.buffer import BufferedAggregator
+from p2pfl_tpu.federation.routing import BufferPlan, TierRouter, VersionHighWater
 from p2pfl_tpu.federation.simfleet import FleetResult, SimulatedAsyncFleet
 from p2pfl_tpu.federation.staleness import UpdateVersion, VersionVector, staleness_weight
 from p2pfl_tpu.federation.topology import HierarchicalTopology
@@ -31,11 +39,14 @@ from p2pfl_tpu.federation.workflow import AsyncLearningWorkflow
 
 __all__ = [
     "AsyncLearningWorkflow",
+    "BufferPlan",
     "BufferedAggregator",
     "FleetResult",
     "HierarchicalTopology",
     "SimulatedAsyncFleet",
+    "TierRouter",
     "UpdateVersion",
+    "VersionHighWater",
     "VersionVector",
     "staleness_weight",
 ]

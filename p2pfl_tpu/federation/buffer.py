@@ -172,6 +172,28 @@ class BufferedAggregator:
                     attrs={"origin": ver.origin, "seq": ver.seq},
                 )
                 return None
+            if (
+                self.bump_on_flush
+                and ver.base_version > self._version
+                and ver.base_version - self._version <= self.max_staleness
+            ):
+                # version high-water handover (root failover): a successor
+                # root that missed the corpse's last minted globals still
+                # sees their versions inside the updates trained FROM them
+                # — jump the counter so the next flush mints strictly
+                # above anything any live node already adopted. A no-op in
+                # steady state (nodes can only train from versions this
+                # tier minted, so base <= version at the minting tier).
+                # The jump is BOUNDED by max_staleness: an unvalidated
+                # base_version from a cross-experiment straggler (pre-xp
+                # sender — the identity gate cannot filter it) must not
+                # inflate the counter so far that every legitimate update
+                # mass-drops as over-stale; beyond the bound the frame
+                # merges once at clamped τ=0 instead — the pre-elastic
+                # bounded damage. A real handover gap larger than the
+                # staleness bound is a partition whose updates would be
+                # dropped anyway.
+                self._version = ver.base_version
             tau = max(self._version - ver.base_version, 0)
             if tau > self.max_staleness:
                 logger.log_comm_metric(self.node_name, "async_stale_drop")
@@ -202,6 +224,23 @@ class BufferedAggregator:
             self.k = max(1, int(k))
             result = self._maybe_flush_locked()
         return self._finish_flush(result)
+
+    def take_pending(self) -> List[ModelUpdate]:
+        """Drain buffered-but-unflushed contributions without merging —
+        the buffer-migration hook for elastic membership.
+
+        An aggregator whose role changes (demoted by a join's re-chunk,
+        or leaving gracefully) must not discard a partial buffer: the
+        contributions are FORWARDED raw, in ``(origin, seq)`` order, to
+        the successor tier, whose own version vector re-dedups any copy
+        that also reached it directly. The local version vector keeps its
+        marks (this buffer may be re-promoted later and must still reject
+        replays of what it already accepted).
+        """
+        with self._lock:
+            entries = sorted(self._pending, key=lambda e: (e[0].origin, e[0].seq))
+            self._pending = []
+        return [u for _v, u, _w, _t in entries]
 
     def _maybe_flush_locked(self) -> Optional[FlushResult]:
         if len(self._pending) < self.k:

@@ -2,37 +2,44 @@
 
 Real threaded nodes cannot replay bit-identically — the OS scheduler
 decides which K updates share a buffer window. This driver replaces
-threads with a **virtual clock**: every train completion, update arrival
-and model push is an event on one heap, popped in ``(time, insertion
-seq)`` order, so the entire run — including which updates land in which
-merge, every staleness value, every fault verdict — is a pure function of
-``(seed, fault plan, fleet shape)``. That purity is what the replay test
-pins (same inputs ⇒ bit-identical final global), and what makes 1k-node
-hierarchical convergence drives affordable: no sockets, no sleeps, the
-only real compute is the buffers' jitted merges.
+threads with a **virtual clock**: every train completion, update arrival,
+model push and membership event is an event on one heap, popped in
+``(time, insertion seq)`` order, so the entire run — including which
+updates land in which merge, every staleness value, every fault verdict,
+every join/leave/failover — is a pure function of ``(seed, fault plan,
+fleet shape)``. That purity is what the replay tests pin (same inputs ⇒
+bit-identical final global), and what makes 1k-node hierarchical churn
+drives affordable: no sockets, no sleeps, the only real compute is the
+buffers' jitted merges.
 
 The simulated fleet shares the production plane's *state machines*: the
 same :class:`~p2pfl_tpu.federation.buffer.BufferedAggregator` instances,
-the same :class:`~p2pfl_tpu.federation.topology.HierarchicalTopology`
-derivation, the same version triples and staleness arithmetic. The
-tier-routing glue (which buffer an arrival feeds, upward stamping,
-downward forwarding) is MIRRORED from ``workflow.AsyncContext`` rather
-than shared — the threaded context is entangled with Node/transport;
-extracting a node-free routing core both drivers consume is an open
-refactor (ROADMAP 3) — so a routing change in one must be mirrored in
-the other. The transport (heap events instead of ``_do_send``) and the
+the same version triples and staleness arithmetic, and — since the
+node-free routing core landed — the SAME
+:class:`~p2pfl_tpu.federation.routing.TierRouter` the production
+``workflow.AsyncContext`` consumes: tier derivation, buffer placement,
+update sinks, push-down fan-outs, successor election on death and the
+version high-water handover are one implementation exercised by both
+drivers. Only the transport (heap events instead of ``_do_send``) and the
 learner (a seeded consensus task instead of a jitted epoch scan) are
-deliberate stand-ins. Faults reuse :class:`FaultPlan` semantics at
-the same conceptual seam: per-edge drop/duplicate verdicts from the
-plan's per-edge streams, ``slow_nodes`` as inbound-weights latency,
+deliberate stand-ins. Faults reuse :class:`FaultPlan` semantics at the
+same conceptual seam: per-edge drop/duplicate verdicts from the plan's
+per-edge streams, ``slow_nodes`` as inbound latency,
 ``CrashSpec(stage="AsyncTrainStage", round_no=k)`` as "dies starting its
-k-th local update".
+k-th local update" — and the elastic churn events ride the same plan:
+``JoinSpec(at_s)`` adds a member mid-run (it bootstraps from its
+aggregator's current global), ``LeaveSpec(at_s, graceful=True)`` removes
+one (a graceful aggregator forwards its partial buffer to the successor
+tier before exiting; an abrupt one is discovered like a crash, after
+``evict_delay``).
 
 The default workload is a consensus least-squares task: node ``i`` pulls
 its model toward a seeded private target ``tᵢ``; the fleet's fixed point
-is the weighted target mean, and ``loss(global) = ‖w − t̄‖²`` measures
-convergence — enough structure to show time-to-target beating a
-barrier-synchronized fleet under stragglers, with zero ML runtime cost.
+is the weighted target mean over the LIVE membership, and
+``loss(global) = ‖w − t̄‖²`` measures convergence — enough structure to
+show time-to-target beating a barrier-synchronized fleet under
+stragglers (and bounded disruption under churn), with zero ML runtime
+cost.
 """
 
 from __future__ import annotations
@@ -45,7 +52,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from p2pfl_tpu.federation.buffer import BufferedAggregator
-from p2pfl_tpu.federation.topology import HierarchicalTopology
+from p2pfl_tpu.federation.routing import TierRouter
 from p2pfl_tpu.learning.weights import ModelUpdate
 
 Pytree = Any
@@ -66,6 +73,9 @@ class FleetResult:
     duplicates_injected: int = 0
     crashed: List[str] = field(default_factory=list)
     merges: int = 0
+    joined: List[str] = field(default_factory=list)
+    left: List[str] = field(default_factory=list)
+    failovers: int = 0  #: how many times the global root changed hands
 
     def final_loss(self) -> float:
         return self.loss_curve[-1][2] if self.loss_curve else float("inf")
@@ -73,9 +83,9 @@ class FleetResult:
 
 class _SimNode:
     __slots__ = (
-        "addr", "idx", "model", "base_version", "known_version",
-        "pending_global", "seq", "updates_done", "crashed", "num_samples",
-        "duration",
+        "addr", "idx", "model", "base_version", "known_version", "high_water",
+        "global_params", "pending_global", "seq", "updates_done", "crashed",
+        "num_samples", "duration",
     )
 
     def __init__(self, addr: str, idx: int, model: Pytree, num_samples: int, duration: float) -> None:
@@ -84,6 +94,11 @@ class _SimNode:
         self.model = model
         self.base_version = 0
         self.known_version = 0
+        #: highest global version observed (adoptions + arriving triples)
+        #: — the seed for a promoted aggregator's version counter
+        self.high_water = 0
+        #: last adopted global params — what a promoted buffer seeds from
+        self.global_params: Optional[Pytree] = None
         self.pending_global: Optional[Tuple[Pytree, int]] = None
         self.seq = itertools.count(1)
         self.updates_done = 0
@@ -98,10 +113,13 @@ class SimulatedAsyncFleet:
     ``train_fn(idx, params, rng) -> params`` and ``loss_fn(params) ->
     float`` default to the consensus task. ``plan`` (a
     :class:`~p2pfl_tpu.communication.faults.FaultPlan`) injects
-    drop/duplicate/slow/crash exactly as the threaded chaos suite would;
+    drop/duplicate/slow/crash — and the churn events ``plan.joins`` /
+    ``plan.leaves`` — exactly as the threaded chaos suite would;
     ``slow_frac``/``slow_factor`` additionally stretch a deterministic
     subset of nodes' train durations (the straggler population the async
-    plane exists for).
+    plane exists for). ``evict_delay`` is the virtual stand-in for the
+    heartbeat eviction window: how long after a crash/abrupt leave the
+    survivors re-derive the topology around the corpse.
     """
 
     def __init__(
@@ -123,73 +141,62 @@ class SimulatedAsyncFleet:
         dim: int = 16,
         local_lr: float = 0.5,
         target_loss: float = 0.0,
+        evict_delay: float = 0.5,
         train_fn: Optional[Callable] = None,
         loss_fn: Optional[Callable] = None,
         init_params: Optional[Pytree] = None,
     ) -> None:
+        from p2pfl_tpu.settings import Settings
+
         self.seed = int(seed)
         self.n = int(n_nodes)
         self.updates_per_node = int(updates_per_node)
         self.link_delay = float(link_delay)
         self.plan = plan
         self.target_loss = float(target_loss)
+        self.evict_delay = float(evict_delay)
+        self.cluster_size = cluster_size
+        self._base_duration = float(base_duration)
+        self._slow_frac = float(slow_frac)
+        self._slow_factor = float(slow_factor)
+        self._base_k = max(1, int(Settings.FEDBUFF_K if k is None else k))
+        self._alpha = alpha
+        self._server_lr = server_lr
+        self._max_staleness = max_staleness
         addrs = [f"sim-{i:04d}" for i in range(self.n)]
-        self.topo = HierarchicalTopology(addrs, cluster_size)
+        self._members: set = set(addrs)
+        self._dead: set = set()
+        self.router = TierRouter(addrs, cluster_size)
 
         # seeded consensus task (see module docs): every node's target is
         # a SHARED offset plus private noise — the fleet's fixed point is
         # ≈ the offset, so a zero-initialized global has an O(dim) loss to
         # close and "converged" is a real statement even at n=1000 (pure
         # zero-mean targets would average to a fixed point at the origin)
-        base = np.random.default_rng([self.seed, 5]).normal(size=dim).astype(np.float32) * 2.0
-        self._targets = {
-            i: base
-            + np.random.default_rng([self.seed, 7, i]).normal(size=dim).astype(np.float32)
-            for i in range(self.n)
-        }
+        self._dim = int(dim)
+        self._target_base = (
+            np.random.default_rng([self.seed, 5]).normal(size=dim).astype(np.float32) * 2.0
+        )
+        self._targets: Dict[int, np.ndarray] = {}
         self._local_lr = float(local_lr)
         if init_params is None:
             init_params = {"w": np.zeros(dim, dtype=np.float32)}
+        self._init = init_params
         self.train_fn = train_fn or self._default_train
         self.loss_fn = loss_fn or self._default_loss
 
         # per-node deterministic shape: duration jitter, slow membership,
-        # sample weights — each from its own stream, FaultPlan-style
+        # sample weights — each from its own stream, FaultPlan-style.
+        # Joiners continue the idx sequence, so their streams are as
+        # deterministic as the founders'.
         self.nodes: Dict[str, _SimNode] = {}
-        for i, addr in enumerate(addrs):
-            rng = np.random.default_rng([self.seed, 11, i])
-            dur = base_duration * (0.8 + 0.4 * float(rng.random()))
-            if slow_frac > 0.0 and float(rng.random()) < slow_frac:
-                dur *= slow_factor
-            self.nodes[addr] = _SimNode(
-                addr, i, _copy_tree(init_params), 1 + i % 3, dur
-            )
+        self._next_idx = 0
+        for addr in addrs:
+            self._make_node(addr)
 
-        kk = k
+        self._up_seq: Dict[str, Any] = {}
         self._buffers: Dict[str, Dict[str, BufferedAggregator]] = {}
-        for regional in self.topo.regionals:
-            bufs: Dict[str, BufferedAggregator] = {}
-            if regional == self.topo.global_root and self.topo.is_flat():
-                bufs["global"] = BufferedAggregator(
-                    regional, _copy_tree(init_params),
-                    k=_clamp_k(kk, len(self.topo.members)), alpha=alpha,
-                    server_lr=server_lr, max_staleness=max_staleness,
-                )
-            else:
-                bufs["regional"] = BufferedAggregator(
-                    regional, _copy_tree(init_params),
-                    k=_clamp_k(kk, len(self.topo.cluster_of(regional))), alpha=alpha,
-                    server_lr=server_lr, max_staleness=max_staleness,
-                    bump_on_flush=False,
-                )
-                if regional == self.topo.global_root:
-                    bufs["global"] = BufferedAggregator(
-                        regional, _copy_tree(init_params),
-                        k=_clamp_k(kk, len(self.topo.regionals)), alpha=alpha,
-                        server_lr=server_lr, max_staleness=max_staleness,
-                    )
-            self._buffers[regional] = bufs
-        self._up_seq = {r: itertools.count(1) for r in self.topo.regionals}
+        self._reconcile(0.0)
 
         # event heap: (time, insertion seq, kind, payload) — the seq makes
         # pop order total and therefore the whole run deterministic
@@ -200,16 +207,50 @@ class SimulatedAsyncFleet:
             time_to_target=None, loss_curve=[],
         )
 
+    @property
+    def topo(self):
+        """Full-membership cluster chunking (routing.TierRouter view)."""
+        return self.router.topo
+
+    def _make_node(self, addr: str) -> _SimNode:
+        idx = self._next_idx
+        self._next_idx += 1
+        rng = np.random.default_rng([self.seed, 11, idx])
+        dur = self._base_duration * (0.8 + 0.4 * float(rng.random()))
+        if self._slow_frac > 0.0 and float(rng.random()) < self._slow_frac:
+            dur *= self._slow_factor
+        node = _SimNode(addr, idx, _copy_tree(self._init), 1 + idx % 3, dur)
+        self.nodes[addr] = node
+        return node
+
+    def _target(self, idx: int) -> np.ndarray:
+        t = self._targets.get(idx)
+        if t is None:
+            t = self._targets[idx] = self._target_base + np.random.default_rng(
+                [self.seed, 7, idx]
+            ).normal(size=self._dim).astype(np.float32)
+        return t
+
+    def _next_up(self, addr: str) -> int:
+        # persistent per-node upward counter: a re-promoted aggregator
+        # continuing at seq 1 would be rejected as a replay by its
+        # parent's version vector
+        c = self._up_seq.get(addr)
+        if c is None:
+            c = self._up_seq[addr] = itertools.count(1)
+        return next(c)
+
     # ---- default workload ----
 
     def _default_train(self, idx: int, params: Pytree, rng: np.random.Generator) -> Pytree:
-        t = self._targets[idx]
+        t = self._target(idx)
         w = params["w"]
         return {"w": (w + self._local_lr * (t - np.asarray(w, np.float32))).astype(np.float32)}
 
     def _default_loss(self, params: Pytree) -> float:
-        weights = np.asarray([self.nodes[a].num_samples for a in self.topo.members], np.float32)
-        targets = np.stack([self._targets[self.nodes[a].idx] for a in self.topo.members])
+        live = [a for a in self.router.live_members if a in self.nodes]
+        weights = np.asarray([self.nodes[a].num_samples for a in live], np.float32)
+        targets = np.stack([self._target(self.nodes[a].idx) for a in live])
         t_mean = (weights[:, None] * targets).sum(0) / weights.sum()
         diff = np.asarray(params["w"], np.float32) - t_mean
         return float(diff @ diff)
@@ -236,14 +277,158 @@ class SimulatedAsyncFleet:
             return None
         return self.plan.crashes.get(addr)
 
+    # ---- membership events (the elastic seam) ----
+
+    def _rederive(self, t: float) -> None:
+        old_root = self.router.root
+        self.router = TierRouter(self._members, self.cluster_size, dead=self._dead)
+        if self.router.root != old_root:
+            self.result.failovers += 1
+        self._reconcile(t)
+
+    def _agg_snapshot(self, addr: str) -> Tuple[Pytree, int]:
+        """An aggregator's current global view (bootstrap-pull stand-in)."""
+        bufs = self._buffers.get(addr, {})
+        for tier in ("global", "regional"):
+            if tier in bufs:
+                return bufs[tier].snapshot()
+        node = self.nodes.get(addr)
+        if node is not None and node.global_params is not None:
+            return node.global_params, node.known_version
+        return self._init, 0
+
+    def _reconcile(self, t: float) -> None:
+        """Migrate every live node's buffers to the new router's plan by
+        executing the SHARED reconcile contract
+        (:meth:`TierRouter.reconcile_ops`) — the same ops the production
+        ``AsyncContext._reconcile_locked`` executes, so promotion
+        seeding, demotion forwarding and K re-clamps cannot drift
+        between the drivers."""
+        for addr in sorted(self.nodes):
+            node = self.nodes[addr]
+            if node.crashed or addr in self._dead:
+                # a corpse's buffers die with it (graceful leavers already
+                # forwarded theirs before this point)
+                self._buffers.pop(addr, None)
+                continue
+            bufs = self._buffers.get(addr, {})
+            ops = self.router.reconcile_ops(
+                addr, self._base_k, "regional" in bufs, "global" in bufs
+            )
+            for op in ops:
+                if op.op == "forward":
+                    self._forward_pending(t, addr, bufs.pop(op.tier), op.target)
+                elif op.op == "create":
+                    params, version = (
+                        (node.global_params, node.known_version)
+                        if node.global_params is not None
+                        else (self._init, 0)
+                    )
+                    regional = op.tier == "regional"
+                    floor = version if regional else max(version, node.high_water)
+                    b = BufferedAggregator(
+                        addr, _copy_tree(params), k=op.k,
+                        alpha=self._alpha, server_lr=self._server_lr,
+                        max_staleness=self._max_staleness, bump_on_flush=not regional,
+                    )
+                    if floor > 0:
+                        b.set_global(_copy_tree(params), floor)
+                    bufs[op.tier] = b
+                else:  # resize
+                    res = bufs[op.tier].set_k(op.k)
+                    if res:
+                        if op.tier == "global":
+                            self._on_global_flush(t, res, addr)
+                        else:
+                            self._propagate_regional_flush(t, addr, res)
+            if bufs:
+                self._buffers[addr] = bufs
+            else:
+                self._buffers.pop(addr, None)
+
+    def _forward_pending(
+        self, t: float, src: str, buf: BufferedAggregator, dst: Optional[str]
+    ) -> None:
+        if dst is None or dst == src:
+            return
+        for upd in buf.take_pending():
+            self._deliver_update(t, src, dst, upd)
+
+    def _on_join(self, t: float, addr: str) -> None:
+        if addr in self.nodes:
+            return
+        node = self._make_node(addr)
+        self._members.add(addr)
+        self.result.joined.append(addr)
+        self._rederive(t)
+        # bootstrap: pull the aggregator's current global (async_pull) —
+        # the joiner's first update then trains from the fleet's state
+        target = self.router.push_target(addr)
+        if target is not None and target != addr:
+            params, version = self._agg_snapshot(target)
+            if version > 0:
+                self._push(
+                    t + self.link_delay, "model_arrive",
+                    (addr, _copy_tree(params), version, target),
+                )
+        self._push(t + self.link_delay + node.duration, "train_done", (addr,))
+
+    def _on_leave(self, t: float, addr: str, graceful: bool) -> None:
+        node = self.nodes.get(addr)
+        if node is None or node.crashed or addr in self._dead:
+            return
+        node.crashed = True  # stops training and arrivals
+        self.result.left.append(addr)
+        if not graceful:
+            # abrupt: discovered like a crash, one eviction window later
+            self._push(t + self.evict_delay, "evict", (addr,))
+            return
+        # graceful: capture the partial buffers (and the pre-leave
+        # fan-out) BEFORE the re-derivation drops them, announce
+        # (everyone re-derives instantly in sim), then forward the
+        # partials to the successor tiers
+        bufs = self._buffers.pop(addr, {})
+        pre_children = self.router.live_children(addr)
+        self._dead.add(addr)
+        self._rederive(t)
+        b = bufs.get("regional")
+        if b is not None:
+            self._forward_pending(t, addr, b, self.router.push_target(addr))
+        b = bufs.get("global")
+        if b is not None:
+            self._forward_pending(t, addr, b, self.router.root)
+        # hand the successor tiers the freshest global the leaver holds —
+        # the same handoff as production's graceful_leave_actions (the
+        # leaver may be the only node that adopted the last mint)
+        if node.global_params is not None and node.known_version > 0:
+            targets = (set(self.router.regionals) | set(pre_children)) - {addr}
+            for tgt in sorted(targets):
+                if tgt not in self._dead:
+                    self._deliver_model(
+                        t, addr, tgt, _copy_tree(node.global_params), node.known_version
+                    )
+
+    def _on_evict(self, t: float, addr: str) -> None:
+        if addr in self._dead:
+            return
+        self._dead.add(addr)
+        self._buffers.pop(addr, None)  # a corpse's pending dies with it
+        self._rederive(t)
+
     # ---- event loop ----
 
     def _push(self, t: float, kind: str, payload: tuple) -> None:
         heapq.heappush(self._heap, (t, next(self._evseq), kind, payload))
 
     def run(self) -> FleetResult:
-        for addr, node in self.nodes.items():
-            self._push(node.duration, "train_done", (addr,))
+        for addr in sorted(self.nodes):
+            self._push(self.nodes[addr].duration, "train_done", (addr,))
+        if self.plan is not None:
+            for addr in sorted(getattr(self.plan, "joins", {})):
+                self._push(self.plan.joins[addr].at_s, "join", (addr,))
+            for addr in sorted(getattr(self.plan, "leaves", {})):
+                spec = self.plan.leaves[addr]
+                self._push(spec.at_s, "leave", (addr, bool(spec.graceful)))
         while self._heap:
             t, _seq, kind, payload = heapq.heappop(self._heap)
             self.result.virtual_time = t
@@ -253,7 +438,14 @@ class SimulatedAsyncFleet:
                 self._on_update_arrive(t, *payload)
             elif kind == "model_arrive":
                 self._on_model_arrive(t, *payload)
-        gbuf = self._buffers[self.topo.global_root].get("global")
+            elif kind == "join":
+                self._on_join(t, *payload)
+            elif kind == "leave":
+                self._on_leave(t, *payload)
+            elif kind == "evict":
+                self._on_evict(t, *payload)
+        root = self.router.root
+        gbuf = self._buffers.get(root, {}).get("global") if root else None
         if gbuf is not None:
             self.result.params, self.result.version = gbuf.snapshot()
             self.result.merges = gbuf.merges
@@ -271,6 +463,10 @@ class SimulatedAsyncFleet:
         ):
             node.crashed = True
             self.result.crashed.append(addr)
+            # survivors discover the corpse one eviction window later and
+            # re-derive the topology around the hole (successor election,
+            # K repair) — the heartbeat plane's virtual stand-in
+            self._push(t + self.evict_delay, "evict", (addr,))
             return
         # adopt the freshest global that arrived while "training"
         if node.pending_global is not None:
@@ -284,8 +480,9 @@ class SimulatedAsyncFleet:
         upd = ModelUpdate(_copy_tree(node.model), [addr], node.num_samples)
         upd.version = (addr, next(node.seq), node.base_version)
         self.result.updates_sent += 1
-        target = self.topo.aggregator_for(addr)
-        self._deliver_update(t, addr, target, upd)
+        target = self.router.push_target(addr)
+        if target is not None:
+            self._deliver_update(t, addr, target, upd)
         if node.updates_done < self.updates_per_node:
             self._push(t + node.duration, "train_done", (addr,))
 
@@ -308,39 +505,46 @@ class SimulatedAsyncFleet:
             )
 
     def _on_update_arrive(self, t: float, dst: str, upd: ModelUpdate) -> None:
-        if self.nodes[dst].crashed:
+        node = self.nodes.get(dst)
+        if node is None or node.crashed:
             return
-        bufs = self._buffers.get(dst)
-        if bufs is None:
-            return  # mis-route: only aggregators hold buffers
-        self.result.updates_delivered += 1
+        if upd.version:
+            node.high_water = max(node.high_water, int(upd.version[2]))
         origin = str(upd.version[0]) if upd.version else ""
-        if "global" in bufs and (
-            self.topo.is_flat() or (origin in self.topo.regionals and origin != dst)
-        ):
+        sink = self.router.update_sink(dst, origin)
+        bufs = self._buffers.get(dst)
+        if sink is None or bufs is None or sink not in bufs:
+            return  # mis-route under the current view (sender ahead of an event)
+        self.result.updates_delivered += 1
+        if sink == "global":
             res = bufs["global"].offer(upd)
             if res:
-                self._on_global_flush(t, res)
+                self._on_global_flush(t, res, dst)
             return
         res = bufs["regional"].offer(upd)
         if res:
-            up = ModelUpdate(res.params, res.contributors, res.num_samples)
-            up.version = (dst, next(self._up_seq[dst]), res.version)
-            if dst == self.topo.global_root:
-                gres = bufs["global"].offer(up)
-                if gres:
-                    self._on_global_flush(t, gres)
-            else:
-                self._deliver_update(t, dst, self.topo.global_root, up)
+            self._propagate_regional_flush(t, dst, res)
 
-    def _on_global_flush(self, t: float, res) -> None:
+    def _propagate_regional_flush(self, t: float, addr: str, res) -> None:
+        up = ModelUpdate(res.params, res.contributors, res.num_samples)
+        up.version = (addr, self._next_up(addr), res.version)
+        bufs = self._buffers.get(addr, {})
+        if "global" in bufs:  # the root's own cluster feeding its global tier
+            gres = bufs["global"].offer(up)
+            if gres:
+                self._on_global_flush(t, gres, addr)
+            return
+        root = self.router.root
+        if root is not None and root != addr:
+            self._deliver_update(t, addr, root, up)
+
+    def _on_global_flush(self, t: float, res, root: str) -> None:
         loss = float(self.loss_fn(res.params))
         self.result.loss_curve.append((t, res.version, loss))
         if self.result.time_to_target is None and loss <= self.target_loss:
             self.result.time_to_target = t
-        root = self.topo.global_root
         self._adopt(t, root, res.params, res.version, forward=False)
-        for child in self.topo.children_of(root):
+        for child in self.router.live_children(root):
             self._deliver_model(t, root, child, res.params, res.version)
 
     def _deliver_model(self, t: float, src: str, dst: str, params: Pytree, version: int) -> None:
@@ -363,26 +567,23 @@ class SimulatedAsyncFleet:
         self, t: float, addr: str, params: Pytree, version: int,
         forward: bool, source: Optional[str] = None,
     ) -> None:
-        node = self.nodes[addr]
-        if node.crashed or version <= node.known_version:
+        node = self.nodes.get(addr)
+        if node is None or node.crashed:
+            return
+        node.high_water = max(node.high_water, version)
+        if version <= node.known_version:
             return
         node.known_version = version
+        node.global_params = params
         node.pending_global = (params, version)
         bufs = self._buffers.get(addr)
         if bufs is not None and "regional" in bufs:
             bufs["regional"].set_global(params, version)
         if forward:
-            for child in self.topo.children_of(addr):
+            for child in self.router.live_children(addr):
                 if child != source:
                     self._deliver_model(t, addr, child, params, version)
 
 
 def _copy_tree(tree: Pytree) -> Pytree:
     return {k: np.array(v, copy=True) for k, v in tree.items()}
-
-
-def _clamp_k(k: Optional[int], fan_in: int):
-    from p2pfl_tpu.settings import Settings
-
-    base = Settings.FEDBUFF_K if k is None else int(k)
-    return max(1, min(base, fan_in))

@@ -50,6 +50,25 @@ def as_version(value) -> Optional[UpdateVersion]:
     return UpdateVersion(str(origin), int(seq), int(base))
 
 
+def xp_mismatch(addr: str, frame_xp: Optional[str], local_xid: Optional[str]) -> bool:
+    """True when a frame's experiment identity contradicts ours — the ONE
+    filtering rule every async plane shares (weights handlers, the
+    done/join/leave control gates, the stash filters' exact branch).
+
+    Only a definite contradiction filters: frames from pre-"xp" senders
+    (``frame_xp is None``) and nodes without an identity yet (a joiner
+    before its bootstrap) fall through to each caller's fallback
+    heuristics. Counts ``async_xp_filtered`` so filtered cross-experiment
+    stragglers are visible in the comm metrics.
+    """
+    if frame_xp is None or local_xid is None or frame_xp == local_xid:
+        return False
+    from p2pfl_tpu.management.logger import logger
+
+    logger.log_comm_metric(addr, "async_xp_filtered")
+    return True
+
+
 def staleness_weight(tau: float, alpha: float) -> float:
     """FedBuff polynomial staleness weight ``w(τ) = 1/(1+τ)^α``.
 

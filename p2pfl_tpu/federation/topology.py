@@ -11,7 +11,12 @@ The topology is a **pure function of the sorted member list** (plus the
 cluster size), so every node derives the identical assignment with zero
 coordination — the same trick as the deterministic per-round trace ids:
 agreement on membership (which the heartbeat plane provides) IS agreement
-on topology.
+on topology. The elastic layer builds on exactly that property: the
+:class:`~p2pfl_tpu.federation.routing.TierRouter` chunks the FULL
+membership (live and dead) through this class and overlays dead members
+as *holes* — a death re-elects roles only within its own cluster plus the
+root chain instead of re-chunking everyone (the bounded-disruption
+contract), while a join re-derives the whole assignment.
 
 Roles nest rather than exclude: the global root is also the regional
 aggregator of its own cluster and trains like any edge — aggregation is a
@@ -69,6 +74,11 @@ class HierarchicalTopology:
 
     def is_flat(self) -> bool:
         return len(self.clusters) == 1
+
+    def cluster_index(self, addr: str) -> Optional[int]:
+        """The index of ``addr``'s cluster, or None for a non-member —
+        the routing layer's membership probe."""
+        return self._cluster_of.get(addr)
 
     def cluster_of(self, addr: str) -> List[str]:
         return list(self.clusters[self._cluster_of[addr]])

@@ -629,6 +629,15 @@ class ModelUpdate:
     #: the protobuf interop schema never carries it. Unused (None) by the
     #: sync round FSM.
     version: Optional[tuple] = None
+    #: experiment identity (the fleet-wide id minted by the start_learning
+    #: initiator): OPTIONAL wire field serialized as ``"xp"`` in the gRPC
+    #: envelope header, same backward-compat pattern as ``"vv"``/``"tc"``
+    #: (absent frames decode unchanged; the protobuf interop schema never
+    #: carries it). Receivers filter cross-experiment stash/drain
+    #: stragglers on it EXACTLY — ``Node.take_async_stash`` /
+    #: ``take_early_init`` fall back to the TTL + epoch heuristics only
+    #: for frames from old senders that lack it.
+    xp: Optional[str] = None
     #: encode-once plumbing (module docstring) — the learner's shared
     #: :class:`PayloadCache` plus its model-version counter at the time
     #: this update was handed out; ``cache_round`` is stamped by

@@ -15,8 +15,12 @@ from typing import Any, Optional, Type, Union
 from p2pfl_tpu.commands import (
     AddModelCommand,
     AsyncDoneCommand,
+    AsyncJoinCommand,
+    AsyncLeaveCommand,
     AsyncModelCommand,
+    AsyncPullCommand,
     AsyncUpdateCommand,
+    AsyncViewCommand,
     HeartbeatCommand,
     InitModelCommand,
     MetricsCommand,
@@ -120,6 +124,20 @@ class Node:
         # cleared on stop. Guarded by _early_async_lock.
         self._early_async_lock = threading.Lock()
         self._early_async: list = []
+        # elastic membership (federation/workflow.py): the experiment id
+        # this node will enter its next experiment under (parsed from
+        # start_learning / minted by set_start_learning), the join flag
+        # consumed by the async workflow (skip init sync, bootstrap-pull
+        # instead), and the graceful-leave request latch
+        self._pending_xid: Optional[str] = None
+        self._async_join = False
+        self._async_leave = threading.Event()
+        # the finished async experiment's canonical result
+        # (params, version, xid) — kept until the next experiment starts
+        # so async_pull can still be served AFTER the workflow exited (a
+        # straggler whose every inbound push targeted a corpse pulls at
+        # exit; the servers may already be gone from their contexts)
+        self._last_async_global: Optional[tuple] = None
         self._interrupt = threading.Event()
         self._learning_thread: Optional[threading.Thread] = None
         self._running = False
@@ -153,6 +171,10 @@ class Node:
             AsyncUpdateCommand(self),
             AsyncModelCommand(self),
             AsyncDoneCommand(self.state),
+            AsyncPullCommand(self),
+            AsyncJoinCommand(self),
+            AsyncViewCommand(self),
+            AsyncLeaveCommand(self),
         ):
             self.protocol.add_command(cmd)
 
@@ -207,13 +229,71 @@ class Node:
         if self.state.round is not None:
             logger.info(self.addr, "Learning already in progress")
             return
+        # mint the fleet-wide experiment identity: it rides the broadcast
+        # (optional third arg — old receivers ignore it) and is stamped on
+        # every wire frame as the "xp" header so receivers can filter
+        # cross-experiment stragglers exactly
+        import uuid
+
+        self._pending_xid = uuid.uuid4().hex[:16]
         self.protocol.broadcast(
-            self.protocol.build_msg("start_learning", [str(rounds), str(epochs)])
+            self.protocol.build_msg(
+                "start_learning", [str(rounds), str(epochs), self._pending_xid]
+            )
         )
         # this node is THE initializer: its current weights seed the network
         self.state.model_initialized_event.set()
         self.protocol.broadcast(self.protocol.build_msg("model_initialized"))
         self._start_learning_thread(rounds, epochs)
+
+    def join_async_experiment(self, rounds: int = 1, epochs: int = 1) -> None:
+        """Join a RUNNING async experiment mid-stream (elastic membership).
+
+        The joiner must already be connected to the overlay (heartbeats
+        advertise it to every member, whose contexts fold it into the
+        topology on their next membership refresh). Its workflow skips
+        the initial-model sync (that experiment's start_learning is long
+        gone) and instead bootstraps by pulling the nearest aggregator's
+        current global (``async_pull``) before contributing. Only
+        meaningful under ``Settings.FEDERATION_MODE == "async"`` — the
+        sync FSM's cohort is fixed by the round-0 vote.
+        """
+        if rounds < 1:
+            raise ZeroRoundsException("rounds must be >= 1")
+        if Settings.FEDERATION_MODE != "async":
+            logger.error(
+                self.addr,
+                "join_async_experiment requires FEDERATION_MODE='async' — ignored",
+            )
+            return
+        if self.state.round is not None:
+            logger.info(self.addr, "Learning already in progress")
+            return
+        # a joiner never saw this experiment's start_learning: clear any
+        # stale identity from a PREVIOUS experiment (it adopts the running
+        # experiment's id from its bootstrap global instead — stamping the
+        # old one would get its frames xp-filtered by the whole fleet)
+        self._pending_xid = None
+        self._async_join = True
+        self._start_learning_thread(rounds, epochs)
+
+    def request_async_leave(self) -> None:
+        """Ask the running async workflow to leave GRACEFULLY: it stops
+        training after the current local update, forwards any partial
+        aggregation buffers to the successor tiers (nothing buffered is
+        lost), broadcasts ``async_leave`` + ``async_done`` so survivors
+        re-derive the topology around the hole without waiting for
+        eviction, and finishes its experiment locally. A no-op outside an
+        async experiment."""
+        self._async_leave.set()
+
+    def async_leave_requested(self) -> bool:
+        return self._async_leave.is_set()
+
+    def consume_async_join(self) -> bool:
+        """Pop the join flag (the workflow reads it exactly once)."""
+        joining, self._async_join = self._async_join, False
+        return joining
 
     def set_stop_learning(self) -> None:
         if self.state.round is None:
@@ -243,6 +323,7 @@ class Node:
             self.total_rounds = rounds
             self.epochs = epochs
             self._interrupt.clear()
+            self._async_leave.clear()
             self._learning_thread = threading.Thread(
                 target=self._run_learning, name=f"learning-{self.addr}", daemon=True
             )
@@ -283,17 +364,29 @@ class Node:
         t.start()
 
     def take_early_init(self) -> Optional[ModelUpdate]:
-        """Pop the pre-start init_model stash if still fresh.
+        """Pop the pre-start init_model stash if it belongs to THIS
+        experiment.
 
-        A stash older than ``Settings.EARLY_INIT_TTL`` is a leftover from
-        a previous (aborted) experiment — seeding THIS experiment with it
-        would discard the real init when it arrives — so it is dropped.
+        When both the stash and this node carry an experiment identity
+        (the wire's optional "xp" header), the comparison is EXACT: a
+        matching init is consumed regardless of age, a mismatched one —
+        a leftover from a previous (aborted) experiment that would
+        shadow the real init — is dropped. Frames from pre-xp senders
+        fall back to the ``Settings.EARLY_INIT_TTL`` freshness heuristic.
         """
         with self._early_init_lock:
             slot, self._early_init = self._early_init, None
         if slot is None:
             return None
         stashed_at, update = slot
+        xid = self.state.experiment_xid
+        if update.xp is not None and xid is not None:
+            if update.xp != xid:
+                logger.debug(
+                    self.addr, "Discarding early init_model stash from another experiment"
+                )
+                return None
+            return update
         if time.monotonic() - stashed_at > Settings.EARLY_INIT_TTL:
             logger.debug(self.addr, "Discarding stale early init_model stash")
             return None
@@ -312,27 +405,31 @@ class Node:
                 self._early_async.pop(0)
 
     def take_async_stash(self) -> list:
-        """Pop the stash, keeping only THIS experiment's fresh entries.
+        """Pop the stash, keeping only THIS experiment's entries.
 
-        Two filters against a previous experiment's retried/duplicated
-        tail update draining into the next experiment's fresh buffers
-        (whose version vector has never seen its ``(origin, seq)`` and
-        would merge a stale experiment's params at τ=0 full weight):
-        the ``experiment_epoch`` stamped at stash time (catches anything
-        stashed before this experiment's ``set_experiment``) and the
-        ``EARLY_INIT_TTL`` freshness window. A straggler delivered AFTER
-        this experiment's start passes both — the wire carries no
-        experiment identity, the same documented residual as the
-        early-init stash; the TTL keeps that window short.
+        When an entry and this node both carry an experiment identity
+        (the wire's optional "xp" header, stamped by the start_learning
+        initiator), the filter is EXACT: a matching entry is kept, a
+        mismatched one — a previous experiment's retried/duplicated tail
+        update that would drain into fresh buffers at τ=0 full weight —
+        is dropped. Entries from pre-xp senders fall back to the two
+        heuristics that closed the residual window before the wire
+        carried identity: the ``experiment_epoch`` stamped at stash time
+        and the ``EARLY_INIT_TTL`` freshness window.
         """
         with self._early_async_lock:
             entries, self._early_async = self._early_async, []
         now = time.monotonic()
         epoch = self.state.experiment_epoch
-        fresh = [
-            u for e, t, u in entries
-            if e == epoch and now - t <= Settings.EARLY_INIT_TTL
-        ]
+        xid = self.state.experiment_xid
+        fresh = []
+        for e, t, u in entries:
+            if u.xp is not None and xid is not None:
+                if u.xp == xid:
+                    fresh.append(u)
+                continue
+            if e == epoch and now - t <= Settings.EARLY_INIT_TTL:
+                fresh.append(u)
         if len(fresh) < len(entries):
             logger.debug(self.addr, "Discarded stale early async_update stash entries")
         return fresh
@@ -358,20 +455,28 @@ class Node:
         st.votes_ready_event.set()
         ctx = self.async_ctx
         if ctx is not None:
-            # async control plane: eviction repair means shrinking the dead
-            # member's aggregation tiers to the live fan-in
-            # (federation/workflow.py AsyncContext.on_peer_evicted). The
-            # listener runs on the HEARTBEATER thread, and the repair may
-            # flush a buffer — a jitted merge plus full-model pushes whose
-            # dispatch can block up to GOSSIP_SEND_TIMEOUT (≈ a whole
-            # HEARTBEAT_TIMEOUT): doing that inline would silence our own
-            # beats exactly during a failure window and get THIS live node
-            # evicted, cascading the fault — so the repair runs on its own
-            # daemon thread (sends outside every context/buffer lock, per
-            # the deadlock contract).
+            # async control plane: an eviction is a MEMBERSHIP event —
+            # the context re-derives the topology with the corpse as a
+            # hole (federation/workflow.py AsyncContext.mark_dead):
+            # successor regionals/roots self-elect, K clamps shrink to
+            # the live fan-in (possibly firing the flush the corpse was
+            # blocking), and this node's buffers migrate to its new
+            # role. The listener runs on the HEARTBEATER thread, and the
+            # repair may flush a buffer — a jitted merge plus full-model
+            # pushes whose dispatch can block up to GOSSIP_SEND_TIMEOUT
+            # (≈ a whole HEARTBEAT_TIMEOUT): doing that inline would
+            # silence our own beats exactly during a failure window and
+            # get THIS live node evicted, cascading the fault — so the
+            # repair runs on its own daemon thread (sends outside every
+            # context/buffer lock, per the deadlock contract).
             def _repair(ctx=ctx, addr=addr) -> None:
                 try:
-                    ctx.execute_actions(ctx.on_peer_evicted(addr))
+                    ctx.execute_actions(ctx.mark_dead(addr))
+                    if ctx.accepting and ctx.take_stash_dirty():
+                        # a role change may make stashed updates routable
+                        from p2pfl_tpu.commands.federation import drain_async_stash
+
+                        drain_async_stash(self, ctx)
                 except Exception as exc:  # noqa: BLE001 — repair is best-effort
                     logger.error(self.addr, f"Async eviction repair failed for {addr}: {exc!r}")
 

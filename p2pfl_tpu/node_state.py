@@ -18,6 +18,11 @@ class NodeState:
         self.simulation = simulation
         self.status = "Idle"
         self.experiment_name: Optional[str] = None
+        #: fleet-wide experiment identity (minted by the start_learning
+        #: initiator, carried in its broadcast and stamped as the wire's
+        #: optional "xp" header). None on a joiner until it adopts the id
+        #: from its bootstrap global, and on fleets with pre-xp initiators.
+        self.experiment_xid: Optional[str] = None
         self.round: Optional[int] = None
         self.total_rounds: Optional[int] = None
         self.simulation = simulation
@@ -109,10 +114,11 @@ class NodeState:
         self.votes_ready_event = threading.Event()
         self.model_initialized_event = threading.Event()
 
-    def set_experiment(self, exp_name: str, total_rounds: int) -> None:
+    def set_experiment(self, exp_name: str, total_rounds: int, xid: Optional[str] = None) -> None:
         """Enter learning mode (reference ``node_state.py:83``)."""
         self.status = "Learning"
         self.experiment_name = exp_name
+        self.experiment_xid = xid
         self.total_rounds = total_rounds
         self.round = 0
         self.experiment_epoch += 1
@@ -138,6 +144,7 @@ class NodeState:
         """Back to idle (``node_state.py:110``)."""
         self.status = "Idle"
         self.experiment_name = None
+        self.experiment_xid = None
         self.round = None
         self.total_rounds = None
         self.models_aggregated = {}

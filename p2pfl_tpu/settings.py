@@ -89,8 +89,10 @@ class Settings:
     # many seconds — instead of being dropped and relying on a redelivery
     # the initiator's push loop may never make (it exits once its status
     # view stops changing). The window is the ONLY discriminator between
-    # that race and a LATE init from a previous aborted experiment (the
-    # wire carries no experiment identity), so keep it just wide enough
+    # that race and a LATE init from a previous aborted experiment for
+    # frames from OLD senders that lack the optional "xp" experiment-
+    # identity header (frames that carry it are filtered exactly, with no
+    # heuristics — Node.take_early_init), so keep it just wide enough
     # for the race: total message-plane retry backoff (~ MESSAGE_RETRY_MAX
     # backoffs capped at MESSAGE_RETRY_CAP) plus flood relay lag — and
     # well under any realistic gap between experiments, or a stale stash
@@ -233,6 +235,13 @@ class Settings:
     # update budget, waiting for slower members' async_done announcements
     # (eviction of a dead member also releases it) before it exits.
     ASYNC_DRAIN_TIMEOUT: float = 30.0
+    # How long a node JOINING a running async experiment
+    # (Node.join_async_experiment) waits for its bootstrap pull — the
+    # nearest aggregator's current global, requested via async_pull —
+    # before contributing from its own local init instead. The pull is a
+    # single direct round-trip, so this only needs to cover connection
+    # setup plus one full-model push.
+    ASYNC_JOIN_TIMEOUT: float = 15.0
     # Secure aggregation (pairwise masking, learning/secagg.py): when True,
     # train-set nodes Diffie-Hellman a seed per peer at experiment start and
     # mask their model contribution; masks cancel in the FedAvg sum, so no
@@ -428,6 +437,7 @@ def set_test_settings() -> None:
     Settings.ASYNC_MAX_STALENESS = 16
     Settings.HIER_CLUSTER_SIZE = 0
     Settings.ASYNC_DRAIN_TIMEOUT = 15.0
+    Settings.ASYNC_JOIN_TIMEOUT = 5.0
     Settings.TRAIN_SET_SIZE = 4
     Settings.VOTE_TIMEOUT = 10.0
     Settings.AGGREGATION_TIMEOUT = 10.0

@@ -144,7 +144,13 @@ class StartLearningStage(Stage):
     @staticmethod
     def execute(node: "Node") -> Optional[Type[Stage]]:
         state = node.state
-        state.set_experiment(node.experiment_name, node.total_rounds)
+        state.set_experiment(
+            node.experiment_name, node.total_rounds, xid=node._pending_xid
+        )
+        # stamp the experiment identity on every outgoing frame from here
+        # on (the optional "xp" wire header — receivers filter stale
+        # cross-experiment traffic on it exactly)
+        node.protocol.experiment_xid = state.experiment_xid
         logger.experiment_started(node.addr)
         # fresh experiment: cross-round strategy state (FedOpt moments,
         # CenteredClip center) from any previous experiment must not leak in

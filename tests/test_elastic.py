@@ -1,0 +1,639 @@
+"""Elastic fleet (ISSUE 11): one routing core, churn, root failover.
+
+Five layers, mirroring the change's structure:
+
+- the node-free :class:`TierRouter` (the shared core BOTH drivers — the
+  production ``AsyncContext`` and ``SimulatedAsyncFleet`` — consume):
+  decision matrix, permutation invariance, the bounded-disruption
+  contract of a removal, successor election;
+- buffer migration primitives: ``take_pending`` forwarding and the
+  version high-water jump that keeps minting monotone across a root
+  handover;
+- the experiment-identity "xp" wire header: codec round-trip, old-frame
+  compat, and the exact stash filters it replaces heuristics with;
+- the simulator under a full churn plan (joins + graceful/abrupt leaves
+  + a global-root kill): bit-exact replay, 1k-node re-convergence with
+  bounded disruption, and the kill-the-root-mid-flush version-monotonicity
+  regression;
+- real nodes over the in-memory transport: root kill with self-elected
+  successor, a mid-experiment join bootstrapping from the fleet's
+  global, and a graceful leave that loses nothing.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from p2pfl_tpu.communication.faults import (
+    CrashSpec,
+    EdgeFault,
+    FaultPlan,
+    JoinSpec,
+    LeaveSpec,
+    install_fault_plan,
+    remove_fault_plan,
+)
+from p2pfl_tpu.communication.grpc_transport import (
+    decode_message,
+    decode_weights,
+    encode_message,
+    encode_weights,
+)
+from p2pfl_tpu.communication.memory import MemoryRegistry
+from p2pfl_tpu.communication.message import Message, WeightsEnvelope
+from p2pfl_tpu.federation import (
+    BufferedAggregator,
+    SimulatedAsyncFleet,
+    TierRouter,
+    VersionHighWater,
+)
+from p2pfl_tpu.learning.learner import DummyLearner
+from p2pfl_tpu.learning.weights import ModelUpdate
+from p2pfl_tpu.management.logger import logger
+from p2pfl_tpu.node import Node
+from p2pfl_tpu.settings import Settings
+from p2pfl_tpu.utils import full_connection, wait_convergence, wait_to_finish
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    MemoryRegistry.reset()
+    logger.reset_comm_metrics()
+    yield
+    Settings.FEDERATION_MODE = "sync"
+    Settings.HIER_CLUSTER_SIZE = 0
+    MemoryRegistry.reset()
+
+
+# ---------------------------------------------------------------------------
+# TierRouter: the shared routing core (exercised once for both drivers)
+# ---------------------------------------------------------------------------
+
+
+def test_router_decision_matrix():
+    """The full decision surface on a 7-member, cluster-3 fleet:
+    clusters [a,b,c] + [d,e,f,g] (trailing singleton folded), root=a."""
+    members = list("abcdefg")
+    r = TierRouter(members, 3)
+    assert r.topo.clusters == [["a", "b", "c"], ["d", "e", "f", "g"]]
+    assert r.root == "a" and r.regionals == ["a", "d"]
+    assert r.roles() == {
+        "a": "global", "b": "edge", "c": "edge",
+        "d": "regional", "e": "edge", "f": "edge", "g": "edge",
+    }
+    # push targets: own cluster's regional (self-offers for aggregators)
+    assert r.push_target("b") == "a" and r.push_target("e") == "d"
+    assert r.push_target("a") == "a" and r.push_target("d") == "d"
+    # update sinks: peer-regional aggregates feed the root's global
+    # buffer, own-cluster (and orphaned) updates its regional buffer
+    assert r.update_sink("a", "d") == "global"
+    assert r.update_sink("a", "b") == "regional"
+    assert r.update_sink("a", "a") == "regional"
+    assert r.update_sink("a", "f") == "regional"  # orphan absorption
+    assert r.update_sink("d", "e") == "regional"
+    assert r.update_sink("b", "a") is None  # edges hold no buffer
+    # push-down fan-outs
+    assert r.live_children("a") == ["d", "b", "c"]
+    assert r.live_children("d") == ["e", "f", "g"]
+    assert r.live_children("b") == []
+    # buffer plans (K clamped to live fan-in)
+    assert r.buffer_plan("a", 4) == (3, 2)
+    assert r.buffer_plan("d", 4) == (4, None)
+    assert r.buffer_plan("b", 4) == (None, None)
+    # flat collapse: one global buffer at the root, K clamped to the fleet
+    flat = TierRouter(members, 0)
+    assert flat.buffer_plan("a", 4) == (None, 4)
+    assert flat.update_sink("a", "g") == "global"
+    assert flat.live_children("a") == ["b", "c", "d", "e", "f", "g"]
+
+
+def test_router_permutation_invariance():
+    """Any permutation of the same live membership yields identical
+    tiers/roles — what lets every node derive the topology alone."""
+    import random as _random
+
+    members = [f"n{i:03d}" for i in range(23)]
+    base = TierRouter(members, 5, dead={"n004", "n010"})
+    for seed in range(5):
+        shuffled = list(members)
+        _random.Random(seed).shuffle(shuffled)
+        r = TierRouter(shuffled, 5, dead={"n010", "n004"})
+        assert r.roles() == base.roles()
+        assert r.topo.clusters == base.topo.clusters
+        assert r.root == base.root and r.regionals == base.regionals
+
+
+def test_router_removal_bounded_disruption():
+    """The bounded-disruption contract: removing ONE member changes role
+    assignments only within the affected cluster (successor election)
+    plus the root chain — every other cluster's roles are untouched."""
+    members = [f"n{i:03d}" for i in range(40)]
+    base = TierRouter(members, 8)
+    base_roles = base.roles()
+    for victim in members:
+        r = TierRouter(members, 8, dead={victim})
+        new_roles = r.roles()
+        vi = base.topo.cluster_index(victim)
+        assert new_roles[victim] == "dead"
+        for m in members:
+            if m == victim or base.topo.cluster_index(m) == vi:
+                continue  # the affected cluster may re-elect
+            assert new_roles[m] == base_roles[m], (victim, m)
+        # clusters themselves never re-chunk on a death (holes, not
+        # re-derivation from the shrunk list)
+        assert r.topo.clusters == base.topo.clusters
+
+
+def test_router_successor_election():
+    """A dead regional's cluster re-elects its next live member; a dead
+    root hands the fleet to the next-sorted live regional; K clamps
+    follow the live fan-in (the eviction-repair contract)."""
+    members = list("abcdefgh")  # clusters [a,b,c,d], [e,f,g,h] at size 4
+    base = TierRouter(members, 4)
+    assert base.root == "a" and base.regionals == ["a", "e"]
+    # regional e dies: f self-elects, root unchanged
+    r = TierRouter(members, 4, dead={"e"})
+    assert r.role("f") == "regional" and r.root == "a"
+    assert r.push_target("g") == "f"
+    assert r.buffer_plan("f", 4) == (3, None)
+    # the ROOT dies: its cluster re-elects b, which is also the
+    # next-sorted live regional — so b is the successor root
+    r = TierRouter(members, 4, dead={"a"})
+    assert r.role("b") == "global" and r.root == "b"
+    assert r.regionals == ["b", "e"]
+    assert r.push_target("c") == "b"
+    # the whole first cluster dies: the fleet re-roots on e's cluster
+    r = TierRouter(members, 4, dead={"a", "b", "c", "d"})
+    assert r.root == "e" and r.regionals == ["e"]
+    # a fully dead cluster's push target falls back to the root
+    assert r.push_target("b") == "e"
+
+
+# ---------------------------------------------------------------------------
+# buffer migration primitives
+# ---------------------------------------------------------------------------
+
+
+def _update(value, contributors, num_samples=1, version=None, dim=4):
+    upd = ModelUpdate({"w": np.full(dim, value, np.float32)}, list(contributors), num_samples)
+    upd.version = version
+    return upd
+
+
+def test_version_high_water():
+    hw = VersionHighWater()
+    hw.observe(3)
+    hw.observe(None)
+    hw.observe(1)
+    assert hw.mark == 3
+    hw.observe(7)
+    assert hw.mark == 7
+
+
+def test_buffer_high_water_jump_keeps_minting_monotone():
+    """A successor root seeded below the fleet's real version must mint
+    ABOVE any base_version it observes — the mid-flush-kill contract."""
+    buf = BufferedAggregator("succ", {"w": np.zeros(4, np.float32)}, k=2, alpha=0.0)
+    assert buf.version == 0
+    # an update trained from v5 (minted by the dead root) arrives
+    buf.offer(_update(1.0, ["a"], version=("a", 1, 5)))
+    assert buf.version == 5, "counter did not jump to the observed base"
+    res = buf.offer(_update(2.0, ["b"], version=("b", 1, 5)))
+    assert res is not None and res.version == 6, "mint regressed below the high water"
+    # regional tiers never jump: their counter tracks the global push
+    rbuf = BufferedAggregator(
+        "reg", {"w": np.zeros(4, np.float32)}, k=2, alpha=0.0, bump_on_flush=False
+    )
+    rbuf.offer(_update(1.0, ["a"], version=("a", 1, 5)))
+    assert rbuf.version == 0
+
+
+def test_buffer_take_pending_preserves_dedup():
+    """Demotion migration: take_pending drains the partial buffer in
+    (origin, seq) order without merging, and the vector still rejects a
+    replay of what was accepted (re-promotion safety)."""
+    buf = BufferedAggregator("me", {"w": np.zeros(4, np.float32)}, k=3, alpha=0.0)
+    buf.offer(_update(2.0, ["b"], version=("b", 1, 0)))
+    buf.offer(_update(1.0, ["a"], version=("a", 1, 0)))
+    pending = buf.take_pending()
+    assert [u.version[0] for u in pending] == ["a", "b"]
+    assert buf.pending() == 0
+    assert buf.offer(_update(1.0, ["a"], version=("a", 1, 0))) is None
+    assert logger.get_comm_metrics("me").get("async_dup_drop", 0) == 1
+
+
+# ---------------------------------------------------------------------------
+# the "xp" experiment-identity wire header
+# ---------------------------------------------------------------------------
+
+
+def test_wire_xp_roundtrip_and_old_frame_compat():
+    msg = Message("a", "async_done", (), 0, xp="xid-1")
+    out = decode_message(encode_message(msg))
+    assert out.xp == "xid-1"
+    # absent on old senders: the key never appears, decode yields None
+    raw = encode_message(Message("a", "beat", ("1",), 0))
+    assert b'"xp"' not in raw
+    assert decode_message(raw).xp is None
+
+    upd = ModelUpdate({"w": np.ones(3, np.float32)}, ["a"], 2)
+    upd.xp = "xid-2"
+    env = WeightsEnvelope("a", 0, "async_update", upd)
+    out = decode_weights(encode_weights(env))
+    assert out.xp == "xid-2" and out.update.xp == "xid-2"
+    clean = WeightsEnvelope("a", 0, "add_model", ModelUpdate({"w": np.ones(3, np.float32)}, ["a"], 2))
+    raw = encode_weights(clean)
+    assert b'"xp"' not in raw
+    assert decode_weights(raw).update.xp is None
+
+
+def test_async_stash_filters_on_experiment_identity():
+    """The xp filter replaces the TTL+epoch heuristics when the frame
+    carries identity: a mismatched entry is dropped outright, a matched
+    one survives even an epoch bump; identity-less entries keep the old
+    heuristic behavior."""
+    node = Node(None, None)
+    try:
+        node.state.experiment_xid = "this-exp"
+        stale = _update(1.0, ["p"])
+        stale.xp = "previous-exp"
+        fresh = _update(2.0, ["q"])
+        fresh.xp = "this-exp"
+        legacy = _update(3.0, ["r"])  # xp None: pre-xp sender
+        node.stash_async_update(stale)
+        node.stash_async_update(fresh)
+        node.stash_async_update(legacy)
+        # an epoch bump invalidates the heuristic path but NOT the exact one
+        node.state.experiment_epoch += 1
+        kept = node.take_async_stash()
+        assert [u.xp for u in kept] == ["this-exp"]
+        # early-init filter: a mismatched init is dropped, a matched one
+        # survives past the TTL
+        init = _update(4.0, ["s"])
+        init.xp = "previous-exp"
+        node.stash_early_init(init)
+        assert node.take_early_init() is None
+        init2 = _update(5.0, ["s"])
+        init2.xp = "this-exp"
+        node.stash_early_init(init2)
+        node._early_init = (node._early_init[0] - 10 * Settings.EARLY_INIT_TTL, init2)
+        assert node.take_early_init() is init2
+    finally:
+        node.stop()
+
+
+# ---------------------------------------------------------------------------
+# simulator: churn plans, replay, re-convergence, version monotonicity
+# ---------------------------------------------------------------------------
+
+
+def _churn_plan(n, seed=1905, kill_root=True):
+    """~5% graceful+abrupt leaves, ~5% joins, one global-root kill.
+
+    The root kill is a time-targeted ABRUPT leave (a killed process: no
+    announcement, discovered one evict_delay later) at t=0.7 — inside
+    the first convergence waterfall, while the root is the only node
+    minting globals — so re-convergence genuinely crosses the failover
+    window instead of the kill landing after the target.
+    """
+    addrs = [f"sim-{i:04d}" for i in range(n)]
+    n_churn = max(2, n // 20)
+    leaves = {
+        a: LeaveSpec(at_s=0.4 + 0.03 * j, graceful=(j % 2 == 0))
+        for j, a in enumerate(addrs[3 :: max(1, n // n_churn)][:n_churn])
+    }
+    joins = {
+        f"sim-j{j:03d}": JoinSpec(at_s=0.6 + 0.05 * j) for j in range(n_churn)
+    }
+    if kill_root:
+        leaves[addrs[0]] = LeaveSpec(at_s=0.7, graceful=False)
+    return FaultPlan(
+        seed=seed,
+        default=EdgeFault(drop=0.01),
+        joins=joins,
+        leaves=leaves,
+    )
+
+
+def test_simfleet_churn_replay_bit_identical():
+    """The full churn plan — joins, graceful AND abrupt leaves, a root
+    kill — replays bit-exact from (seed, plan); a different seed
+    diverges."""
+
+    def run(seed):
+        return SimulatedAsyncFleet(
+            64,
+            seed=seed,
+            cluster_size=8,
+            updates_per_node=6,
+            slow_frac=0.1,
+            slow_factor=8.0,
+            plan=_churn_plan(64),
+        ).run()
+
+    a, b = run(42), run(42)
+    assert a.version == b.version and a.version > 0
+    np.testing.assert_array_equal(np.asarray(a.params["w"]), np.asarray(b.params["w"]))
+    assert a.loss_curve == b.loss_curve
+    assert a.joined == b.joined and a.left == b.left and a.crashed == b.crashed
+    assert a.failovers == b.failovers and a.failovers >= 1
+    assert a.joined and a.left  # the plan actually churned
+    c = run(43)
+    assert not np.array_equal(np.asarray(a.params["w"]), np.asarray(c.params["w"]))
+
+
+def test_simfleet_1k_churn_reconverges_with_bounded_disruption():
+    """ISSUE 11 acceptance: a 1k-node hierarchical fleet under the full
+    churn plan (5% leave + 5% join + global-root kill) still reaches the
+    loss target, joiners' contributions merge, and the minted version
+    sequence is strictly monotone THROUGH the failover."""
+    n = 1000
+    static = SimulatedAsyncFleet(
+        n, seed=7, cluster_size=32, updates_per_node=4, local_lr=0.7,
+    )
+    start_loss = static.loss_fn({"w": np.zeros(16, np.float32)})
+    target = float(start_loss) * 0.05
+    static.target_loss = target
+    res_static = static.run()
+
+    churn = SimulatedAsyncFleet(
+        n, seed=7, cluster_size=32, updates_per_node=4, local_lr=0.7,
+        plan=_churn_plan(n), target_loss=target,
+    )
+    res = churn.run()
+    assert res.failovers >= 1, "the root kill never triggered a failover"
+    assert len(res.joined) >= 50 and len(res.left) >= 50
+    assert res.final_loss() < start_loss / 10, "churn fleet did not re-converge"
+    assert res.time_to_target is not None, "churn fleet never hit the target"
+    # bounded disruption: churn costs less than 3x the static fleet's
+    # time-to-target (the bench quantifies the exact ratio)
+    assert res_static.time_to_target is not None
+    assert res.time_to_target < 3.0 * max(res_static.time_to_target, 1.0)
+    # version monotonicity across the handover: the minted sequence in
+    # the loss curve never repeats or regresses
+    versions = [v for _t, v, _l in res.loss_curve]
+    assert versions == sorted(versions) and len(set(versions)) == len(versions)
+
+
+def test_root_killed_mid_flush_version_monotonicity():
+    """The regression the high-water handover exists for: the root is
+    killed right after minting versions its SUCCESSOR never saw (a
+    one-way partition eats the root→successor model pushes). The
+    successor must resume minting strictly above the corpse's last
+    version — carried to it only inside the "vv" triples of updates
+    trained from that version."""
+    n = 6
+    addrs = [f"sim-{i:04d}" for i in range(n)]
+    plan = FaultPlan(
+        seed=3,
+        # successor (sim-0001) never receives a model push from the root
+        partitions=[(addrs[0], addrs[1])],
+        crashes={addrs[0]: CrashSpec(stage="AsyncTrainStage", round_no=3)},
+    )
+    fleet = SimulatedAsyncFleet(
+        n, seed=3, cluster_size=0, k=2, updates_per_node=8, plan=plan,
+        evict_delay=0.3,
+    )
+    res = fleet.run()
+    assert res.failovers >= 1
+    # the successor was blind to the root's mints before the kill...
+    assert fleet.nodes[addrs[1]].known_version > 0
+    # ...yet the minted sequence never regressed or repeated
+    versions = [v for _t, v, _l in res.loss_curve]
+    assert versions == sorted(versions) and len(set(versions)) == len(versions)
+    # and minting continued after the failover (the curve outlived the corpse)
+    pre_kill = max(v for t, v, _l in res.loss_curve if t < 3 * 0.8)
+    assert res.version > pre_kill
+
+
+# ---------------------------------------------------------------------------
+# real nodes: root kill, mid-experiment join, graceful leave
+# ---------------------------------------------------------------------------
+
+
+def _mk_nodes(n, prefix=None):
+    nodes = [
+        Node(
+            learner=DummyLearner(value=float(i)),
+            address=f"{prefix}-{i}" if prefix else None,
+        )
+        for i in range(n)
+    ]
+    for node in nodes:
+        node.start()
+    for node in nodes:
+        full_connection(node, nodes)
+    wait_convergence(nodes, n - 1, only_direct=True, wait=10)
+    return nodes
+
+
+def _stop_all(nodes):
+    for n in nodes:
+        n.stop()
+
+
+def _sum_metric(metric):
+    return sum(d.get(metric, 0.0) for d in logger.get_comm_metrics().values())
+
+
+def _pace(seconds):
+    """A stage hook that paces local updates so churn lands mid-run."""
+
+    def hook(node, stage_name):
+        if stage_name == "AsyncTrainStage":
+            time.sleep(seconds)
+
+    return hook
+
+
+def test_async_root_kill_fails_over_to_successor():
+    """ISSUE 11 acceptance (live half): the GLOBAL ROOT is killed
+    mid-run — the next-sorted live regional self-elects as successor
+    root, survivors keep merging and converge on one global, and nobody
+    sits out the failover window."""
+    Settings.FEDERATION_MODE = "async"
+    Settings.FEDBUFF_K = 3
+    Settings.HIER_CLUSTER_SIZE = 3
+    nodes = _mk_nodes(6, prefix="rk")
+    # addresses rk-0..rk-5 sort deterministically: clusters
+    # [rk-0,rk-1,rk-2] + [rk-3,rk-4,rk-5]; rk-0 is the global root
+    by_addr = {n.addr: n for n in nodes}
+    root = by_addr[sorted(by_addr)[0]]
+    plan = FaultPlan(
+        seed=1905,
+        crashes={root.addr: CrashSpec(stage="AsyncTrainStage", round_no=1)},
+    )
+    install_fault_plan(nodes, plan)
+    for n in nodes:
+        n.stage_hooks.append(_pace(0.4))
+    survivors = [n for n in nodes if n is not root]
+    try:
+        t0 = time.monotonic()
+        nodes[1].set_start_learning(rounds=6, epochs=1)
+        wait_to_finish(survivors, timeout=60)
+        elapsed = time.monotonic() - t0
+        assert elapsed < 50.0, "a node sat out the failover window"
+        assert not root._running
+        for n in survivors:
+            assert n.state.round is None
+        # exactly one survivor self-elected as successor root
+        assert _sum_metric("root_failover") >= 1
+        assert _sum_metric("role_changed") >= 1
+        assert _sum_metric("async_merge") >= 2
+        params = [np.asarray(n.learner.get_parameters()["w"]) for n in survivors]
+        for p in params[1:]:
+            np.testing.assert_allclose(p, params[0], atol=1e-5)
+    finally:
+        remove_fault_plan(nodes)
+        _stop_all(nodes)
+
+
+def test_async_join_mid_experiment():
+    """A node JOINS a running experiment: it bootstraps from an
+    aggregator's current global (async_pull), the fleet folds it into
+    the topology, its updates merge, and it finishes on the fleet's
+    final global."""
+    Settings.FEDERATION_MODE = "async"
+    Settings.FEDBUFF_K = 3
+    Settings.HIER_CLUSTER_SIZE = 0
+    nodes = _mk_nodes(4, prefix="jn-a")
+    for n in nodes:
+        n.stage_hooks.append(_pace(0.35))
+    joiner = Node(learner=DummyLearner(value=99.0), address="jn-z-joiner")
+    joiner.start()
+    try:
+        nodes[0].set_start_learning(rounds=8, epochs=1)
+        time.sleep(1.0)  # the fleet is mid-run, globals already minted
+        full_connection(joiner, nodes)
+        wait_convergence([joiner], 4, only_direct=True, wait=10)
+        joiner.join_async_experiment(rounds=2, epochs=1)
+        wait_to_finish(nodes + [joiner], timeout=60)
+        assert _sum_metric("async_join") == 1
+        assert _sum_metric("async_pull_served") >= 1
+        assert _sum_metric("membership_changed") >= 1
+        # the joiner ends on the fleet's final global, not its own init
+        params = [np.asarray(n.learner.get_parameters()["w"]) for n in nodes]
+        jp = np.asarray(joiner.learner.get_parameters()["w"])
+        np.testing.assert_allclose(jp, params[0], atol=1e-5)
+        for p in params[1:]:
+            np.testing.assert_allclose(p, params[0], atol=1e-5)
+    finally:
+        _stop_all(nodes + [joiner])
+
+
+def test_weights_handlers_drop_cross_experiment_frames():
+    """The xp gate on the DIRECT delivery path (not just the stashes): a
+    previous experiment's retried async_update/async_model must never
+    reach a fresh context's buffers — its version triple is unknown to
+    the new version vector and would merge at full weight."""
+    from p2pfl_tpu.federation import TierRouter as _TR
+    from p2pfl_tpu.federation.workflow import AsyncContext
+
+    node = Node(learner=DummyLearner(value=0.0))
+    try:
+        # a second (virtual) member keeps the flat K at 2, so a valid
+        # offer BUFFERS instead of flushing immediately
+        router = _TR([node.addr, "zz-peer"], 0)
+        ctx = AsyncContext(node, router, {"w": np.zeros(4, np.float32)}, xid="exp2")
+        stale = _update(9.0, ["ghost"], version=("ghost", 1, 0))
+        stale.xp = "exp1"
+        assert ctx.handle_update(stale) == []
+        assert ctx.gbuf.pending() == 0
+        assert _sum_metric("async_xp_filtered") >= 1
+        stale_model = _update(9.0, ["ghost"], version=("ghost", 5, 5))
+        stale_model.xp = "exp1"
+        assert ctx.handle_model(stale_model, "ghost") == []
+        assert ctx.global_version == 0, "cross-experiment global adopted"
+        # a matching frame flows normally
+        ok = _update(1.0, ["peer"], version=("peer", 1, 0))
+        ok.xp = "exp2"
+        ctx.handle_update(ok)
+        assert ctx.gbuf.pending() == 1
+    finally:
+        node.stop()
+
+
+def test_join_view_merge_restores_shared_chunking():
+    """A joiner's live overlay view lacks the dead members survivors keep
+    as cluster HOLES — deriving from it alone would chunk clusters
+    differently from the fleet forever. Merging the pull server's
+    (members, dead) view (async_view) restores the shared derivation."""
+    from p2pfl_tpu.federation.workflow import AsyncContext
+
+    node = Node(None, None)
+    try:
+        members = ["a", "b", "c", "d", "e", "f"]
+        survivor = TierRouter(members + [node.addr], 3, dead={"c"})
+        # the joiner never saw c: its own view is the live members only
+        live_only = [m for m in members if m != "c"] + [node.addr]
+        ctx = AsyncContext(node, TierRouter(live_only, 3), {"w": np.zeros(4, np.float32)})
+        assert ctx.router.topo.clusters != survivor.topo.clusters
+        ctx.merge_view(members + [node.addr], ["c"])
+        assert ctx.router.topo.clusters == survivor.topo.clusters
+        assert ctx.router.roles() == survivor.roles()
+        # idempotent: merging the same view again changes nothing
+        assert ctx.merge_view(members + [node.addr], ["c"]) == []
+    finally:
+        node.stop()
+
+
+def test_overlay_presence_is_not_membership():
+    """A node that CONNECTS mid-run without joining (a monitor, or a
+    node waiting to call join_async_experiment) must not be folded into
+    the topology — membership grows only on an async_join announcement,
+    so a non-participant can never be elected aggregator and blackhole a
+    tier."""
+    Settings.FEDERATION_MODE = "async"
+    Settings.FEDBUFF_K = 3
+    Settings.HIER_CLUSTER_SIZE = 0
+    nodes = _mk_nodes(4, prefix="np-m")
+    for n in nodes:
+        n.stage_hooks.append(_pace(0.25))
+    # "np-a..." sorts BEFORE every member — under presence-based
+    # membership it would be elected global root and blackhole the run
+    monitor = Node(learner=DummyLearner(value=50.0), address="np-a-monitor")
+    monitor.start()
+    try:
+        nodes[0].set_start_learning(rounds=4, epochs=1)
+        time.sleep(0.6)
+        full_connection(monitor, nodes)
+        wait_to_finish(nodes, timeout=60)
+        # membership never changed (no announcement, no eviction)...
+        assert _sum_metric("membership_changed") == 0
+        # ...and the fleet converged without routing anything at the monitor
+        params = [np.asarray(n.learner.get_parameters()["w"]) for n in nodes]
+        for p in params[1:]:
+            np.testing.assert_allclose(p, params[0], atol=1e-5)
+        assert monitor.state.round is None and monitor._running
+    finally:
+        _stop_all(nodes + [monitor])
+
+
+def test_async_graceful_leave():
+    """A member LEAVES gracefully mid-run: it announces (async_leave),
+    survivors re-derive around the hole without an eviction window, the
+    fleet completes, and the leaver exits cleanly with its node still
+    serving the overlay."""
+    Settings.FEDERATION_MODE = "async"
+    Settings.FEDBUFF_K = 3
+    Settings.HIER_CLUSTER_SIZE = 0
+    nodes = _mk_nodes(5, prefix="lv")
+    for n in nodes:
+        n.stage_hooks.append(_pace(0.35))
+    leaver = nodes[3]
+    try:
+        nodes[0].set_start_learning(rounds=6, epochs=1)
+        time.sleep(0.9)
+        leaver.request_async_leave()
+        wait_to_finish(nodes, timeout=60)
+        assert _sum_metric("async_left") == 1
+        assert _sum_metric("async_merge") >= 2
+        assert leaver._running, "a graceful leave must not stop the node"
+        assert leaver.state.round is None
+        stayed = [n for n in nodes if n is not leaver]
+        params = [np.asarray(n.learner.get_parameters()["w"]) for n in stayed]
+        for p in params[1:]:
+            np.testing.assert_allclose(p, params[0], atol=1e-5)
+    finally:
+        _stop_all(nodes)

@@ -431,9 +431,12 @@ def test_async_federation_hierarchical_chaos():
 
 def test_async_regional_crash_fails_over_to_root():
     """A dead REGIONAL must not orphan its cluster: once eviction lands,
-    its edges re-route updates to the global root (push_target) and the
-    root adopts them into its push-down fan-out (live_children), so the
-    orphaned edges keep merging and keep receiving fresh globals."""
+    every node re-derives the topology with the corpse as a hole
+    (federation/routing.py) — the cluster's next-sorted live member
+    self-elects as successor regional (seeding its buffer from its last
+    adopted global), and until each edge observes the death its updates
+    are absorbed rather than lost — so the cluster keeps merging and
+    keeps receiving fresh globals."""
     Settings.FEDERATION_MODE = "async"
     Settings.FEDBUFF_K = 3
     Settings.HIER_CLUSTER_SIZE = 3
