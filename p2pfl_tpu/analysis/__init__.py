@@ -1,0 +1,50 @@
+"""p2pfl-check: static enforcement of the repo's concurrency/donation/wire contracts.
+
+The framework's hardest bugs have all been violations of invariants that
+used to exist only as prose: "no lock held across a send" (the async
+plane's deadlock contract), "donated buffers must not be reused after a
+failed dispatch" (the deleted-array poisoning class), "control-plane
+merges are monotone and serialized by ``status_merge_lock``" (the round-0
+wedge), "optional wire-header keys decode unchanged when absent and never
+reach the protobuf interop schema" (the ``tc``/``vv``/``xp`` pattern), and
+"nothing inside a jitted program reads mutable host state" (the
+``BWD_MODE`` staleness class). ``check_partition_rules`` proved that
+turning one of these contracts into a construction-time lint converts
+silent corruption into loud errors; this package generalizes the idea to
+an AST-based rule engine over the whole codebase (stdlib ``ast`` only —
+analyzed code is parsed, never imported or executed).
+
+Usage::
+
+    python -m p2pfl_tpu.analysis p2pfl_tpu/          # exit 1 on findings
+    python -m p2pfl_tpu.analysis --list-rules
+
+Findings are suppressed inline with ``# p2pfl: allow(rule-id)`` (same line
+or the line above, with a justification after the pragma) or accepted
+wholesale via a committed baseline file (``--baseline`` /
+``--update-baseline``) so the gate can land on a tree with known debt and
+still block NEW violations. The finding/severity types here are shared
+with the sharding lint (:mod:`p2pfl_tpu.parallel.sharding`), so every
+static check in the repo reports in one format.
+"""
+
+from p2pfl_tpu.analysis.engine import (
+    Rule,
+    SourceModule,
+    analyze,
+    load_baseline,
+    new_findings,
+    write_baseline,
+)
+from p2pfl_tpu.analysis.findings import Finding, Severity
+
+__all__ = [
+    "Finding",
+    "Rule",
+    "Severity",
+    "SourceModule",
+    "analyze",
+    "load_baseline",
+    "new_findings",
+    "write_baseline",
+]

@@ -1,0 +1,30 @@
+"""Built-in rule registry: one module per incident family.
+
+Each rule is distilled from a real bug this repo shipped and fixed; the
+rule docstrings name the incident, and ``tests/test_analysis.py`` pins
+both directions (the historical bug shape flags, the shipped fix shape
+passes). Order here is the report order for ``--list-rules``.
+"""
+
+from p2pfl_tpu.analysis.rules.concurrency import SendUnderLockRule
+from p2pfl_tpu.analysis.rules.donation import DonationReuseRule
+from p2pfl_tpu.analysis.rules.jit import JitStalenessRule
+from p2pfl_tpu.analysis.rules.merge import MonotoneMergeRule
+from p2pfl_tpu.analysis.rules.wire import WireHeaderCompatRule
+
+ALL_RULES = (
+    SendUnderLockRule,
+    DonationReuseRule,
+    MonotoneMergeRule,
+    WireHeaderCompatRule,
+    JitStalenessRule,
+)
+
+__all__ = [
+    "ALL_RULES",
+    "DonationReuseRule",
+    "JitStalenessRule",
+    "MonotoneMergeRule",
+    "SendUnderLockRule",
+    "WireHeaderCompatRule",
+]

@@ -1,0 +1,229 @@
+"""donation-reuse: a buffer donated to a jit call is dead — rebind or recover.
+
+Incident: the fused-round programs donate params/opt-state
+(``donate_argnums``/``donate_argnames``) so XLA reuses their buffers. A
+dispatch that fails AFTER argument donation leaves the caller holding
+deleted arrays; the next use explodes with "array has been deleted" deep
+inside jit argument processing — the PR-4 encode-path poisoning, re-hit
+by PR-6's fused round and fixed with the ``_recover_donated_state``
+pattern (drop + rebuild on dispatch failure, rebind on success).
+
+The rule works lexically within one module: it collects jitted functions
+whose decorators declare donated parameters, then at every call site
+checks that each donated argument (a plain ``name`` or dotted
+``self.attr`` expression) is not READ again later in the same function
+without an intervening rebind. Reads inside nested defs are exempt (they
+run later, usually after the rebind); the historical fix shape —
+``result = spmd_round(self.params, …)`` then
+``self.params, … = result[:…]`` — passes because the store precedes any
+read.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from p2pfl_tpu.analysis.engine import (
+    Rule,
+    SourceModule,
+    dotted_name,
+    iter_non_nested,
+    node_end_pos,
+    node_pos,
+    walk_functions,
+)
+from p2pfl_tpu.analysis.findings import Finding
+
+_JIT_NAMES = {"jit", "jax.jit", "pjit", "jax.pjit", "pjit.pjit"}
+_PARTIAL_NAMES = {"partial", "functools.partial"}
+
+Donation = Tuple[Set[int], Set[str]]  # (positional indices, kwarg names)
+
+
+def _const_ints(node: ast.AST) -> Set[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: Set[int] = set()
+        for elt in node.elts:
+            out |= _const_ints(elt)
+        return out
+    return set()
+
+
+def _const_strs(node: ast.AST) -> Set[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: Set[str] = set()
+        for elt in node.elts:
+            out |= _const_strs(elt)
+        return out
+    return set()
+
+
+def _jit_donation(call: ast.AST, module_strs: Dict[str, Set[str]]) -> Optional[Donation]:
+    """Donated (positions, names) declared by a jit/partial(jit, …) call."""
+    if not isinstance(call, ast.Call):
+        return None
+    func = dotted_name(call.func)
+    is_jit = func in _JIT_NAMES
+    if func in _PARTIAL_NAMES and call.args:
+        is_jit = dotted_name(call.args[0]) in _JIT_NAMES
+    if not is_jit:
+        return None
+    positions: Set[int] = set()
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            positions |= _const_ints(kw.value)
+        elif kw.arg == "donate_argnames":
+            strs = _const_strs(kw.value)
+            if not strs:
+                # a module-level constant tuple of names (the
+                # _ROUND_DONATED_STATE idiom): resolve it lexically
+                ref = dotted_name(kw.value)
+                if ref in module_strs:
+                    strs = module_strs[ref]
+            names |= strs
+    if positions or names:
+        return positions, names
+    return None
+
+
+def _module_str_tuples(tree: ast.Module) -> Dict[str, Set[str]]:
+    """Module-level ``NAME = ("a", "b", …)`` string-tuple constants."""
+    out: Dict[str, Set[str]] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+            if isinstance(target, ast.Name):
+                strs = _const_strs(node.value)
+                if strs:
+                    out[target.id] = strs
+    return out
+
+
+def _donated_functions(tree: ast.Module) -> Dict[str, Donation]:
+    module_strs = _module_str_tuples(tree)
+    out: Dict[str, Donation] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                don = _jit_donation(dec, module_strs)
+                if don:
+                    out[node.name] = don
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+            if isinstance(target, ast.Name):
+                don = _jit_donation(node.value, module_strs)
+                if don:
+                    out[target.id] = don
+    return out
+
+
+def _store_paths(target: ast.AST) -> Iterable[str]:
+    """Dotted paths a (possibly tuple) assignment target rebinds."""
+    if isinstance(target, (ast.Tuple, ast.List)):
+        for elt in target.elts:
+            yield from _store_paths(elt)
+    elif isinstance(target, ast.Starred):
+        yield from _store_paths(target.value)
+    else:
+        path = dotted_name(target)
+        if path:
+            yield path
+
+
+class DonationReuseRule(Rule):
+    id = "donation-reuse"
+    summary = "donated jit arguments must be rebound before any later read"
+
+    def check_module(self, mod: SourceModule) -> Iterable[Finding]:
+        donated = _donated_functions(mod.tree)
+        if not donated:
+            return ()
+        out: List[Finding] = []
+        for qual, fn in walk_functions(mod.tree):
+            out += self._check_function(mod, qual, fn, donated)
+        return out
+
+    def _check_function(
+        self,
+        mod: SourceModule,
+        qual: str,
+        fn: ast.AST,
+        donated: Dict[str, Donation],
+    ) -> List[Finding]:
+        # one linear pass in source order: donate events, stores, loads
+        donations: List[Tuple[Tuple[int, int], str, str]] = []  # (end_pos, path, callee)
+        stores: List[Tuple[Tuple[int, int], str]] = []
+        loads: List[Tuple[Tuple[int, int], str, ast.AST]] = []
+
+        for node in iter_non_nested(fn):
+            if isinstance(node, ast.Call):
+                callee = dotted_name(node.func)
+                callee_last = callee.rsplit(".", 1)[-1] if callee else None
+                if callee_last in donated:
+                    positions, names = donated[callee_last]
+                    exprs = [
+                        node.args[i]
+                        for i in positions
+                        if i < len(node.args) and not isinstance(node.args[i], ast.Starred)
+                    ]
+                    exprs += [kw.value for kw in node.keywords if kw.arg in names]
+                    for expr in exprs:
+                        path = dotted_name(expr)
+                        if path:
+                            donations.append((node_end_pos(node), path, callee_last))
+            elif isinstance(node, ast.Assign):
+                # a store lands AFTER its RHS evaluates: position it at the
+                # statement's end so `x = donated_fn(x)` counts as a rebind
+                for target in node.targets:
+                    stores += [(node_end_pos(node), p) for p in _store_paths(target)]
+            elif isinstance(node, ast.AugAssign):
+                # read-modify-write: the read half counts as a load
+                path = dotted_name(node.target)
+                if path:
+                    loads.append((node_pos(node), path, node))
+            elif isinstance(node, (ast.Attribute, ast.Name)):
+                path = dotted_name(node)
+                if path:
+                    if isinstance(node.ctx, ast.Load):
+                        loads.append((node_pos(node), path, node))
+                    elif isinstance(node.ctx, (ast.Store, ast.Del)):
+                        # with-as / for-target / del rebinds too
+                        stores.append((node_pos(node), path))
+
+        out: List[Finding] = []
+        for don_pos, path, callee in donations:
+            next_load = min(
+                (pos for pos, p, _ in loads if p == path and pos > don_pos),
+                default=None,
+            )
+            if next_load is None:
+                continue
+            next_store = min(
+                (pos for pos, p in stores if p == path and pos >= don_pos),
+                default=None,
+            )
+            if next_store is not None and next_store <= next_load:
+                continue  # rebound before the read — the shipped fix shape
+            load_node = next(n for pos, p, n in loads if p == path and pos == next_load)
+            out.append(
+                Finding(
+                    rule=self.id,
+                    path=mod.path,
+                    line=load_node.lineno,
+                    col=load_node.col_offset,
+                    message=(
+                        f"'{path}' was donated to jitted '{callee}' and read "
+                        "again without rebinding — a failed dispatch leaves "
+                        "it deleted (rebind from the result, or recover via "
+                        "the _recover_donated_state pattern)"
+                    ),
+                    context=qual,
+                )
+            )
+        return out

@@ -33,8 +33,13 @@ class ModelInitializedCommand(Command):
 
     def execute(self, source: str, round: int, *args, **kwargs) -> None:  # noqa: A002
         # -1 is the floor of the status lattice: only record it for a peer
-        # with no status yet (nei_status is reset at experiment boundaries)
-        self._state.nei_status.setdefault(source, -1)
+        # with no status yet (nei_status is reset at experiment boundaries).
+        # Same merge lock as models_ready's max-merge: this handler and
+        # that one race on whatever threads deliver the two announcements,
+        # and the lattice contract is that every nei_status merge is
+        # serialized, not just individually GIL-atomic.
+        with self._state.status_merge_lock:
+            self._state.nei_status.setdefault(source, -1)
 
 
 class SecAggPubCommand(Command):

@@ -55,6 +55,12 @@ _METHODS = ("handshake", "disconnect", "send_message", "send_weights")
 
 # ---- envelope codec ----
 
+# Optional header keys ("tc"/"vv"/"xp") are declared in ONE registry —
+# communication/wire_headers.py — and every leg of their compat contract
+# (guarded encode, .get() decode, memory byte-path copy, no protobuf
+# leak) is enforced against these functions by the wire-header-compat
+# rule of `python -m p2pfl_tpu.analysis`. Add a key there first.
+
 
 def encode_message(msg: Message) -> bytes:
     d = {

@@ -121,7 +121,10 @@ class InMemoryProtocol(CommunicationProtocol):
                 if Settings.MEMORY_WIRE_CODEC and env.update.params is not None:
                     # byte-path simulation: ship encoded bytes (hitting the
                     # payload cache like a network transport would) and let
-                    # the receiver materialize against its own learner
+                    # the receiver materialize against its own learner.
+                    # Every optional header in wire_headers.py must ride
+                    # this re-wrap (enforced by wire-header-compat) or
+                    # simulations diverge from the network transports.
                     from p2pfl_tpu.learning.weights import ModelUpdate
 
                     wire = ModelUpdate(

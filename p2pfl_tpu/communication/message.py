@@ -51,6 +51,11 @@ class Message:
     unchanged, the protobuf interop schema never carries it. Receivers
     use it to filter cross-experiment stragglers EXACTLY instead of by
     TTL + epoch heuristics alone.
+
+    Both ride the wire as optional header keys declared in
+    :mod:`p2pfl_tpu.communication.wire_headers` — the registry the
+    ``wire-header-compat`` analyzer rule enforces the compat contract
+    against.
     """
 
     source: str

@@ -56,6 +56,11 @@ except ImportError:  # pragma: no cover - protobuf is present in dev images
     pb = None
     HAVE_PROTOBUF = False
 
+# NOTE: this codec is the reference-interop schema — the optional native
+# envelope headers registered in communication/wire_headers.py must NEVER
+# appear here (enforced by the wire-header-compat analyzer rule: any of
+# those key strings, kwargs or field accesses in this file is a finding).
+
 #: the P2TW magic (learning/weights.py) — the only weight payload accepted
 _P2TW_MAGIC = b"P2TW"
 #: protobuf field-1 length-delimited tag; both formats' first byte differs

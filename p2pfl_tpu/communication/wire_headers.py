@@ -1,0 +1,82 @@
+"""The one registry of OPTIONAL envelope header keys.
+
+Every optional key the native wire envelope may carry (``tc``, ``vv``,
+``xp``) follows the same backward-compat contract, established when the
+flight recorder first put ``tc`` on the wire:
+
+- **absent-frame decode**: a frame without the key decodes exactly as a
+  pre-key frame did (``d.get(key)`` — never ``d[key]``);
+- **guarded encode**: ``None`` is never serialized — the encoder writes
+  the key only under an ``is not None`` guard, so old receivers keep
+  parsing new senders and byte-for-byte golden frames stay stable;
+- **memory byte path copies it**: the in-memory transport's
+  ``MEMORY_WIRE_CODEC`` re-wrap (``communication/memory.py``) must carry
+  the key's backing attributes onto the re-built envelope/update, or
+  simulations silently diverge from the network transports;
+- **never in the protobuf interop schema**: the reference's proto schema
+  (``proto_wire.py``) predates these keys and must stay byte-compatible
+  with real reference nodes — optional keys ride only the native JSON
+  envelope.
+
+Declaring a key here is what makes the contract enforceable: the
+``wire-header-compat`` analyzer rule (:mod:`p2pfl_tpu.analysis`)
+cross-checks every declared key against all three codec files and fails
+CI when a new key skips any leg of the pattern. Adding an optional
+header = add a :class:`WireHeader` entry + satisfy the rule.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Tuple
+
+
+@dataclass(frozen=True)
+class WireHeader:
+    """One optional envelope header key and where it must be handled.
+
+    ``planes``: which native codecs carry it — ``"message"`` (control
+    plane: ``encode_message``/``decode_message``) and/or ``"weights"``
+    (data plane: ``encode_weights``/``decode_weights``).
+
+    ``memory_copies``: ``(constructor, kwarg)`` pairs the in-memory byte
+    path's re-wrap must pass — e.g. ``("ModelUpdate", "version")`` means
+    the rebuilt wire update must copy ``version=``.
+    """
+
+    key: str
+    planes: Tuple[str, ...]
+    memory_copies: Tuple[Tuple[str, str], ...]
+    doc: str
+
+
+OPTIONAL_WIRE_HEADERS: Tuple[WireHeader, ...] = (
+    WireHeader(
+        key="tc",
+        planes=("message", "weights"),
+        memory_copies=(("WeightsEnvelope", "trace_ctx"),),
+        doc=(
+            "flight-recorder trace context (trace_id, parent_span_id) — "
+            "management/telemetry.py; joins receiver spans to the "
+            "sender's causal tree"
+        ),
+    ),
+    WireHeader(
+        key="vv",
+        planes=("weights",),
+        memory_copies=(("ModelUpdate", "version"),),
+        doc=(
+            "async-federation version triple (origin, seq, base_version) "
+            "— federation/staleness.py; dedup + staleness weighting"
+        ),
+    ),
+    WireHeader(
+        key="xp",
+        planes=("message", "weights"),
+        memory_copies=(("ModelUpdate", "xp"), ("WeightsEnvelope", "xp")),
+        doc=(
+            "experiment identity minted by the start_learning initiator — "
+            "receivers filter cross-experiment stragglers exactly"
+        ),
+    ),
+)

@@ -53,6 +53,7 @@ from typing import Any, Optional, Sequence
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from p2pfl_tpu.analysis.findings import Finding, Severity
 from p2pfl_tpu.settings import Settings
 
 Pytree = Any
@@ -185,6 +186,13 @@ class RuleLintReport:
     pairs where the axis exists but its size does not divide the leaf dim
     (placement replicates these — legitimate for tiny test models, an
     error for a 1B deployment).
+
+    Reporting rides the shared static-check types
+    (:mod:`p2pfl_tpu.analysis.findings`): :meth:`findings` renders the
+    same facts as :class:`~p2pfl_tpu.analysis.findings.Finding` objects
+    — error severity for the three construction-time failures, info for
+    ``indivisible`` — so sharding lint output and the analyzer CLI speak
+    one format.
     """
 
     unmatched: list[str] = field(default_factory=list)
@@ -192,12 +200,40 @@ class RuleLintReport:
     unknown_axes: list[tuple[str, str]] = field(default_factory=list)
     indivisible: list[tuple[str, str]] = field(default_factory=list)
 
+    def findings(self, path: str = "partition-rules") -> list[Finding]:
+        """The report as shared :class:`Finding` objects (one per fact).
+
+        ``path`` labels the source of the rule set (there is no file to
+        point at — rules are data); line/col are 0 by construction.
+        """
+
+        def make(rule: str, message: str, severity: Severity = Severity.ERROR) -> Finding:
+            return Finding(
+                rule=rule, path=path, line=0, col=0, message=message, severity=severity
+            )
+
+        out = [make("partition-unmatched", f"unmatched path: {p}") for p in self.unmatched]
+        out += [
+            make("partition-dead-rule", f"dead rule (never first match): {r!r}")
+            for r in self.dead_rules
+        ]
+        out += [
+            make("partition-unknown-axis", f"rule {r!r} names axis {a!r} not in the mesh")
+            for r, a in self.unknown_axes
+        ]
+        out += [
+            make(
+                "partition-indivisible",
+                f"axis {a!r} does not divide {p!r} — leaf replicates",
+                Severity.INFO,
+            )
+            for p, a in self.indivisible
+        ]
+        return out
+
     @property
     def errors(self) -> list[str]:
-        out = [f"unmatched path: {p}" for p in self.unmatched]
-        out += [f"dead rule (never first match): {r!r}" for r in self.dead_rules]
-        out += [f"rule {r!r} names axis {a!r} not in the mesh" for r, a in self.unknown_axes]
-        return out
+        return [f.message for f in self.findings() if f.severity is Severity.ERROR]
 
     def ok(self) -> bool:
         return not self.errors

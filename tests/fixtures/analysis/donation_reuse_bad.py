@@ -1,0 +1,30 @@
+# teeth: the PR-6 donation-reuse shape. spmd_round donates params/opt
+# state; a dispatch that dies after consuming the buffers leaves
+# self.params deleted, and the later read poisons every following round
+# with "array has been deleted" deep inside jit argument processing.
+# MUST flag: donation-reuse
+
+from functools import partial
+
+import jax
+
+_DONATED_STATE = ("c_global", "c_local")
+
+
+@partial(jax.jit, static_argnames=("module",), donate_argnums=(0, 1), donate_argnames=_DONATED_STATE)
+def spmd_round(stacked_params, opt_states, x_all, *, c_global=None, c_local=None, module=None):
+    return stacked_params, opt_states
+
+
+class Federation:
+    def run_round(self):
+        try:
+            result = spmd_round(
+                self.params, self.opt_state, self.x_all,
+                c_global=self.c_global, c_local=self.c_local, module=self.module,
+            )
+        except Exception:
+            pass  # no recovery: the donated buffers may already be consumed
+        loss = result[2]
+        # read of a possibly-deleted donated buffer — the historical bug
+        return self.encode(self.params), loss

@@ -1,0 +1,30 @@
+# teeth: the shipped PR-6 fix shape — rebind the donated state from the
+# result on success, and recover (drop + rebuild) on dispatch failure
+# before re-raising (_recover_donated_state in parallel/spmd.py).
+# MUST pass: donation-reuse
+
+from functools import partial
+
+import jax
+
+_DONATED_STATE = ("c_global", "c_local")
+
+
+@partial(jax.jit, static_argnames=("module",), donate_argnums=(0, 1), donate_argnames=_DONATED_STATE)
+def spmd_round(stacked_params, opt_states, x_all, *, c_global=None, c_local=None, module=None):
+    return stacked_params, opt_states
+
+
+class Federation:
+    def run_round(self):
+        try:
+            result = spmd_round(
+                self.params, self.opt_state, self.x_all,
+                c_global=self.c_global, c_local=self.c_local, module=self.module,
+            )
+        except Exception:
+            self._recover_donated_state()
+            raise
+        self.params, self.opt_state, loss = result[:3]
+        self.c_global, self.c_local = result[3:5]
+        return self.encode(self.params), loss

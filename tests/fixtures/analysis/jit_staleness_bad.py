@@ -1,0 +1,43 @@
+# teeth: the PR-2 BWD_MODE staleness shape. A module global read at trace
+# time participates in no jit cache key — flipping it keeps serving the
+# OLD compiled program. Settings.* reads inside jit are the same trap,
+# and host syncs on traced values break the no-host-sync dispatch
+# contract of the fused-round programs.
+# MUST flag: jit-staleness (x4)
+
+from functools import partial
+
+import jax
+import numpy as np
+
+from p2pfl_tpu.settings import Settings
+
+BWD_MODE = "flash"
+
+
+def set_bwd_mode(mode):
+    global BWD_MODE
+    BWD_MODE = mode
+
+
+@jax.jit
+def flash_bwd(q, k, v):
+    if BWD_MODE == "flash":  # mutable global inside jit: stale after set_bwd_mode
+        return q
+    return k
+
+
+@partial(jax.jit, static_argnames=("n",))
+def fold(x, n):
+    acc = x.astype(Settings.AGG_DTYPE)  # Settings read baked at first trace
+    total = float(acc.sum())  # host sync on a traced value
+    return np.asarray(total)  # host materialization inside jit
+
+
+def _kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * (2.0 if BWD_MODE == "flash" else 1.0)
+
+
+def apply(x, pl=None):
+    kernel = partial(_kernel)
+    return pl.pallas_call(kernel, out_shape=x)(x)

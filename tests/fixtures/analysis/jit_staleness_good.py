@@ -1,0 +1,29 @@
+# teeth: the shipped PR-2 fix shape — every knob is an explicit argument
+# (KernelConfig idiom): static_argnames participate in the jit cache key,
+# so changing a knob provably re-traces; dtypes ride in as arguments and
+# reductions stay on device.
+# MUST pass: jit-staleness
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+_BLOCK = 128  # single-assignment module constant: static, fine to read
+
+
+@partial(jax.jit, static_argnames=("mode", "agg_dtype"))
+def flash_bwd(q, k, v, *, mode="flash", agg_dtype=jnp.float32):
+    acc = q.astype(agg_dtype)
+    if mode == "flash":
+        return acc
+    return k
+
+
+def _kernel(x_ref, o_ref, *, scale):
+    o_ref[...] = x_ref[...] * scale * _BLOCK
+
+
+def apply(x, pl=None):
+    kernel = partial(_kernel, scale=2.0)
+    return pl.pallas_call(kernel, out_shape=x)(x)

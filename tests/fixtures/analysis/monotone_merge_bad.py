@@ -1,0 +1,26 @@
+# teeth: the PR-5 round-0 wedge shape. An unlocked overwrite of the
+# coverage dict lets a stale redelivery clobber a newer view — the
+# partial-gossip convergence detector reopens and the round wedges.
+# MUST flag: monotone-merge
+
+
+class ModelsAggregatedCommand:
+    def execute(self, source, round, *args):
+        st = self._state
+        coverage = st.models_aggregated
+        if st.round is None or round != st.round:
+            return
+        # overwrite, no lock: loses a sender's FINAL announcement when two
+        # handler threads interleave their read-merge-writes
+        coverage[source] = list(args)
+
+
+class ModelsReadyCommand:
+    def execute(self, source, round, *args):
+        st = self._state
+        st.nei_status[source] = round  # regression on stale redelivery, unlocked
+
+
+class AsyncDoneCommand:
+    def execute(self, source, round, *args):
+        self._state.async_done_peers.add(source)  # unlocked set mutation

@@ -1,0 +1,35 @@
+# teeth: the shipped PR-5 fix shape — every lattice merge is a monotone
+# union/max read-merge-write serialized under status_merge_lock; whole-
+# attribute replacement stays allowed (the replace-don't-mutate idiom of
+# NodeState.increase_round / clear).
+# MUST pass: monotone-merge
+
+
+class ModelsAggregatedCommand:
+    def execute(self, source, round, *args):
+        st = self._state
+        coverage = st.models_aggregated
+        if st.round is None or round != st.round:
+            return
+        with st.status_merge_lock:
+            prev = coverage.get(source)
+            coverage[source] = sorted(set(prev) | set(args)) if prev else list(args)
+
+
+class ModelsReadyCommand:
+    def execute(self, source, round, *args):
+        st = self._state
+        with st.status_merge_lock:
+            st.nei_status[source] = max(st.nei_status.get(source, -1), round)
+
+
+class AsyncDoneCommand:
+    def execute(self, source, round, *args):
+        with self._state.status_merge_lock:
+            self._state.async_done_peers.add(source)
+
+
+class NodeState:
+    def increase_round(self):
+        self.round += 1
+        self.models_aggregated = {}  # replacement, not mutation: allowed

@@ -1,0 +1,21 @@
+# teeth: the PR-9 lock-across-send shape. A command handler sending while
+# holding the context lock re-enters the receiver's handler synchronously
+# on the in-memory transport — two nodes deadlock on each other's locks.
+# MUST flag: send-under-lock
+
+
+class AsyncUpdateHandler:
+    def execute(self, source, update):
+        ctx = self.node.async_ctx
+        with ctx.lock:
+            res = ctx.rbuf.offer(update)
+            if res:
+                # sending with ctx.lock held: the receiver's handler takes
+                # ITS context lock and may push back at us
+                self.node.protocol.send(ctx.router.root, self.build(res))
+
+    def repair(self, addr):
+        st = self.node.state
+        with st.status_merge_lock:
+            st.async_done_peers.add(addr)
+            self.node.protocol.broadcast(self.node.protocol.build_msg("async_done"))

@@ -1,0 +1,28 @@
+# teeth: the shipped PR-9 fix shape — handlers compute under locks,
+# collect action tuples, and send OUTSIDE every lock (the deadlock
+# contract in federation/workflow.py AsyncContext docs).
+# MUST pass: send-under-lock
+
+
+class AsyncUpdateHandler:
+    def execute(self, source, update):
+        ctx = self.node.async_ctx
+        with ctx.lock:
+            res = ctx.rbuf.offer(update)
+            actions = [("async_update", ctx.router.root, res)] if res else []
+        for cmd, target, upd in actions:
+            self.node.protocol.send(target, self.build(cmd, upd))
+
+    def repair(self, addr):
+        st = self.node.state
+        with st.status_merge_lock:
+            st.async_done_peers.add(addr)
+        self.node.protocol.broadcast(self.node.protocol.build_msg("async_done"))
+
+    def deferred_is_fine(self):
+        # a closure DEFINED under a lock runs later, outside it — the
+        # eviction-repair daemon-thread pattern in node.py
+        with self.ctx.lock:
+            def _repair():
+                self.node.protocol.send(self.target, self.env)
+            self.spawn(_repair)

@@ -1,0 +1,44 @@
+# teeth: every way the tc/vv/xp optional-key contract historically broke
+# in the envelope codec — unconditional serialization (None hits the
+# wire) and [] decode (KeyError on pre-key frames).
+# MUST flag: wire-header-compat
+
+import json
+
+
+def encode_message(msg):
+    d = {"src": msg.source, "cmd": msg.cmd, "args": list(msg.args)}
+    if msg.trace_ctx is not None:
+        d["tc"] = list(msg.trace_ctx)
+    d["xp"] = msg.xp  # unconditional: old receivers now see "xp": null
+    return json.dumps(d).encode()
+
+
+def decode_message(data):
+    d = json.loads(data.decode())
+    # [] read: a frame from a pre-xp sender raises KeyError here
+    return Message(d["src"], d["cmd"], trace_ctx=_trace_ctx(d), xp=d["xp"])
+
+
+def _trace_ctx(d):
+    tc = d.get("tc")
+    return (str(tc[0]), str(tc[1])) if tc else None
+
+
+def encode_weights(env):
+    d = {"src": env.source, "round": env.round, "cmd": env.cmd}
+    if env.trace_ctx is not None:
+        d["tc"] = list(env.trace_ctx)
+    if env.update.version is not None:
+        d["vv"] = list(env.update.version)
+    if env.xp is not None:
+        d["xp"] = env.xp
+    return json.dumps(d).encode()
+
+
+def decode_weights(data):
+    d = json.loads(data.decode())
+    vv = d.get("vv")
+    return WeightsEnvelope(
+        d["src"], d["round"], d["cmd"], version=vv, trace_ctx=_trace_ctx(d), xp=d.get("xp")
+    )

@@ -1,0 +1,20 @@
+# teeth: the byte-path re-wrap drops the version triple and experiment
+# identity — MEMORY_WIRE_CODEC simulations silently diverge from the
+# network transports (dedup and xp filtering never see the fields).
+# MUST flag: wire-header-compat
+
+
+class InMemoryProtocol:
+    def _send_to_neighbor(self, nei, env, create_connection=False):
+        peer = MemoryRegistry.get(nei)
+        if Settings.MEMORY_WIRE_CODEC and env.update.params is not None:
+            wire = ModelUpdate(
+                params=None,
+                contributors=list(env.update.contributors),
+                num_samples=env.update.num_samples,
+                encoded=env.update.encode(),
+                # version= and xp= NOT copied
+            )
+            env = WeightsEnvelope(env.source, env.round, env.cmd, wire, env.msg_id)
+            # trace_ctx= and xp= NOT copied
+        return peer.handle_weights(env).ok

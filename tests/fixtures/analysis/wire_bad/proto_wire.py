@@ -1,0 +1,18 @@
+# teeth: an optional envelope key leaking into the protobuf interop
+# schema — the reference's generated stubs would reject / misparse the
+# frame, breaking byte-compat with real reference nodes.
+# MUST flag: wire-header-compat
+
+
+def encode_weights_pb(env):
+    out = pb.Weights(
+        source=env.source,
+        round=env.round,
+        weights=env.update.encode(),
+        contributors=list(env.update.contributors),
+        weight=int(env.update.num_samples),
+        cmd=env.cmd,
+    )
+    if env.update.version is not None:
+        out.vv = list(env.update.version)  # schema leak
+    return out.SerializeToString()

@@ -1,0 +1,45 @@
+# teeth: the shipped optional-key pattern — is-not-None guarded encode,
+# .get() decode (absent frames decode unchanged), helper indirection for
+# the trace context exactly like the real codec.
+# MUST pass: wire-header-compat
+
+import json
+
+
+def encode_message(msg):
+    d = {"src": msg.source, "cmd": msg.cmd, "args": list(msg.args)}
+    if msg.trace_ctx is not None:
+        d["tc"] = list(msg.trace_ctx)
+    if msg.xp is not None:
+        d["xp"] = msg.xp
+    return json.dumps(d).encode()
+
+
+def decode_message(data):
+    d = json.loads(data.decode())
+    return Message(d["src"], d["cmd"], trace_ctx=_trace_ctx(d), xp=d.get("xp"))
+
+
+def _trace_ctx(d):
+    tc = d.get("tc")
+    return (str(tc[0]), str(tc[1])) if tc else None
+
+
+def encode_weights(env):
+    d = {"src": env.source, "round": env.round, "cmd": env.cmd}
+    if env.trace_ctx is not None:
+        d["tc"] = list(env.trace_ctx)
+    if env.update.version is not None:
+        d["vv"] = list(env.update.version)
+    xp = env.xp or env.update.xp
+    if xp is not None:
+        d["xp"] = xp
+    return json.dumps(d).encode()
+
+
+def decode_weights(data):
+    d = json.loads(data.decode())
+    vv = d.get("vv")
+    return WeightsEnvelope(
+        d["src"], d["round"], d["cmd"], version=vv, trace_ctx=_trace_ctx(d), xp=d.get("xp")
+    )

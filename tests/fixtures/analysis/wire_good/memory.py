@@ -1,0 +1,23 @@
+# teeth: the shipped byte-path re-wrap — version, xp and trace_ctx all
+# ride the rebuilt update/envelope, so MEMORY_WIRE_CODEC simulations see
+# exactly what the network transports would deliver.
+# MUST pass: wire-header-compat
+
+
+class InMemoryProtocol:
+    def _send_to_neighbor(self, nei, env, create_connection=False):
+        peer = MemoryRegistry.get(nei)
+        if Settings.MEMORY_WIRE_CODEC and env.update.params is not None:
+            wire = ModelUpdate(
+                params=None,
+                contributors=list(env.update.contributors),
+                num_samples=env.update.num_samples,
+                encoded=env.update.encode(),
+                version=env.update.version,
+                xp=env.update.xp,
+            )
+            env = WeightsEnvelope(
+                env.source, env.round, env.cmd, wire, env.msg_id,
+                trace_ctx=env.trace_ctx, xp=env.xp,
+            )
+        return peer.handle_weights(env).ok

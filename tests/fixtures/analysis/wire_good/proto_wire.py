@@ -1,0 +1,25 @@
+# teeth: the shipped protobuf interop codec shape — exactly the
+# reference's Weights schema fields, no optional envelope keys.
+# MUST pass: wire-header-compat
+
+
+def encode_weights_pb(env):
+    return pb.Weights(
+        source=env.source,
+        round=env.round,
+        weights=env.update.encode(),
+        contributors=list(env.update.contributors),
+        weight=int(env.update.num_samples),
+        cmd=env.cmd,
+    ).SerializeToString()
+
+
+def decode_weights_pb(data):
+    w = pb.Weights.FromString(data)
+    update = ModelUpdate(
+        params=None,
+        contributors=list(w.contributors),
+        num_samples=int(w.weight),
+        encoded=bytes(w.weights),
+    )
+    return WeightsEnvelope(w.source, w.round, w.cmd, update)

@@ -1,0 +1,441 @@
+"""p2pfl-check rule engine: teeth fixtures, suppressions, baseline, self-run.
+
+Every rule gets a flag/no-flag matrix: the *bad* fixture reproduces the
+historical bug shape (PR-9 lock-across-send, PR-6 donation reuse, PR-5
+unlocked lattice overwrite, the tc/vv/xp wire-compat breaks, the PR-2
+BWD_MODE staleness) and MUST flag; the *good* fixture is the shipped fix
+shape and MUST pass. On top of the minimal fixtures, the "shipped module
+teeth" tests re-introduce each bug into the REAL source files in memory
+and assert the analyzer catches it there too — so a rule cannot silently
+stop seeing the code it was written for. The self-run test makes tier-1
+fail if a future PR introduces a violation without a pragma.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+import pytest
+
+import p2pfl_tpu
+from p2pfl_tpu.analysis import (
+    Finding,
+    Severity,
+    analyze,
+    load_baseline,
+    new_findings,
+    write_baseline,
+)
+from p2pfl_tpu.analysis.__main__ import main as cli_main
+from p2pfl_tpu.analysis.rules import (
+    ALL_RULES,
+    DonationReuseRule,
+    JitStalenessRule,
+    MonotoneMergeRule,
+    SendUnderLockRule,
+    WireHeaderCompatRule,
+)
+
+FIXTURES = Path(__file__).parent / "fixtures" / "analysis"
+PKG = Path(p2pfl_tpu.__file__).parent
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+def run_fixture(name, rule=None):
+    return analyze([str(FIXTURES / name)], [rule] if rule else ALL_RULES)
+
+
+# ---- per-rule flag / no-flag matrices on the teeth fixtures ----
+
+
+def test_send_under_lock_teeth():
+    bad = run_fixture("send_under_lock_bad.py", SendUnderLockRule)
+    assert len(bad) == 2  # ctx.lock send + status_merge_lock broadcast
+    assert rules_of(bad) == ["send-under-lock"]
+    assert "no lock may be held across a send" in bad[0].message
+    assert run_fixture("send_under_lock_good.py", SendUnderLockRule) == []
+
+
+def test_donation_reuse_teeth():
+    bad = run_fixture("donation_reuse_bad.py", DonationReuseRule)
+    assert rules_of(bad) == ["donation-reuse"]
+    assert any("self.params" in f.message and "spmd_round" in f.message for f in bad)
+    assert run_fixture("donation_reuse_good.py", DonationReuseRule) == []
+
+
+def test_donation_one_statement_rebind_is_clean():
+    # `x = donated_fn(x)` rebinds in the same statement — the canonical
+    # safe shape must not need a pragma (review regression)
+    src = (
+        "import jax\n"
+        "from functools import partial\n\n"
+        "@partial(jax.jit, donate_argnums=(0,))\n"
+        "def step(params, x):\n"
+        "    return params\n\n"
+        "class F:\n"
+        "    def run(self, x):\n"
+        "        self.params = step(self.params, x)\n"
+        "        return self.encode(self.params)\n"
+    )
+    assert analyze([], [DonationReuseRule], sources={"a.py": src}) == []
+
+
+def test_monotone_merge_teeth():
+    bad = run_fixture("monotone_merge_bad.py", MonotoneMergeRule)
+    # coverage overwrite (aliased), nei_status write, async_done add
+    assert len(bad) == 3
+    assert rules_of(bad) == ["monotone-merge"]
+    assert run_fixture("monotone_merge_good.py", MonotoneMergeRule) == []
+
+
+def test_jit_staleness_teeth():
+    bad = run_fixture("jit_staleness_bad.py", JitStalenessRule)
+    assert rules_of(bad) == ["jit-staleness"]
+    msgs = "\n".join(f.message for f in bad)
+    assert "BWD_MODE" in msgs  # mutable global in @jax.jit body
+    assert "Settings.AGG_DTYPE" in msgs  # Settings read in jit
+    assert "float(…)" in msgs  # host sync
+    assert "np.asarray" in msgs  # host materialization
+    # the pallas kernel (reached through kernel = partial(_kernel)) too
+    assert any(f.context == "_kernel" for f in bad)
+    assert run_fixture("jit_staleness_good.py", JitStalenessRule) == []
+
+
+def test_wire_header_compat_teeth():
+    bad = analyze([str(FIXTURES / "wire_bad")], [WireHeaderCompatRule])
+    assert rules_of(bad) == ["wire-header-compat"]
+    msgs = "\n".join(f.message for f in bad)
+    assert "serialized unconditionally" in msgs  # xp without the None guard
+    assert "read with []" in msgs  # d["xp"] decode
+    assert "without copying 'version'" in msgs  # memory ModelUpdate re-wrap
+    assert "without copying 'xp'" in msgs
+    assert "protobuf interop codec" in msgs  # out.vv schema leak
+    assert analyze([str(FIXTURES / "wire_good")], [WireHeaderCompatRule]) == []
+
+
+def test_wire_codec_sets_are_per_directory():
+    # scanning fixtures alongside a real codec must not let one shadow
+    # the other (review regression: basename collisions) — the bad
+    # directory still produces all its findings, the good one none
+    both = analyze(
+        [str(FIXTURES / "wire_good"), str(FIXTURES / "wire_bad")],
+        [WireHeaderCompatRule],
+    )
+    assert both and all("wire_bad" in f.path for f in both)
+    alone = analyze([str(FIXTURES / "wire_bad")], [WireHeaderCompatRule])
+    assert {f.fingerprint for f in both} == {f.fingerprint for f in alone}
+
+
+# ---- teeth against the SHIPPED modules: re-introduce each incident ----
+
+
+def _read(rel):
+    return (PKG / rel).read_text()
+
+
+def test_shipped_spmd_flags_when_rebind_removed():
+    src = _read("parallel/spmd.py")
+    assert analyze([], ALL_RULES, sources={"parallel/spmd.py": src}) == []
+    mutated = src.replace(
+        "        self.params, self.opt_state, loss = result[:3]\n",
+        "        loss = result[2]\n        self._log_norm(self.params)\n",
+        1,
+    )
+    assert mutated != src
+    found = analyze([], [DonationReuseRule], sources={"parallel/spmd.py": mutated})
+    assert any(f.rule == "donation-reuse" and "spmd_round" in f.message for f in found)
+
+
+def test_shipped_flash_attention_flags_bwd_mode_global():
+    src = _read("ops/flash_attention.py")
+    assert analyze([], [JitStalenessRule], sources={"ops/flash_attention.py": src}) == []
+    inject = (
+        "BWD_MODE = 'flash'\n\n\ndef set_bwd(m):\n"
+        "    global BWD_MODE\n    BWD_MODE = m\n\n\ndef _flash_kernel("
+    )
+    mutated = src.replace("def _flash_kernel(", inject, 1)
+    m = re.search(r"def _flash_kernel\(.*?\):\n", mutated, re.S)
+    mutated = mutated[: m.end()] + "    _mode = BWD_MODE\n" + mutated[m.end() :]
+    found = analyze([], [JitStalenessRule], sources={"ops/flash_attention.py": mutated})
+    assert any("BWD_MODE" in f.message and f.context == "_flash_kernel" for f in found)
+
+
+def test_shipped_control_flags_when_merge_lock_removed():
+    # the exact pre-fix shape of ModelInitializedCommand (this PR's triage)
+    src = _read("commands/control.py")
+    assert analyze([], [MonotoneMergeRule], sources={"commands/control.py": src}) == []
+    mutated = src.replace(
+        "        with self._state.status_merge_lock:\n"
+        "            self._state.nei_status.setdefault(source, -1)",
+        "        self._state.nei_status.setdefault(source, -1)",
+        1,
+    )
+    assert mutated != src
+    found = analyze([], [MonotoneMergeRule], sources={"commands/control.py": mutated})
+    assert any(f.rule == "monotone-merge" and "nei_status" in f.message for f in found)
+
+
+def test_shipped_federation_command_flags_send_moved_under_lock():
+    src = _read("commands/federation.py")
+    assert analyze([], [SendUnderLockRule], sources={"commands/federation.py": src}) == []
+    # move AsyncDoneCommand's (hypothetical) ack-send inside the merge lock
+    mutated = src.replace(
+        "        with st.status_merge_lock:\n            st.async_done_peers.add(source)\n",
+        "        with st.status_merge_lock:\n"
+        "            st.async_done_peers.add(source)\n"
+        "            self._node.protocol.broadcast(self._node.protocol.build_msg('ack'))\n",
+        1,
+    )
+    assert mutated != src
+    found = analyze([], [SendUnderLockRule], sources={"commands/federation.py": mutated})
+    assert any(f.rule == "send-under-lock" for f in found)
+
+
+def test_shipped_grpc_transport_flags_unguarded_xp():
+    src = _read("communication/grpc_transport.py")
+    mutated = src.replace(
+        "    if msg.xp is not None:\n"
+        "        # experiment identity (Node.set_start_learning) — optional like\n"
+        "        # \"tc\": old frames decode unchanged, receivers use it to filter\n"
+        "        # cross-experiment stragglers exactly\n"
+        "        d[\"xp\"] = msg.xp\n",
+        "    d[\"xp\"] = msg.xp\n",
+        1,
+    )
+    assert mutated != src
+    found = analyze(
+        [], [WireHeaderCompatRule], sources={"communication/grpc_transport.py": mutated}
+    )
+    assert any("serialized unconditionally" in f.message for f in found)
+
+
+def test_shipped_memory_flags_dropped_version_copy():
+    src = _read("communication/memory.py")
+    mutated = src.replace("                        version=env.update.version,\n", "", 1)
+    assert mutated != src
+    found = analyze([], [WireHeaderCompatRule], sources={"communication/memory.py": mutated})
+    assert any("without copying 'version'" in f.message for f in found)
+
+
+def test_shipped_proto_wire_flags_vv_leak():
+    src = _read("communication/proto_wire.py")
+    mutated = src.replace(
+        "        cmd=env.cmd,\n    ).SerializeToString()",
+        "        cmd=env.cmd,\n        vv=list(env.update.version or ()),\n    ).SerializeToString()",
+        1,
+    )
+    assert mutated != src
+    found = analyze([], [WireHeaderCompatRule], sources={"communication/proto_wire.py": mutated})
+    assert any("protobuf interop codec" in f.message for f in found)
+
+
+# ---- suppression semantics ----
+
+BAD_SEND = """
+class H:
+    def f(self):
+        with self.lock:
+            self.protocol.send(self.peer, self.env){pragma}
+"""
+
+
+def test_inline_suppression_same_line():
+    src = BAD_SEND.format(pragma="  # p2pfl: allow(send-under-lock) — teeth test")
+    assert analyze([], ALL_RULES, sources={"a.py": src}) == []
+
+
+def test_inline_suppression_line_above():
+    src = (
+        "class H:\n"
+        "    def f(self):\n"
+        "        with self.lock:\n"
+        "            # p2pfl: allow(send-under-lock) — justified\n"
+        "            self.protocol.send(self.peer, self.env)\n"
+    )
+    assert analyze([], ALL_RULES, sources={"a.py": src}) == []
+
+
+def test_suppression_is_rule_specific():
+    src = BAD_SEND.format(pragma="  # p2pfl: allow(jit-staleness)")
+    found = analyze([], ALL_RULES, sources={"a.py": src})
+    assert rules_of(found) == ["send-under-lock"]
+
+
+def test_suppression_wildcard():
+    src = BAD_SEND.format(pragma="  # p2pfl: allow(*) — drive harness")
+    assert analyze([], ALL_RULES, sources={"a.py": src}) == []
+
+
+def test_unsuppressed_flags():
+    found = analyze([], ALL_RULES, sources={"a.py": BAD_SEND.format(pragma="")})
+    assert rules_of(found) == ["send-under-lock"]
+
+
+# ---- baseline semantics ----
+
+
+def test_baseline_accepts_old_findings_only(tmp_path):
+    src = BAD_SEND.format(pragma="")
+    found = analyze([], ALL_RULES, sources={"a.py": src})
+    assert len(found) == 1
+    baseline_file = tmp_path / "baseline.json"
+    write_baseline(str(baseline_file), found)
+    baseline = load_baseline(str(baseline_file))
+    assert new_findings(found, baseline) == []
+    # a NEW violation (different function) is not masked by the baseline
+    src2 = src + (
+        "\n"
+        "    def g(self):\n"
+        "        with self.lock:\n"
+        "            self.protocol.broadcast(self.env)\n"
+    )
+    found2 = analyze([], ALL_RULES, sources={"a.py": src2})
+    fresh = new_findings(found2, baseline)
+    assert [f.context for f in fresh] == ["H.g"]
+
+
+def test_fingerprint_survives_line_shifts():
+    src = BAD_SEND.format(pragma="")
+    shifted = "# a new header comment\n\n" + src
+    (f1,) = analyze([], ALL_RULES, sources={"a.py": src})
+    (f2,) = analyze([], ALL_RULES, sources={"a.py": shifted})
+    assert f1.line != f2.line
+    assert f1.fingerprint == f2.fingerprint
+
+
+def test_missing_baseline_is_empty():
+    assert load_baseline("/nonexistent/baseline.json") == {}
+
+
+# ---- CLI ----
+
+
+def test_cli_exit_codes(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(BAD_SEND.format(pragma=""))
+    good = tmp_path / "good.py"
+    good.write_text("def f():\n    return 1\n")
+    assert cli_main([str(good)]) == 0
+    assert cli_main([str(bad)]) == 1
+    assert cli_main([str(tmp_path / "missing.py")]) == 2
+    assert cli_main(["--select", "not-a-rule", str(good)]) == 2
+    # baseline the debt: gate goes green, then a clean tree stays green
+    baseline = tmp_path / "b.json"
+    assert cli_main([str(bad), "--baseline", str(baseline), "--update-baseline"]) == 0
+    assert cli_main([str(bad), "--baseline", str(baseline)]) == 0
+    # a rule-filtered rewrite would drop other rules' accepted entries
+    assert cli_main([str(bad), "--select", "jit-staleness", "--update-baseline"]) == 2
+
+
+def test_cli_list_rules(capsys):
+    assert cli_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in ALL_RULES:
+        assert rule.id in out
+
+
+# ---- the self-run gate: the shipped tree must stay clean ----
+
+
+def test_self_run_is_green():
+    """tier-1 fails if a future PR introduces a violation without a pragma
+    — the same gate CI runs (`python -m p2pfl_tpu.analysis p2pfl_tpu`)."""
+    found = analyze([str(PKG)], ALL_RULES)
+    gating = [f for f in found if f.severity is Severity.ERROR]
+    assert gating == [], "p2pfl-check found new violations:\n" + "\n".join(
+        f.format() for f in gating
+    )
+
+
+# ---- shared finding types: the partition-rule lint speaks them too ----
+
+
+def test_partition_lint_reports_shared_findings():
+    jnp = pytest.importorskip("jax.numpy")
+    from p2pfl_tpu.parallel.sharding import lint_partition_rules
+
+    tree = {"w": jnp.zeros((4, 5)), "odd": jnp.zeros((2, 2))}
+    rules = (
+        (r"w", (None, "model")),
+        (r"typo_never_matches", ("model", None)),
+    )
+    report = lint_partition_rules(rules, tree)
+    findings = report.findings()
+    assert all(isinstance(f, Finding) for f in findings)
+    by_rule = {f.rule for f in findings}
+    assert "partition-unmatched" in by_rule
+    assert "partition-dead-rule" in by_rule
+    # errors property mirrors the error-severity findings verbatim
+    assert report.errors == [f.message for f in findings if f.severity is Severity.ERROR]
+    # one shared one-line format across the lint and the analyzer
+    assert findings[0].format().startswith("partition-rules:0:0: error[partition-")
+
+
+def test_partition_lint_indivisible_is_info():
+    jnp = pytest.importorskip("jax.numpy")
+    import jax
+    from p2pfl_tpu.parallel.mesh import node_slices, submesh_federation_mesh
+    from p2pfl_tpu.parallel.sharding import lint_partition_rules
+
+    mesh = node_slices(submesh_federation_mesh(1, 2, devices=jax.devices()[:2]))[0]
+    tree = {"Dense_0": {"kernel": jnp.zeros((8, 5)), "bias": jnp.zeros((3,))}}
+    rules = ((r"kernel", (None, "model")), (r".*", ()))
+    report = lint_partition_rules(rules, tree, mesh)
+    infos = [f for f in report.findings() if f.severity is Severity.INFO]
+    assert report.ok()  # indivisible is informational, not an error
+    assert infos and all(f.rule == "partition-indivisible" for f in infos)
+
+
+# ---- regression for this PR's triage fix (commands/control.py) ----
+
+
+def test_model_initialized_merge_holds_lock_and_keeps_semantics():
+    from p2pfl_tpu.commands.control import ModelInitializedCommand, ModelsReadyCommand
+    from p2pfl_tpu.node_state import NodeState
+
+    st = NodeState("me")
+    st.round = 0
+    ModelInitializedCommand(st).execute("peer", -1)
+    assert st.nei_status == {"peer": -1}
+    # monotone: a later round report wins, a stale re-init cannot regress it
+    ModelsReadyCommand(st).execute("peer", 0)
+    assert st.nei_status == {"peer": 0}
+    ModelInitializedCommand(st).execute("peer", -1)
+    assert st.nei_status == {"peer": 0}
+    # the merge must run under the shared lock (the monotone-merge rule
+    # pins the source shape; this pins the runtime behavior: holding the
+    # lock elsewhere must not deadlock the handler — i.e. it really uses
+    # status_merge_lock, briefly and reentrantly-safely)
+    import threading
+
+    done = threading.Event()
+
+    def blocked_merge():
+        ModelInitializedCommand(st).execute("other", -1)
+        done.set()
+
+    with st.status_merge_lock:
+        t = threading.Thread(target=blocked_merge, daemon=True)
+        t.start()
+        assert not done.wait(0.2)  # handler waits for the lock → it takes it
+    assert done.wait(2.0)
+    assert st.nei_status["other"] == -1
+
+
+# ---- wire registry sanity ----
+
+
+def test_wire_header_registry_is_consistent():
+    from p2pfl_tpu.communication.wire_headers import OPTIONAL_WIRE_HEADERS
+
+    keys = [h.key for h in OPTIONAL_WIRE_HEADERS]
+    assert len(keys) == len(set(keys))
+    for h in OPTIONAL_WIRE_HEADERS:
+        assert h.planes and set(h.planes) <= {"message", "weights"}
+        assert h.doc
+        for ctor, kwarg in h.memory_copies:
+            assert ctor in {"ModelUpdate", "WeightsEnvelope"} and kwarg
