@@ -206,13 +206,21 @@ def run_federation(
     cache: bool = True,
     send_timeout: float = 0.5,
     train_set_size: int = 0,
+    weights_plane: str = "bytes",
 ) -> dict:
     """One timed federation run on the in-memory byte path.
 
     Returns round wall-clock plus encode/cache/send accounting. epochs=0
     keeps device compute out of the measurement — what remains IS the
     gossip data plane (init push, partial gossip, diffusion).
+
+    ``weights_plane="ici"`` re-routes model payloads through the
+    shard-native ICI plane (``communication/ici.py`` — the ppermute
+    fallback on this CPU bench): the byte path below stays armed as the
+    per-peer fallback, so the row's host-byte counters measure what the
+    plane actually kept off the host.
     """
+    from p2pfl_tpu.communication import ici
     from p2pfl_tpu.communication.memory import MemoryRegistry
     from p2pfl_tpu.learning import weights as W
     from p2pfl_tpu.learning.dataset import FederatedDataset
@@ -225,9 +233,13 @@ def run_federation(
     set_test_settings()
     logger.set_level("ERROR")
     Settings.MEMORY_WIRE_CODEC = True
+    Settings.WEIGHTS_PLANE = weights_plane
     Settings.GOSSIP_SEND_WORKERS = workers
     Settings.GOSSIP_PAYLOAD_CACHE = cache
     Settings.GOSSIP_SEND_TIMEOUT = send_timeout
+    ici.ShardPlaneRegistry.reset()
+    ici.reset_ici_stats()
+    W.reset_wire_stats()
     if train_set_size:
         # slow-peer configs elect EVERYONE so the stalled node is a
         # train-set member being gossiped partials every tick — the
@@ -285,6 +297,8 @@ def run_federation(
         def total(metric):
             return int(sum(m.get(metric, 0) for m in comm.values()))
 
+        wire = W.wire_stats()
+        ici_stats = ici.ici_stats()
         return {
             "n_nodes": n_nodes,
             "rounds": rounds,
@@ -293,6 +307,7 @@ def run_federation(
             "cache": cache,
             "send_timeout_s": send_timeout,
             "slow_peer_delay_s": slow_peer_delay,
+            "weights_plane": weights_plane,
             "round_wall_s": round(wall_s / rounds, 3),
             "total_wall_s": round(wall_s, 3),
             "encode_calls": encodes,
@@ -302,12 +317,25 @@ def run_federation(
             "sends_ok": total("gossip_send_ok"),
             "send_timeouts": total("gossip_send_timeout"),
             "inflight_skips": total("gossip_send_inflight_skip"),
+            # bytes-over-host (the ICI row's headline): payload bytes the
+            # encode pipeline materialized + D2H it pulled, plus the
+            # shard plane's own accounting and the receiver-side D2D
+            # fix-up copies FedAvg counted (ICI contract: zero)
+            "host_payload_bytes": wire["payload_bytes"],
+            "host_d2h_bytes": wire["d2h_bytes"],
+            "ici_shard_sends": ici_stats["shard_sends"],
+            "ici_bytes_moved": ici_stats["bytes_moved"],
+            "ici_fallback_bytes": ici_stats["fallback_bytes"],
+            "ici_align_violations": ici_stats["align_violations"],
+            "tree_align_copies": total("tree_align_copies"),
         }
     finally:
         for node in nodes:
             node.stop()
         MemoryRegistry.reset()
+        ici.ShardPlaneRegistry.reset()
         Settings.MEMORY_WIRE_CODEC = False
+        Settings.WEIGHTS_PLANE = "bytes"
         Settings.GOSSIP_PAYLOAD_CACHE = True
         Settings.GOSSIP_SEND_WORKERS = 4
 
@@ -348,8 +376,28 @@ def main() -> int:
         assert tk_dev["d2h_bytes_per_encode"] < tk_dev["payload_bytes"] * 3, (
             "device topk8 D2H should be on the order of the payload, not the model"
         )
+        # ICI weights plane: same fleet, model payloads shard-to-shard —
+        # the parity + zero-D2H smoke (the ppermute fallback on CI's CPU)
+        ici_fed = run_federation(n_nodes=3, rounds=1, weights_plane="ici")
+        results["ici_federation"] = ici_fed
+        assert ici_fed["ici_shard_sends"] > 0, "ICI plane never carried a payload"
+        assert ici_fed["ici_fallback_bytes"] == 0, (
+            f"{ici_fed['ici_fallback_bytes']} co-located sends fell back to bytes"
+        )
+        assert ici_fed["host_payload_bytes"] == 0 and ici_fed["host_d2h_bytes"] == 0, (
+            "ICI round materialized model bytes host-side "
+            f"(payload={ici_fed['host_payload_bytes']}, d2h={ici_fed['host_d2h_bytes']})"
+            " — the zero-host-bytes contract broke"
+        )
+        assert ici_fed["encode_calls"] == 0, (
+            f"{ici_fed['encode_calls']} byte encodes ran under WEIGHTS_PLANE=ici"
+        )
+        assert ici_fed["ici_align_violations"] == 0 and ici_fed["tree_align_copies"] == 0, (
+            "ICI deliveries needed device fix-up copies — the no-realign "
+            "contract broke"
+        )
         print(json.dumps(results, indent=2))
-        print("SMOKE OK: encode-once + device-codec invariants hold")
+        print("SMOKE OK: encode-once + device-codec + ICI zero-D2H invariants hold")
         return 0
 
     results["codec"] = bench_codec()
@@ -371,6 +419,25 @@ def main() -> int:
     results["round_speedup_with_slow_peer"] = round(
         seq["round_wall_s"] / max(conc["round_wall_s"], 1e-9), 2
     )
+    # ICI weights plane vs the memory byte path: same fleet, same rounds —
+    # bytes-over-host and s/round are the row's two claims (on this CPU
+    # anchor "ICI" is the ppermute fallback over virtual devices, so the
+    # wall-clock is structural, not an interconnect measurement)
+    mem_row = run_federation(n_nodes=4, rounds=2)
+    ici_row = run_federation(n_nodes=4, rounds=2, weights_plane="ici")
+    results["ici"] = {
+        "memory_byte_path": mem_row,
+        "ici_plane": ici_row,
+        "host_payload_bytes": {
+            "memory": mem_row["host_payload_bytes"],
+            "ici": ici_row["host_payload_bytes"],
+        },
+        "s_per_round": {
+            "memory": mem_row["round_wall_s"],
+            "ici": ici_row["round_wall_s"],
+        },
+        "backend": "ppermute-fallback (CPU virtual devices)",
+    }
     with open(args.out, "w") as f:
         json.dump(results, f, indent=2)
     print(json.dumps(results, indent=2))

@@ -1,13 +1,16 @@
 """Built-in rule registry: one module per incident family.
 
-Each rule is distilled from a real bug this repo shipped and fixed; the
-rule docstrings name the incident, and ``tests/test_analysis.py`` pins
-both directions (the historical bug shape flags, the shipped fix shape
-passes). Order here is the report order for ``--list-rules``.
+Each rule is distilled from a real bug this repo shipped and fixed (or,
+for ``no-host-gather``, a contract a new subsystem must keep rather than
+a bug to remember); the rule docstrings name the incident, and
+``tests/test_analysis.py`` pins both directions (the historical bug shape
+flags, the shipped fix shape passes). Order here is the report order for
+``--list-rules``.
 """
 
 from p2pfl_tpu.analysis.rules.concurrency import SendUnderLockRule
 from p2pfl_tpu.analysis.rules.donation import DonationReuseRule
+from p2pfl_tpu.analysis.rules.hostgather import NoHostGatherRule
 from p2pfl_tpu.analysis.rules.jit import JitStalenessRule
 from p2pfl_tpu.analysis.rules.merge import MonotoneMergeRule
 from p2pfl_tpu.analysis.rules.wire import WireHeaderCompatRule
@@ -18,6 +21,7 @@ ALL_RULES = (
     MonotoneMergeRule,
     WireHeaderCompatRule,
     JitStalenessRule,
+    NoHostGatherRule,
 )
 
 __all__ = [
@@ -25,6 +29,7 @@ __all__ = [
     "DonationReuseRule",
     "JitStalenessRule",
     "MonotoneMergeRule",
+    "NoHostGatherRule",
     "SendUnderLockRule",
     "WireHeaderCompatRule",
 ]

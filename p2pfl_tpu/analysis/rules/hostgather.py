@@ -1,0 +1,99 @@
+"""no-host-gather: the ICI weights-plane modules never touch the host.
+
+Incident class being prevented (rather than remembered): the shard-native
+weights plane (``communication/ici.py`` + ``parallel/ici_plane.py``)
+exists for exactly one promise — model diffusion with ZERO payload bytes
+crossing device→host. The promise is fragile in a way prose cannot
+defend: one innocent ``np.asarray(leaf)`` for a shape check, one
+``.tobytes()`` for a digest, one ``jax.device_get`` in a debug branch,
+and the plane silently becomes a slower byte path while every counter
+still reads "ici". PR 4 already paid this tuition on the encode side (the
+host producer's full-model D2H pull hid on the critical path of every
+gossip send for three PRs).
+
+The rule is scope-targeted, not call-targeted: *inside the ICI modules*
+(recognized by basename, like the wire codec set) any host
+materialization is an error —
+
+- ``jax.device_get`` / ``np.asarray`` / ``np.array`` /
+  ``np.frombuffer`` (full-gather / host copies of device values),
+- ``.item()`` (scalar host sync),
+- ``.tobytes()`` (byte materialization — the exact call that would
+  sneak the byte codec back into the plane).
+
+Device-side mechanics stay allowed: ``make_array_from_single_device_arrays``,
+``addressable_shards`` / per-shard ``reshape`` (zero-copy metadata
+assembly), ``device_put`` (D2D), ``jnp.zeros`` filler uploads (H2D,
+never payload D2H). Everywhere OUTSIDE these modules the rule is silent
+— the byte transports legitimately materialize payloads.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from p2pfl_tpu.analysis.engine import Rule, SourceModule, dotted_name, node_pos
+from p2pfl_tpu.analysis.findings import Finding
+
+#: the weights-plane modules, recognized by basename (teeth fixtures can
+#: replicate the shape in a scanned directory, like the wire codec set)
+ICI_BASENAMES = ("ici.py", "ici_plane.py")
+
+_HOST_CALLS = {
+    "jax.device_get",
+    "np.asarray",
+    "np.array",
+    "numpy.asarray",
+    "numpy.array",
+    "np.frombuffer",
+    "numpy.frombuffer",
+}
+_HOST_ATTR_CALLS = {"item", "tobytes"}
+
+
+class NoHostGatherRule(Rule):
+    id = "no-host-gather"
+    summary = "ICI weights-plane modules must not materialize bytes host-side"
+
+    def check_module(self, mod: SourceModule) -> Iterable[Finding]:
+        if mod.basename not in ICI_BASENAMES:
+            return ()
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name in _HOST_CALLS:
+                out.append(
+                    self._finding(
+                        mod,
+                        node,
+                        f"{name}(…) inside the ICI weights plane — host "
+                        "materialization of (potentially) device-resident "
+                        "payload data breaks the zero-host-bytes contract; "
+                        "keep the value a jax.Array or move the code out "
+                        "of the plane modules",
+                    )
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _HOST_ATTR_CALLS
+                and not node.args
+            ):
+                out.append(
+                    self._finding(
+                        mod,
+                        node,
+                        f".{node.func.attr}() inside the ICI weights plane — "
+                        "host sync/byte materialization breaks the "
+                        "zero-host-bytes contract",
+                    )
+                )
+        return out
+
+    def _finding(self, mod: SourceModule, node: ast.AST, msg: str) -> Finding:
+        line, col = node_pos(node)
+        return Finding(
+            rule=self.id, path=mod.path, line=line, col=col, message=msg
+        )

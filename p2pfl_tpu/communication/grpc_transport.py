@@ -121,8 +121,19 @@ def encode_weights(env: WeightsEnvelope) -> bytes:
         # experiment identity — optional like "tc"/"vv"; rides BOTH the
         # envelope and the decoded update so stash filters see it
         d["xp"] = xp
+    if env.update.sp is not None:
+        # shard-plane handshake triple (slice_shape, slice_index, codec)
+        # — optional like "vv": a byte-path frame advertising the
+        # sender's slice topology so receivers can validate co-location
+        # for the ICI weights plane (communication/ici.py)
+        d["sp"] = [list(env.update.sp[0]), env.update.sp[1], env.update.sp[2]]
     header = json.dumps(d).encode()
     return b"".join((len(header).to_bytes(4, "little"), header, env.update.encode()))
+
+
+def _sp_header(d: dict):
+    sp = d.get("sp")
+    return (tuple(sp[0]), int(sp[1]), str(sp[2])) if sp else None
 
 
 def decode_weights(data: bytes) -> WeightsEnvelope:
@@ -136,6 +147,7 @@ def decode_weights(data: bytes) -> WeightsEnvelope:
         encoded=data[4 + hlen :],
         version=(str(vv[0]), int(vv[1]), int(vv[2])) if vv else None,
         xp=d.get("xp"),
+        sp=_sp_header(d),
     )
     return WeightsEnvelope(
         d["src"], d["round"], d["cmd"], update, d["id"], trace_ctx=_trace_ctx(d),
@@ -275,6 +287,18 @@ class GrpcProtocol(CommunicationProtocol):
         try:
             kind = "weights" if isinstance(env, WeightsEnvelope) else "control"
             if kind == "weights":
+                # shard-native weights plane: two gRPC nodes hosted in ONE
+                # process on one fabric can move model payloads device-to-
+                # device (communication/ici.py) while control keeps riding
+                # the socket; sits inside the transport send so the fault
+                # injector/spans at the _do_send seam wrap it unchanged.
+                # Cross-process peers are never on the shard registry and
+                # fall through to the wire below.
+                from p2pfl_tpu.communication.ici import try_shard_send
+
+                handled = try_shard_send(self, nei, env)
+                if handled is not None:
+                    return handled
                 payload = _enc_weights(env)
                 resp = channel.unary_unary(_svc() + "send_weights")(
                     payload, timeout=Settings.GRPC_TIMEOUT

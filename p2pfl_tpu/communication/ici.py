@@ -1,0 +1,575 @@
+"""The shard-native ICI weights plane: model diffusion never touches the host.
+
+``Settings.WEIGHTS_PLANE = "ici"`` re-routes MODEL payloads between
+co-located nodes (nodes in one process whose learners live on slices of
+one accelerator fabric) through a device-to-device shard transfer
+(:mod:`p2pfl_tpu.parallel.ici_plane`) instead of the byte codec: each
+device copies its parameter block directly to the matching device of the
+peer's slice — a ``lax.ppermute`` collective everywhere, a Pallas remote
+DMA on TPU — composing with the shard-resident top-k/int8 codec
+(:mod:`p2pfl_tpu.ops.compression`) so the encode→transfer→decode→merge
+chain is end to end on device and ZERO model-plane bytes cross D2H.
+
+What deliberately does NOT change:
+
+- **The control plane.** Votes, coverage announcements, beats, TTL floods
+  keep riding the existing transport untouched — the ICI plane carries
+  only :class:`~p2pfl_tpu.communication.message.WeightsEnvelope` payloads.
+- **The ``_do_send`` seam.** The plane plugs in INSIDE the transport's
+  ``_send_to_neighbor``, i.e. *behind* the protocol's send span and the
+  fault-injection continuation — FaultPlan drop/delay/duplicate/partition
+  verdicts, circuit-breaker feeds, retries and telemetry spans wrap an
+  ICI transfer exactly as they wrap a byte send. A chaos plan cannot tell
+  the difference; that is the point.
+- **Failure semantics.** A peer that is not eligible — unregistered,
+  another process, mismatched architecture or slice topology, anchor from
+  a different round — falls back LOUDLY to the byte path *for that peer
+  only* (``ici_fallback_bytes`` metric, one log line per (peer, reason)),
+  never aborting the round. A dead peer fails the send exactly like the
+  byte path would, so eviction and repair machinery see the same signals.
+
+Delivery places the payload under the RECEIVER's own shardings before
+handing it to ``handle_weights``, so
+:func:`~p2pfl_tpu.ops.tree.tree_align_devices` is an asserted no-op
+downstream: the plane checks the align copy counter after every transfer
+and self-heals (with a loud ``ici_align_violation`` metric) if a leaf
+ever lands misplaced.
+
+This module is inside the ``no-host-gather`` analyzer scope
+(:mod:`p2pfl_tpu.analysis`): no ``np.asarray``/``jax.device_get``/
+``.tobytes()`` may appear here — the zero-host-bytes contract is
+statically enforced.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from p2pfl_tpu.communication.message import WeightsEnvelope
+from p2pfl_tpu.learning.weights import ModelUpdate, named_leaves
+from p2pfl_tpu.management.logger import logger
+from p2pfl_tpu.management.telemetry import telemetry
+from p2pfl_tpu.parallel.ici_plane import (
+    SliceInfo,
+    conform_specs,
+    replicate_on_slice,
+    same_devices,
+    shard_transfer,
+    slice_info_of,
+    tree_device_bytes,
+)
+
+Pytree = Any
+
+# ---- process-wide accounting (bench/tests read these) ----
+
+_stats_lock = threading.Lock()
+_stats = {
+    "shard_sends": 0,       # payloads delivered over the ICI plane
+    "bytes_moved": 0,       # device bytes that crossed the interconnect
+                            # (co-resident zero-copy handoffs count 0)
+    "fallback_bytes": 0,    # sends that fell back to the byte path
+    "align_violations": 0,  # delivered leaves that needed re-placement
+    #: source-side re-layouts (device_put within the sender's slice)
+    #: before a transfer — a producing program (aggregation fold) left a
+    #: leaf in a different layout than the receiver's placement; still
+    #: all device-to-device, never host
+    "conform_copies": 0,
+}
+
+
+def ici_stats() -> dict:
+    with _stats_lock:
+        return dict(_stats)
+
+
+def reset_ici_stats() -> None:
+    with _stats_lock:
+        for k in _stats:
+            _stats[k] = 0
+
+
+def _count(key: str, n: int = 1) -> None:
+    with _stats_lock:
+        _stats[key] += n
+
+
+class IciEndpoint:
+    """One node's presence on the shard plane.
+
+    Holds a weak reference to the node (the registry must never keep a
+    stopped node alive), the node's slot on the global mesh's nodes axis
+    when known (``slice_index`` — rides the ``sp`` handshake), and a
+    cache of receiver-side zero filler buffers for codec payloads (the
+    pair-transfer needs structurally-matching blocks on the destination
+    slice; zeros are uploaded once per payload shape, then reused every
+    round).
+    """
+
+    def __init__(self, node, slice_index: int = -1) -> None:
+        self._node_ref = weakref.ref(node)
+        self.slice_index = slice_index
+        self._filler_lock = threading.Lock()
+        self._fillers: dict = {}
+
+    def node(self):
+        return self._node_ref()
+
+    @property
+    def learner(self):
+        node = self.node()
+        return None if node is None else node.learner
+
+    def slice_info(self) -> Optional[SliceInfo]:
+        learner = self.learner
+        if learner is None:
+            return None
+        try:
+            return slice_info_of(learner.get_parameters())
+        except Exception:  # noqa: BLE001 — learner mid-teardown
+            return None
+
+    def handshake(self, codec: str) -> Optional[Tuple]:
+        """The ``sp`` wire-header triple (slice_shape, slice_index, codec)."""
+        info = self.slice_info()
+        if info is None:
+            return None
+        return (info.shape, self.slice_index, codec)
+
+    def filler(self, name: str, leaf, info: SliceInfo):
+        """A zero buffer shaped like ``leaf``, resident replicated on this
+        endpoint's slice — cached per (name, shape, dtype, slice)."""
+        key = (
+            name,
+            tuple(leaf.shape),
+            str(leaf.dtype),
+            tuple(sorted(info.device_ids)),
+        )
+        with self._filler_lock:
+            buf = self._fillers.get(key)
+        if buf is not None:
+            return buf
+        buf = jax.device_put(
+            jnp.zeros(tuple(leaf.shape), leaf.dtype), NamedSharding(info.mesh, P())
+        )
+        with self._filler_lock:
+            self._fillers[key] = buf
+        return buf
+
+
+class ShardPlaneRegistry:
+    """Process-global address → :class:`IciEndpoint` map.
+
+    The shard plane is in-process by construction (live ``jax.Array``
+    shards cannot cross process boundaries); a peer absent from this
+    registry is simply not co-located and its sends ride the byte path.
+    """
+
+    _lock = threading.Lock()
+    _endpoints: dict[str, IciEndpoint] = {}
+    #: (src, dst, reason) triples already logged — fallback is per-send
+    #: metric-counted but only narrated once per edge per reason
+    _warned: set = set()
+
+    @classmethod
+    def register(cls, addr: str, endpoint: IciEndpoint) -> None:
+        with cls._lock:
+            cls._endpoints[addr] = endpoint
+
+    @classmethod
+    def unregister(cls, addr: str) -> None:
+        with cls._lock:
+            cls._endpoints.pop(addr, None)
+
+    @classmethod
+    def get(cls, addr: str) -> Optional[IciEndpoint]:
+        with cls._lock:
+            return cls._endpoints.get(addr)
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls._lock:
+            cls._endpoints.clear()
+            cls._warned.clear()
+
+    @classmethod
+    def warn_once(cls, src: str, dst: str, reason: str) -> bool:
+        key = (src, dst, reason)
+        with cls._lock:
+            if key in cls._warned:
+                return False
+            cls._warned.add(key)
+            return True
+
+
+def stamp_handshake(addr: str, update: ModelUpdate) -> None:
+    """Stamp the optional ``sp`` wire header on an outgoing update.
+
+    Called by ``protocol.build_weights`` when the ICI plane is on: even
+    frames that end up on the BYTE path (non-colocated peers) advertise
+    the sender's slice topology, which is what lets a mixed fleet
+    diagnose per-peer plane selection from the wire alone.
+    """
+    from p2pfl_tpu.settings import Settings
+
+    if Settings.WEIGHTS_PLANE != "ici" or update.sp is not None:
+        return
+    ep = ShardPlaneRegistry.get(addr)
+    if ep is None:
+        return
+    update.sp = ep.handshake(Settings.WIRE_COMPRESSION)
+
+
+def _fallback(src: str, nei: str, reason: str) -> None:
+    """Per-peer loud degradation to the byte path (never aborts)."""
+    _count("fallback_bytes")
+    logger.log_comm_metric(src, "ici_fallback_bytes")
+    if ShardPlaneRegistry.warn_once(src, nei, reason):
+        logger.info(
+            src,
+            f"ICI weights plane ineligible for {nei} ({reason}) — "
+            "falling back to the byte path for this peer",
+        )
+    telemetry.event(
+        src, "ici_fallback", kind="gossip", attrs={"peer": nei, "reason": reason}
+    )
+
+
+def _leaf_meta_matches(a: Pytree, b: Pytree) -> bool:
+    return all(
+        tuple(x.shape) == tuple(y.shape) and x.dtype == y.dtype
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+def _named_dict(tree: Pytree) -> dict:
+    """Canonical path → leaf, leaves kept device-resident."""
+    return dict(named_leaves(tree)[1])
+
+
+def _restore_named(template: Pytree, flat: dict) -> Pytree:
+    """Rebuild ``template``'s structure from a path → device-leaf dict
+    (the shard plane's host-free twin of ``weights.restore_like`` — no
+    casts, no host materialization; shapes/dtypes were checked upfront)."""
+    from p2pfl_tpu.learning.weights import _SEP, _path_part
+
+    leaves_with_path, treedef = jax.tree_util.tree_flatten_with_path(template)
+    new_leaves = []
+    for path, _leaf in leaves_with_path:
+        key = _SEP.join(_path_part(p) for p in path)
+        new_leaves.append(flat[key])
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
+def _move_codec(
+    update: ModelUpdate,
+    src_params: Pytree,
+    template: Pytree,
+    src_info: SliceInfo,
+    dst_info: SliceInfo,
+    dst_ep: IciEndpoint,
+    dst_learner,
+    mode: str,
+    backend: str,
+) -> Optional[Tuple[Pytree, int]]:
+    """topk8/int8 composition: device encode → shard transfer → device
+    decode against the receiver's anchor. Returns ``(params, bytes)`` or
+    ``None`` when this peer must fall back (anchor round mismatch — the
+    byte path then reproduces the exact AnchorMismatch skip semantics)."""
+    from p2pfl_tpu.ops.compression import (
+        build_topk_plan,
+        decode_shard_device,
+        encode_shard_device,
+    )
+    from p2pfl_tpu.settings import Settings
+
+    named = _named_dict(src_params)
+    anchor_named = _named_dict(update.anchor) if update.anchor is not None else None
+    topk_frac = Settings.TOPK_FRACTION if mode == "topk8" else 0.0
+    topk_plan = build_topk_plan(named, anchor_named, topk_frac)
+    if topk_plan:
+        # delta segments reconstruct against the RECEIVER's anchor — both
+        # ends must hold the same round's (anchor divergence is part of
+        # the codec's loss budget, exactly like the byte decoder)
+        dst_anchor = getattr(dst_learner, "_wire_anchor", None)
+        dst_tag = getattr(dst_learner, "_wire_anchor_tag", None)
+        if dst_anchor is None or dst_tag != update.anchor_tag:
+            return None
+        dst_anchor_named = _named_dict(dst_anchor)
+    else:
+        dst_anchor_named = None
+
+    # encode ONCE per payload content: repeat sends of the same update
+    # (many candidates, many ticks) reuse the device buffers, and the
+    # error-feedback residual folds exactly once PER CONTENT ACROSS
+    # PLANES — the ICI and byte encodes cache under different keys, so
+    # fold ownership is coordinated through PayloadCache.ef_fold_once
+    # (whichever plane encodes first folds; the other goes residual-free
+    # instead of re-applying the just-written carry)
+    with update._encode_lock:
+        cache = update.payload_cache
+        # same knob as the byte path: GOSSIP_PAYLOAD_CACHE=False means
+        # every send re-encodes (the benchable baseline), on BOTH planes
+        use_cache = Settings.GOSSIP_PAYLOAD_CACHE
+        key = None
+        cached = None
+        if use_cache and cache is not None and update.cache_version is not None:
+            key = (
+                "ici",
+                update.cache_version,
+                update.cache_round,
+                mode,
+                update.anchor_tag,
+                update.ef_residual is not None,
+            )
+            cached = cache.get(key)
+        elif use_cache:
+            cached = getattr(update, "_ici_payload", None)
+        if cached is not None:
+            tk_spec, dense_spec, payload = cached
+        else:
+            residual = update.ef_residual
+            if residual is not None and cache is not None and update.cache_version is not None:
+                # cross-plane fold ownership — ONE key builder, shared
+                # with the byte encoder (ModelUpdate.ef_fold_key)
+                if not cache.ef_fold_once(update.ef_fold_key(mode)):
+                    residual = None
+            tk_spec, dense_spec, payload = encode_shard_device(
+                named,
+                anchor_named,
+                topk_plan,
+                residual,
+                # optimization_barrier under the SPMD partitioner is a
+                # single-device-only workaround (see _encode_jit)
+                barrier=len(src_info.device_ids) == 1,
+            )
+            # deterministic transfer layout: buffers replicated over the
+            # sender's slice (D2D within the slice, nothing host-side)
+            payload = replicate_on_slice(payload, src_info)
+            if key is not None:
+                cache.put(key, (tk_spec, dense_spec, payload))
+            elif use_cache:
+                update._ici_payload = (tk_spec, dense_spec, payload)
+
+    spec_keys = [k for k, _leaf in named_leaves(src_params)[1]]
+    src_spec_by_key = dict(zip(spec_keys, src_info.specs))
+    coded = {k for k, _s, _b in tk_spec} | {k for k, _s in dense_spec}
+    raw_keys = [k for k in sorted(named) if k not in coded]
+    template_named = _named_dict(template)
+
+    # one combined transfer tree: codec buffers (replicated) + raw
+    # passthrough leaves (their own specs) move in ONE dispatch
+    transfer_tree: dict = {f"c/{k}": v for k, v in payload.items()}
+    filler: dict = {
+        f"c/{k}": dst_ep.filler(k, v, dst_info) for k, v in payload.items()
+    }
+    for k in raw_keys:
+        transfer_tree[f"r/{k}"] = named[k]
+        filler[f"r/{k}"] = template_named[k]
+    spec_of = {
+        **{f"c/{k}": P() for k in payload},
+        **{f"r/{k}": src_spec_by_key[k] for k in raw_keys},
+    }
+    ordered_specs = tuple(spec_of[k] for k in sorted(transfer_tree))
+    if same_devices(src_info, dst_info):
+        # co-resident: the buffers are already on the receiver's devices
+        # — zero interconnect bytes, honestly counted as such
+        moved = 0
+        landed = transfer_tree
+    else:
+        moved = tree_device_bytes(transfer_tree)
+        landed = shard_transfer(
+            transfer_tree,
+            filler,
+            SliceInfo(src_info.mesh, ordered_specs),
+            SliceInfo(dst_info.mesh, ordered_specs),
+            backend,
+        )
+    payload_dst = {k[2:]: v for k, v in landed.items() if k.startswith("c/")}
+    out_named = decode_shard_device(
+        payload_dst, tk_spec, dense_spec, dst_anchor_named, template_named
+    )
+    for k in raw_keys:
+        out_named[k] = landed[f"r/{k}"]
+    restored = _restore_named(template, out_named)
+    # the decode jit's output layout is XLA-chosen: on a multi-device
+    # slice it can differ from the receiver's placement — normalize HERE
+    # (device_put within the receiver's slice, counted as conform, never
+    # host) so delivery always lands receiver-ready
+    from p2pfl_tpu.ops.tree import tree_align_copy_count, tree_align_devices
+
+    before = tree_align_copy_count()
+    restored = tree_align_devices(restored, template)
+    moved_leaves = tree_align_copy_count() - before
+    if moved_leaves:
+        _count("conform_copies", moved_leaves)
+    return restored, moved
+
+
+def try_shard_send(proto, nei: str, env) -> Optional[bool]:
+    """Attempt an ICI shard delivery for one outgoing envelope.
+
+    Returns ``True``/``False`` when the plane handled the send (the
+    transport's byte path must NOT run), or ``None`` when this envelope/
+    peer is not eligible and the caller should proceed down its normal
+    byte path. Called from inside ``_send_to_neighbor`` so every wrapper
+    at the ``_do_send`` seam (fault injector, send spans, breaker feeds,
+    retries) applies unchanged.
+    """
+    from p2pfl_tpu.settings import Settings, ici_backend
+
+    if Settings.WEIGHTS_PLANE != "ici" or not isinstance(env, WeightsEnvelope):
+        return None
+    update = env.update
+    if update.params is None:
+        return None  # pre-encoded frame (relay) — bytes it is
+    src = proto.get_address()
+    src_ep = ShardPlaneRegistry.get(src)
+    dst_ep = ShardPlaneRegistry.get(nei)
+    if src_ep is None or dst_ep is None:
+        _fallback(src, nei, "peer_not_on_shard_plane")
+        return None
+    dst_node = dst_ep.node()
+    if dst_node is None or not getattr(dst_node, "_running", False):
+        # dead peer: let the byte path fail the send so breakers/eviction
+        # see exactly the signals they are built for
+        return None
+    dst_learner = dst_ep.learner
+    if dst_learner is None:
+        _fallback(src, nei, "peer_has_no_learner")
+        return None
+    try:
+        template = dst_learner.get_parameters()
+    except Exception:  # noqa: BLE001 — learner mid-teardown
+        return None
+    if jax.tree.structure(template) != jax.tree.structure(update.params):
+        _fallback(src, nei, "architecture_mismatch")
+        return None
+    if not _leaf_meta_matches(update.params, template):
+        _fallback(src, nei, "shape_dtype_mismatch")
+        return None
+    src_info = slice_info_of(update.params)
+    dst_info = slice_info_of(template)
+    if src_info is None or dst_info is None:
+        _fallback(src, nei, "params_not_device_resident")
+        return None
+    if (
+        src_info.shape != dst_info.shape
+        or src_info.mesh.axis_names != dst_info.mesh.axis_names
+    ):
+        _fallback(src, nei, "slice_topology_mismatch")
+        return None
+    co_resident = src_info.device_ids == dst_info.device_ids
+    if not co_resident and (src_info.device_ids & dst_info.device_ids):
+        _fallback(src, nei, "slices_overlap")
+        return None
+
+    src_params = update.params
+    if src_info.specs != dst_info.specs:
+        # the producing program (an aggregation fold's XLA-chosen output
+        # layout) left leaves laid out differently than the receiver's
+        # placement: conform at the SOURCE — device_put within the
+        # sender's own devices, still zero host — so the transfer lands
+        # every block exactly where the receiver's jits expect it.
+        # Cached per update instance: repeat sends of one payload (many
+        # candidates, many ticks) re-lay out once.
+        with update._encode_lock:
+            cached = getattr(update, "_ici_conformed", None)
+            if cached is not None and cached[0] == dst_info.specs:
+                src_params = cached[1]
+            else:
+                target_mesh = dst_info.mesh if co_resident else src_info.mesh
+                src_params, n_moved = conform_specs(
+                    update.params, target_mesh, dst_info.specs
+                )
+                update._ici_conformed = (dst_info.specs, src_params)
+                if n_moved:
+                    _count("conform_copies", n_moved)
+                    logger.log_comm_metric(src, "ici_conform_copies", n_moved)
+        src_info = SliceInfo(
+            dst_info.mesh if co_resident else src_info.mesh, dst_info.specs
+        )
+
+    mode = Settings.WIRE_COMPRESSION
+    backend = ici_backend()
+    try:
+        if mode in ("int8", "topk8"):
+            out = _move_codec(
+                update, src_params, template, src_info, dst_info, dst_ep,
+                dst_learner, mode, backend,
+            )
+            if out is None:
+                _fallback(src, nei, "anchor_round_mismatch")
+                return None
+            params, moved = out
+        else:
+            if co_resident:
+                # co-resident slices: the shards are already exactly where
+                # the receiver wants them — a zero-copy handoff (the same
+                # read-only contract as the in-memory reference path), so
+                # zero interconnect bytes are counted
+                moved = 0
+                params = src_params
+            else:
+                moved = tree_device_bytes(src_params)
+                params = shard_transfer(
+                    src_params, template, src_info, dst_info, backend
+                )
+    except Exception as exc:  # noqa: BLE001 — a failed transfer is a failed send
+        logger.error(src, f"ICI shard transfer to {nei} failed: {exc!r}")
+        return False
+
+    delivered = ModelUpdate(
+        params,
+        list(update.contributors),
+        update.num_samples,
+        version=update.version,
+        xp=update.xp or env.xp,
+        sp=src_ep.handshake(mode),
+    )
+    # the receiver re-encodes relays/diffusions against ITS OWN anchor,
+    # exactly like the byte path's materialize()
+    delivered.anchor = getattr(dst_learner, "_wire_anchor", None)
+    delivered.anchor_tag = getattr(dst_learner, "_wire_anchor_tag", None)
+
+    # the no-fix-up contract, asserted: delivery already matches the
+    # receiver's placement, so aligning against it must copy NOTHING
+    from p2pfl_tpu.ops.tree import tree_align_copy_count, tree_align_devices
+
+    before = tree_align_copy_count()
+    delivered.params = tree_align_devices(delivered.params, template)
+    misplaced = tree_align_copy_count() - before
+    if misplaced:
+        _count("align_violations", misplaced)
+        logger.log_comm_metric(src, "ici_align_violation", misplaced)
+        logger.error(
+            src,
+            f"ICI delivery to {nei} needed {misplaced} device fix-up "
+            "copies — the shard plane mis-placed a leaf (self-healed)",
+        )
+
+    denv = WeightsEnvelope(
+        env.source, env.round, env.cmd, delivered, env.msg_id,
+        trace_ctx=env.trace_ctx, xp=env.xp,
+    )
+    try:
+        result = dst_node.protocol.handle_weights(denv)
+    except Exception:  # noqa: BLE001 — peer died mid-delivery
+        return False
+    _count("shard_sends")
+    _count("bytes_moved", moved)
+    logger.log_comm_metric(src, "ici_send_shard")
+    logger.log_comm_metric(src, "ici_bytes_moved", moved)
+    telemetry.event(
+        src,
+        "ici_transfer",
+        kind="gossip",
+        attrs={"peer": nei, "backend": backend, "codec": mode, "bytes": moved},
+    )
+    return bool(result.ok)

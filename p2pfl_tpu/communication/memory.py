@@ -118,6 +118,18 @@ class InMemoryProtocol(CommunicationProtocol):
             if isinstance(env, WeightsEnvelope):
                 from p2pfl_tpu.settings import Settings
 
+                # shard-native weights plane (Settings.WEIGHTS_PLANE="ici"):
+                # model payloads between co-located nodes move device-to-
+                # device (communication/ici.py) — this sits INSIDE the
+                # transport send, so the fault injector, send spans and
+                # breaker feeds at the _do_send seam wrap it unchanged; an
+                # ineligible peer falls through to the byte/reference path
+                from p2pfl_tpu.communication.ici import try_shard_send
+
+                handled = try_shard_send(self, nei, env)
+                if handled is not None:
+                    return handled
+
                 if Settings.MEMORY_WIRE_CODEC and env.update.params is not None:
                     # byte-path simulation: ship encoded bytes (hitting the
                     # payload cache like a network transport would) and let
@@ -134,6 +146,7 @@ class InMemoryProtocol(CommunicationProtocol):
                         encoded=env.update.encode(),
                         version=env.update.version,
                         xp=env.update.xp,
+                        sp=env.update.sp,
                     )
                     env = WeightsEnvelope(
                         env.source, env.round, env.cmd, wire, env.msg_id,

@@ -134,6 +134,13 @@ class CommunicationProtocol(ABC):
         # be shared across a broadcast — identical stamp, benign
         if update.xp is None and self.experiment_xid is not None:
             update.xp = self.experiment_xid
+        # shard-plane handshake: when the ICI weights plane is on, every
+        # weights frame — including byte-path fallbacks to non-colocated
+        # peers — advertises this node's slice topology via the optional
+        # "sp" header (communication/ici.py)
+        from p2pfl_tpu.communication.ici import stamp_handshake
+
+        stamp_handshake(self._address, update)
         return WeightsEnvelope(
             self._address, round, cmd, update, trace_ctx=telemetry.current_ctx(),
             xp=update.xp or self.experiment_xid,

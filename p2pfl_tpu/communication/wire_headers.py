@@ -79,4 +79,15 @@ OPTIONAL_WIRE_HEADERS: Tuple[WireHeader, ...] = (
             "receivers filter cross-experiment stragglers exactly"
         ),
     ),
+    WireHeader(
+        key="sp",
+        planes=("weights",),
+        memory_copies=(("ModelUpdate", "sp"),),
+        doc=(
+            "shard-plane handshake triple (slice_shape, slice_index, "
+            "codec) — communication/ici.py; byte-path frames advertise "
+            "the sender's slice topology so receivers can validate "
+            "co-location for the ICI weights plane"
+        ),
+    ),
 )

@@ -15,8 +15,9 @@ import jax.numpy as jnp
 
 from p2pfl_tpu.learning.aggregators.aggregator import Aggregator
 from p2pfl_tpu.learning.weights import ModelUpdate
+from p2pfl_tpu.management.logger import logger
 from p2pfl_tpu.ops.aggregation import fedavg, fedavg_fold_acc
-from p2pfl_tpu.ops.tree import tree_align_devices, tree_stack
+from p2pfl_tpu.ops.tree import tree_align_copy_count, tree_align_devices, tree_stack
 from p2pfl_tpu.settings import Settings
 
 
@@ -25,6 +26,20 @@ class FedAvg(Aggregator):
     MASK_COMPATIBLE = True  # linear: secagg pairwise masks cancel through it
 
     def aggregate(self, models: list[ModelUpdate]) -> ModelUpdate:
+        align_before = tree_align_copy_count()
+        try:
+            return self._aggregate(models)
+        finally:
+            # per-node visibility of D2D fix-up copies: the ICI weights
+            # plane's deliveries must contribute ZERO here (they arrive
+            # already on this node's shardings) while the zero-copy
+            # memory transport's cross-slice contributions still count
+            # theirs — the bench reads exactly this metric
+            copies = tree_align_copy_count() - align_before
+            if copies:
+                logger.log_comm_metric(self.node_name, "tree_align_copies", copies)
+
+    def _aggregate(self, models: list[ModelUpdate]) -> ModelUpdate:
         contributors = sorted({c for m in models for c in m.contributors})
         total = sum(m.num_samples for m in models)
         own = next((m for m in models if m.partial_acc is not None), None)

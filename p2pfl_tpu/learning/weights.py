@@ -104,6 +104,10 @@ class PayloadCache:
         self.owner = owner
         self._lock = threading.Lock()
         self._entries: "dict[tuple, bytes]" = {}
+        # error-feedback fold ownership per payload content (see
+        # ef_fold_once) — separate from _entries so markers can never
+        # evict cached payloads
+        self._ef_marks: "dict[tuple, None]" = {}
         self.hits = 0
         self.misses = 0
 
@@ -126,6 +130,23 @@ class PayloadCache:
             self._entries[key] = payload
             while len(self._entries) > self.MAX_ENTRIES:
                 self._entries.pop(next(iter(self._entries)))
+
+    def ef_fold_once(self, key: tuple) -> bool:
+        """True exactly once per content key — the caller that gets True
+        OWNS the error-feedback fold for that payload content; every
+        later encoder of the same content (a cache miss on a *different*
+        plane's key — the ICI shard encode and the byte encode cache
+        under different keys) must encode residual-free instead of
+        re-folding the just-written carry as if it were last round's.
+        Keys are monotone like payload keys, so the FIFO bound only
+        guards a pathological interleave."""
+        with self._lock:
+            if key in self._ef_marks:
+                return False
+            self._ef_marks[key] = None
+            while len(self._ef_marks) > self.MAX_ENTRIES * 2:
+                self._ef_marks.pop(next(iter(self._ef_marks)))
+            return True
 
 _MAGIC = b"P2TW"  # p2pfl-tpu weights
 _VERSION = 1
@@ -354,23 +375,13 @@ def encode_params(
     named = _named(tree)
     anchor_named = _named(anchor) if anchor is not None else None
 
-    def _size(leaf) -> int:
-        return int(np.prod(np.shape(leaf), dtype=np.int64)) if np.shape(leaf) else 1
+    # the ONE topk-eligibility predicate + budget AND the one sizing
+    # helper, shared by both byte producers and the shard-plane codec
+    # (ops/compression.py — drift here would silently wipe valid
+    # error-feedback carries or diverge nnz)
+    from p2pfl_tpu.ops.compression import build_topk_plan, leaf_size as _size
 
-    # the ONE topk-eligibility predicate + budget, shared by both producers
-    # (and by residual validation — drift here would silently wipe valid
-    # error-feedback carries or diverge the producers' nnz)
-    from p2pfl_tpu.ops.compression import topk_budget
-
-    topk_plan = {
-        key: topk_budget(_size(leaf), topk_frac)
-        for key, leaf in named.items()
-        if compression == "topk8"
-        and np.dtype(leaf.dtype).kind == "f"
-        and anchor_named is not None
-        and key in anchor_named
-        and _size(leaf) > 16
-    }
+    topk_plan = build_topk_plan(named, anchor_named, topk_frac)
     _validate_residual(residual, {key: _size(named[key]) for key in topk_plan})
 
     from p2pfl_tpu.settings import wire_compression_device
@@ -638,6 +649,20 @@ class ModelUpdate:
     #: ``take_early_init`` fall back to the TTL + epoch heuristics only
     #: for frames from old senders that lack it.
     xp: Optional[str] = None
+    #: shard-plane handshake triple ``(slice_shape, slice_index, codec)``
+    #: (``communication/ici.py``): the sender's slice topology — the
+    #: devices-array shape of its submesh (or ``(1,)`` for a single-chip
+    #: node), its slot on the global mesh's nodes axis (-1 when unknown)
+    #: and the codec tag its shard payloads use. OPTIONAL wire field
+    #: serialized as ``"sp"`` in the gRPC envelope header, same
+    #: backward-compat pattern as ``"vv"``/``"xp"`` (absent frames decode
+    #: unchanged; the protobuf interop schema never carries it). Stamped
+    #: by ``protocol.build_weights`` whenever the sending node has a
+    #: registered shard-plane endpoint — including on BYTE-path fallback
+    #: frames to non-colocated peers, which is what makes it a handshake:
+    #: the receiver learns the sender's slice topology from ordinary
+    #: frames and can validate co-location before any shard transfer.
+    sp: Optional[tuple] = None
     #: encode-once plumbing (module docstring) — the learner's shared
     #: :class:`PayloadCache` plus its model-version counter at the time
     #: this update was handed out; ``cache_round`` is stamped by
@@ -650,6 +675,19 @@ class ModelUpdate:
     #: instance from several worker threads, and an error-feedback encode
     #: mutates the residual store — exactly once, under this lock
     _encode_lock: threading.Lock = field(default_factory=threading.Lock, repr=False, compare=False)
+
+    def ef_fold_key(self, compression: str) -> tuple:
+        """The ONE cross-plane error-feedback fold-ownership key.
+
+        Both encoders of this update's content — the byte path
+        (:meth:`encode`) and the ICI shard plane
+        (``communication/ici.py``) — claim the fold through
+        ``PayloadCache.ef_fold_once`` with exactly this tuple; building
+        it anywhere else risks the keys drifting apart, which would
+        silently re-arm the fold on the second plane (the double-apply
+        bug the mechanism exists to prevent).
+        """
+        return (self.cache_version, self.cache_round, compression, self.anchor_tag)
 
     def encode(self) -> bytes:
         with self._encode_lock:
@@ -682,11 +720,21 @@ class ModelUpdate:
             if cached is not None:
                 self.encoded = cached
                 return cached
+        residual = self.ef_residual
+        if residual is not None and cache is not None and self.cache_version is not None:
+            # cross-PLANE fold ownership: the ICI shard encode and the
+            # byte encode cache under different keys, so a cache miss
+            # here does not mean the residual is unfolded — whichever
+            # plane encoded this content first owns the fold, and the
+            # other encodes residual-free (re-folding the just-written
+            # carry would double-apply it)
+            if not cache.ef_fold_once(self.ef_fold_key(Settings.WIRE_COMPRESSION)):
+                residual = None
         self.encoded = encode_params(
             self.params,
             anchor=self.anchor,
             anchor_tag=self.anchor_tag,
-            residual=self.ef_residual,
+            residual=residual,
             owner=cache.owner if cache is not None else None,
         )
         if key is not None:

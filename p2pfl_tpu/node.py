@@ -188,6 +188,14 @@ class Node:
 
         StallWatchdog.ensure_started()  # no-op unless Settings.STALL_WATCHDOG_S > 0
         self.protocol.start()
+        if self.learner is not None:
+            # shard-plane presence (communication/ici.py): co-located peers
+            # can move model payloads device-to-device when
+            # Settings.WEIGHTS_PLANE="ici"; registration is unconditional
+            # and cheap — the plane itself gates on the setting per send
+            from p2pfl_tpu.communication.ici import IciEndpoint, ShardPlaneRegistry
+
+            ShardPlaneRegistry.register(self.addr, IciEndpoint(self))
         self._running = True
         if wait:
             self.protocol.wait_for_termination()
@@ -196,6 +204,9 @@ class Node:
         if not self._running:
             return
         self._running = False
+        from p2pfl_tpu.communication.ici import ShardPlaneRegistry
+
+        ShardPlaneRegistry.unregister(self.addr)
         self._stop_learning()
         self.protocol.stop()
         logger.unregister_node(self.addr)

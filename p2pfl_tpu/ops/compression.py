@@ -60,6 +60,51 @@ def topk_budget(size: int, topk_frac: float) -> int:
     return max(1, int(np.ceil(size * topk_frac)))
 
 
+def leaf_size(leaf) -> int:
+    """Element count of a leaf (1 for scalars) — shared sizing helper."""
+    return int(np.prod(np.shape(leaf), dtype=np.int64)) if np.shape(leaf) else 1
+
+
+def build_topk_plan(named: dict, anchor_named: Optional[dict], topk_frac: float) -> dict:
+    """The ONE topk-eligibility predicate + per-tensor budget.
+
+    Shared by the host producer, the device producer AND the shard-plane
+    codec (``communication/ici.py``) — drift here would silently wipe
+    valid error-feedback carries or diverge the producers' nnz. A tensor
+    is delta-coded iff: topk is active (``topk_frac > 0``), the leaf is
+    float, the anchor holds a matching path, and the tensor is big enough
+    (> 16 elements) for sparsification to pay.
+    """
+    if topk_frac <= 0.0 or anchor_named is None:
+        return {}
+    return {
+        key: topk_budget(leaf_size(leaf), topk_frac)
+        for key, leaf in named.items()
+        if np.dtype(leaf.dtype).kind == "f"
+        and key in anchor_named
+        and leaf_size(leaf) > 16
+    }
+
+
+def split_codec_specs(named: dict, topk_plan: dict) -> tuple[list, tuple, tuple]:
+    """Sorted keys + the static (tk, dense) segment specs both device
+    entry points compile against: ``tk_spec`` is ``(key, size, budget)``
+    per delta-coded tensor, ``dense_spec`` ``(key, size)`` per dense-int8
+    float tensor; non-float leaves belong to neither (raw passthrough)."""
+    keys = sorted(named)
+    tk_spec: list[tuple[str, int, int]] = []
+    dense_spec: list[tuple[str, int]] = []
+    for key in keys:
+        leaf = named[key]
+        if np.dtype(leaf.dtype).kind != "f":
+            continue  # raw passthrough, handled by the caller
+        if key in topk_plan:
+            tk_spec.append((key, leaf_size(leaf), topk_plan[key]))
+        else:
+            dense_spec.append((key, leaf_size(leaf)))
+    return keys, tuple(tk_spec), tuple(dense_spec)
+
+
 # ---- the fused encode program ----
 
 
@@ -72,9 +117,10 @@ def _quantize_seg(vals):
     return q, scale
 
 
-@partial(jax.jit, static_argnums=(4, 5, 6, 7), donate_argnums=(2,))
+@partial(jax.jit, static_argnums=(4, 5, 6, 7, 8), donate_argnums=(2,))
 def _encode_jit(
-    tk_leaves, anchor_leaves, res_leaves, dense_leaves, tk_spec, dense_spec, res_mask, want_res
+    tk_leaves, anchor_leaves, res_leaves, dense_leaves, tk_spec, dense_spec, res_mask,
+    want_res, barrier=True,
 ):
     """One dispatch: delta + residual + per-segment top-k + int8.
 
@@ -82,6 +128,14 @@ def _encode_jit(
     into one program, and selection costs ``Σ topk(n_i, k_i)`` with no
     padding waste. ``res_leaves`` (the error-feedback carry) is donated —
     the new residual can reuse its buffers and never visits the host.
+
+    ``barrier`` (static) pins the top_k/sort results as materialized
+    values — load-bearing on single-device XLA:CPU (below). It MUST be
+    False when the inputs are committed across a multi-device mesh:
+    ``optimization_barrier`` under the SPMD partitioner hard-crashes
+    XLA:CPU (a fatal ``hlo_casting_utils`` check, observed on jax
+    0.4.37), and the fusion-duplication pathology it works around is a
+    single-device CPU artifact anyway.
     """
     out = {}
     if tk_spec:
@@ -99,9 +153,12 @@ def _encode_jit(
             # every consumer fusion (q, residual, idx outputs) — measured
             # ~10× wall-clock on the bench MLP; pinning the sorted results
             # as materialized values keeps selection cost at Σ topk(n_i,k_i)
-            mags, pos = jax.lax.optimization_barrier((mags, pos))
+            if barrier:
+                mags, pos = jax.lax.optimization_barrier((mags, pos))
             scale = jnp.where(mags[0] > 0, mags[0] / jnp.float32(127.0), jnp.float32(1.0))
-            pos = jax.lax.optimization_barrier(jnp.sort(pos))  # wire ships ascending
+            pos = jnp.sort(pos)  # wire ships ascending
+            if barrier:
+                pos = jax.lax.optimization_barrier(pos)
             vals = d[pos]
             q = jnp.clip(jnp.rint(vals / scale), -127, 127).astype(jnp.int8)
             if want_res:
@@ -130,6 +187,63 @@ def _encode_jit(
     return out
 
 
+def _run_encode_jit(
+    named: dict,
+    anchor_named: Optional[dict],
+    tk_spec: tuple,
+    dense_spec: tuple,
+    residual: Optional[dict],
+    barrier: bool = True,
+) -> dict:
+    """Stage leaves, run :func:`_encode_jit`, write back the EF carries.
+
+    Shared by the D2H-materializing producer (:func:`encode_device`) and
+    the shard-resident producer (:func:`encode_shard_device`) so the two
+    can never diverge on residual-donation failure handling or carry
+    write-back order.
+    """
+    tk_leaves = tuple(jnp.asarray(named[k]) for k, _s, _b in tk_spec)
+    anchor_leaves = tuple(jnp.asarray(anchor_named[k]) for k, _s, _b in tk_spec)
+    res_mask = tuple(
+        residual is not None and k in residual for k, _s, _b in tk_spec
+    )
+    res_leaves = tuple(
+        jnp.asarray(residual[k], jnp.float32).reshape(-1)
+        for (k, _s, _b), present in zip(tk_spec, res_mask)
+        if present
+    )
+    dense_leaves = tuple(jnp.asarray(named[k]) for k, _s in dense_spec)
+
+    try:
+        outs = _encode_jit(
+            tk_leaves,
+            anchor_leaves,
+            res_leaves,
+            dense_leaves,
+            tk_spec,
+            dense_spec,
+            res_mask,
+            residual is not None,
+            barrier,
+        )
+    except Exception:
+        # res_leaves were DONATED: a dispatch that fails after handing
+        # buffers to the runtime (transient OOM) leaves the store's arrays
+        # deleted while still referenced — and .size metadata survives
+        # deletion, so _validate_residual would never notice. Drop the
+        # entries we donated: the next encode restarts their carry from
+        # zero instead of dying on 'Array has been deleted' forever.
+        if residual is not None:
+            for (key, _size, _b), present in zip(tk_spec, res_mask):
+                if present:
+                    residual.pop(key, None)
+        raise
+    if tk_spec and residual is not None:
+        for (key, _size, _b), carry in zip(tk_spec, outs["tk"][3]):
+            residual[key] = carry
+    return outs
+
+
 def encode_device(
     named: dict,
     anchor_named: Optional[dict],
@@ -155,69 +269,19 @@ def encode_device(
     byte materialized host-side — the compressed buffers plus any raw
     (non-float) passthrough leaves.
     """
-    keys = sorted(named)
-    tk_spec: list[tuple[str, int, int]] = []
-    dense_spec: list[tuple[str, int]] = []
-    for key in keys:
-        leaf = named[key]
-        size = int(np.prod(leaf.shape, dtype=np.int64)) if leaf.shape else 1
-        if np.dtype(leaf.dtype).kind != "f":
-            continue  # raw passthrough, handled below
-        if key in topk_plan:
-            tk_spec.append((key, size, topk_plan[key]))
-        else:
-            dense_spec.append((key, size))
-    tk_spec_t = tuple(tk_spec)
-    dense_spec_t = tuple(dense_spec)
-
-    tk_leaves = tuple(jnp.asarray(named[k]) for k, _s, _b in tk_spec)
-    anchor_leaves = tuple(jnp.asarray(anchor_named[k]) for k, _s, _b in tk_spec)
-    res_mask = tuple(
-        residual is not None and k in residual for k, _s, _b in tk_spec
-    )
-    res_leaves = tuple(
-        jnp.asarray(residual[k], jnp.float32).reshape(-1)
-        for (k, _s, _b), present in zip(tk_spec, res_mask)
-        if present
-    )
-    dense_leaves = tuple(jnp.asarray(named[k]) for k, _s in dense_spec)
-
-    try:
-        outs = _encode_jit(
-            tk_leaves,
-            anchor_leaves,
-            res_leaves,
-            dense_leaves,
-            tk_spec_t,
-            dense_spec_t,
-            res_mask,
-            residual is not None,
-        )
-    except Exception:
-        # res_leaves were DONATED: a dispatch that fails after handing
-        # buffers to the runtime (transient OOM) leaves the store's arrays
-        # deleted while still referenced — and .size metadata survives
-        # deletion, so _validate_residual would never notice. Drop the
-        # entries we donated: the next encode restarts their carry from
-        # zero instead of dying on 'Array has been deleted' forever.
-        if residual is not None:
-            for (key, _size, _b), present in zip(tk_spec, res_mask):
-                if present:
-                    residual.pop(key, None)
-        raise
+    keys, tk_spec, dense_spec = split_codec_specs(named, topk_plan)
+    outs = _run_encode_jit(named, anchor_named, tk_spec, dense_spec, residual)
 
     d2h = 0
     idx_np = q_np = scales_np = None
     if tk_spec:
-        idx_dev, q_dev, scales_dev, new_res = outs["tk"]
-        # the ONLY model-sized D2H is these compressed buffers
+        idx_dev, q_dev, scales_dev, _new_res = outs["tk"]
+        # the ONLY model-sized D2H is these compressed buffers (the EF
+        # carries were written back device-resident by _run_encode_jit)
         idx_np = np.asarray(idx_dev)
         q_np = np.asarray(q_dev)
         scales_np = np.asarray(scales_dev)
         d2h += idx_np.nbytes + q_np.nbytes + scales_np.nbytes
-        if residual is not None:
-            for (key, _size, _b), carry in zip(tk_spec, new_res):
-                residual[key] = carry
     qd_np = scales_d_np = None
     if dense_spec:
         qd_dev, scales_d_dev = outs["dense"]
@@ -306,3 +370,124 @@ def decode_tk8_device(items: list) -> dict:
         key: flat.reshape(shape).astype(dtype)
         for (key, _leaf, _idx, _vals, shape, dtype), flat in zip(items, dense)
     }
+
+
+# ---- shard-resident entry points (the ICI weights plane's codec) ----
+#
+# The producers above exist to shrink the D2H pull to ~payload size; the
+# shard-native ICI weights plane (communication/ici.py) goes one further
+# and never crosses D2H at all: the compressed (idx, q, scale) buffers
+# stay DEVICE arrays, move to the peer's slice over the interconnect
+# (parallel/ici_plane.py), and are consumed by a fused scatter against the
+# receiver's device-resident anchor. Same math, same _encode_jit program,
+# same segment specs (split_codec_specs / build_topk_plan) — only the
+# materialization step is gone, so bytes-over-host is exactly zero.
+
+
+def encode_shard_device(
+    named: dict,
+    anchor_named: Optional[dict],
+    topk_plan: dict,
+    residual: Optional[dict],
+    barrier: bool = True,
+) -> tuple[tuple, tuple, dict]:
+    """Device-resident encode: one fused dispatch, NOTHING materialized.
+
+    Returns ``(tk_spec, dense_spec, payload)`` where ``payload`` maps
+    buffer names (``"idx"``/``"q"``/``"scales"`` for the delta-coded
+    segments, ``"dq"``/``"dscales"`` for dense-int8) to DEVICE arrays —
+    the exact tensors :func:`decode_shard_device` consumes on the far
+    slice. Non-float leaves belong to neither spec; the caller ships them
+    raw (they are already device-resident). ``residual`` follows the same
+    donated error-feedback contract as :func:`encode_device` (the carry
+    is written back device-resident; a failed dispatch drops the donated
+    entries) via the shared :func:`_run_encode_jit`.
+    """
+    _keys, tk_spec, dense_spec = split_codec_specs(named, topk_plan)
+    outs = _run_encode_jit(named, anchor_named, tk_spec, dense_spec, residual, barrier)
+    payload: dict = {}
+    if tk_spec:
+        idx_dev, q_dev, scales_dev, _new_res = outs["tk"]
+        payload["idx"] = idx_dev
+        payload["q"] = q_dev
+        payload["scales"] = scales_dev
+    if dense_spec:
+        dq_dev, dscales_dev = outs["dense"]
+        payload["dq"] = dq_dev
+        payload["dscales"] = dscales_dev
+    return tk_spec, dense_spec, payload
+
+
+@partial(jax.jit, static_argnums=(4, 5))
+def _shard_scatter_jit(anchor_leaves, idx, q, scales, tk_spec, out_meta):
+    """Delta segments → reconstructed tensors, one fused dispatch.
+
+    ``idx``/``q`` are the concatenated per-segment buffers in spec order
+    (per-tensor LOCAL indices — never global offsets, same int32 contract
+    as :func:`_scatter_jit`); the static ``out_meta`` carries each
+    segment's (shape, dtype name) so reshape + cast stay inside the one
+    program. Indices are strictly ascending per segment by construction
+    (the encoder sorts), so the scatter-add touches each coordinate once.
+    """
+    outs = []
+    off = 0
+    for i, (_key, _size, budget) in enumerate(tk_spec):
+        shape, dtype = out_meta[i]
+        seg = idx[off : off + budget]
+        vals = q[off : off + budget].astype(jnp.float32) * scales[i]
+        flat = anchor_leaves[i].astype(jnp.float32).reshape(-1).at[seg].add(vals)
+        outs.append(flat.reshape(shape).astype(dtype))
+        off += budget
+    return tuple(outs)
+
+
+@partial(jax.jit, static_argnums=(2, 3))
+def _shard_dense_jit(dq, dscales, dense_spec, out_meta):
+    """Dense-int8 segments → dequantized tensors, one fused dispatch."""
+    outs = []
+    off = 0
+    for i, (_key, size) in enumerate(dense_spec):
+        shape, dtype = out_meta[i]
+        seg = dq[off : off + size].astype(jnp.float32) * dscales[i]
+        outs.append(seg.reshape(shape).astype(dtype))
+        off += size
+    return tuple(outs)
+
+
+def decode_shard_device(
+    payload: dict,
+    tk_spec: tuple,
+    dense_spec: tuple,
+    anchor_named: Optional[dict],
+    template_named: dict,
+) -> dict:
+    """Consume a shard-resident payload against the RECEIVER's anchors.
+
+    The mirror of :func:`encode_shard_device`: delta segments scatter-add
+    onto the receiver's device-resident anchor tensors (same divergence
+    budget as the byte decoder — same-round anchors differ across nodes
+    by at most the codec's loss), dense segments dequantize, and every
+    output takes the matching ``template_named`` leaf's shape/dtype. Two
+    fused dispatches at most; nothing crosses the host.
+    """
+    out: dict = {}
+    if tk_spec:
+        anchors = tuple(jnp.asarray(anchor_named[k]) for k, _s, _b in tk_spec)
+        meta = tuple(
+            (tuple(np.shape(template_named[k])), np.dtype(template_named[k].dtype).name)
+            for k, _s, _b in tk_spec
+        )
+        recon = _shard_scatter_jit(
+            anchors, payload["idx"], payload["q"], payload["scales"], tk_spec, meta
+        )
+        for (key, _s, _b), leaf in zip(tk_spec, recon):
+            out[key] = leaf
+    if dense_spec:
+        meta = tuple(
+            (tuple(np.shape(template_named[k])), np.dtype(template_named[k].dtype).name)
+            for k, _s in dense_spec
+        )
+        recon = _shard_dense_jit(payload["dq"], payload["dscales"], dense_spec, meta)
+        for (key, _s), leaf in zip(dense_spec, recon):
+            out[key] = leaf
+    return out

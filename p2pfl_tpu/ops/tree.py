@@ -13,6 +13,7 @@ with fp32-exact averaging.
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Sequence
 
 import jax
@@ -42,6 +43,26 @@ def tree_stack(trees: Sequence[Pytree]) -> Pytree:
     return jax.tree.map(lambda *xs: jnp.stack(xs), *trees)
 
 
+#: per-THREAD count of leaves :func:`tree_align_devices` actually had to
+#: re-place (one ``device_put`` each). The shard-native ICI weights plane
+#: (``communication/ici.py``) delivers payloads already on the receiver's
+#: shardings, so its no-fix-up contract is *measurable*: the counter stays
+#: flat across an ICI round while the zero-copy memory transport's cross-
+#: slice deliveries still count theirs (FedAvg logs the per-aggregate delta
+#: as the ``tree_align_copies`` comm metric). Thread-local deliberately:
+#: every consumer measures a before/after DELTA around its own call on its
+#: own thread — a process-global counter would let one gossip worker's
+#: copies land inside another node's open delta window (a multi-node
+#: in-process fleet runs many senders concurrently) and flag phantom
+#: violations.
+_align_tls = threading.local()
+
+
+def tree_align_copy_count() -> int:
+    """Leaves re-placed by :func:`tree_align_devices` on THIS thread."""
+    return getattr(_align_tls, "copies", 0)
+
+
 def tree_align_devices(tree: Pytree, like: Pytree) -> Pytree:
     """Re-place ``tree``'s committed arrays onto ``like``'s shardings.
 
@@ -51,15 +72,37 @@ def tree_align_devices(tree: Pytree, like: Pytree) -> Pytree:
     jit mixing them with local state refuses with "incompatible devices".
     One ``device_put`` per differing leaf re-places them (device-to-device
     over ICI on a pod). Host numpy leaves and already-aligned arrays pass
-    through untouched, so the common single-device path pays nothing.
+    through untouched.
+
+    Fast path: when every leaf already sits on ``like``'s sharding — the
+    common single-device case, and the *contract* on the shard-native ICI
+    weights plane — the input tree is returned unchanged and the copy
+    counter does not move (zero per-leaf ``device_put`` dispatches, zero
+    allocations). The ICI plane asserts exactly this after each transfer.
     """
+    la = jax.tree.leaves(tree)
+    ll = jax.tree.leaves(like)
+
+    def differs(x, l):  # noqa: E741 — like-leaf
+        if not (isinstance(x, jax.Array) and isinstance(l, jax.Array)):
+            return False
+        if x.sharding == l.sharding:
+            return False
+        # sharding-TYPE-blind placement equivalence: a NamedSharding over
+        # a one-device mesh and a SingleDeviceSharding of that device put
+        # every byte in the same place — jits mix them freely, so a
+        # device_put here would be pure churn (the ICI plane's decode
+        # programs legitimately produce the former against templates
+        # committed as the latter)
+        ds_x, ds_l = x.sharding.device_set, l.sharding.device_set
+        return not (len(ds_x) == 1 and ds_x == ds_l)
+
+    if not any(differs(x, l) for x, l in zip(la, ll)):
+        return tree
 
     def one(x, l):  # noqa: E741 — like-leaf
-        if (
-            isinstance(x, jax.Array)
-            and isinstance(l, jax.Array)
-            and x.sharding != l.sharding
-        ):
+        if differs(x, l):
+            _align_tls.copies = tree_align_copy_count() + 1
             return jax.device_put(x, l.sharding)
         return x
 

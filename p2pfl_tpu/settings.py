@@ -200,6 +200,32 @@ class Settings:
     # re-enter the next round's delta (Seide et al. 2014).
     TOPK_ERROR_FEEDBACK: bool = True
 
+    # --- shard-native ICI weights plane (communication/ici.py) ---
+    # Which transport carries MODEL payloads between co-located nodes:
+    # "bytes" is the existing behavior (the weights plane rides the same
+    # transport as the control plane — encoded frames over gRPC, or the
+    # in-memory reference/byte path); "ici" exchanges SHARDS shard-to-
+    # shard between nodes registered on the shard-plane registry — each
+    # device copies its parameter block directly to the matching device
+    # of the peer's slice (a collective-permute / Pallas remote DMA over
+    # the interconnect), composing with the device-side top-k/int8 codec
+    # so the encode→transfer→decode→merge chain never touches the host.
+    # The control plane (votes, coverage, beats) ALWAYS keeps riding the
+    # byte transport; per-peer ineligibility (unregistered peer,
+    # different process, mismatched slice topology) falls back loudly to
+    # the byte path for that peer only (``ici_fallback_bytes`` metric),
+    # never aborts the round.
+    WEIGHTS_PLANE: str = "bytes"
+    # Shard-transfer backend for the ICI plane: "pallas" is the TPU
+    # remote-DMA kernel (parallel/ici_plane.py — each device RDMAs its
+    # block straight to the partner device's HBM), "ppermute" the pure-
+    # XLA collective-permute program that runs anywhere (the CPU-runnable
+    # bit-parity fallback the chaos suite and tier-1 exercise). "auto"
+    # resolves by backend via :func:`ici_backend`: pallas on TPU,
+    # ppermute elsewhere. Both move the same shards — backend choice can
+    # never change what the receiver decodes.
+    ICI_BACKEND: str = "auto"
+
     # --- async bounded-staleness federation (p2pfl_tpu/federation/) ---
     # Which control plane drives the learning thread: "sync" is the round
     # FSM (stages/learning_stages.py — barrier-synchronized rounds, the
@@ -336,6 +362,22 @@ def wire_compression_device() -> bool:
     return jax.default_backend() != "cpu"
 
 
+def ici_backend() -> str:
+    """Resolve ``Settings.ICI_BACKEND`` ("auto" = by backend).
+
+    The Pallas remote-DMA kernel only lowers on real TPU hardware; the
+    pure-XLA ``ppermute`` program is the bit-parity fallback everywhere
+    else (including the 8-virtual-device CPU mesh tier-1 runs on). An
+    explicit "pallas"/"ppermute" overrides the auto-select either way.
+    """
+    explicit = Settings.ICI_BACKEND
+    if explicit != "auto":
+        return explicit
+    import jax
+
+    return "pallas" if jax.default_backend() == "tpu" else "ppermute"
+
+
 def telemetry_jax_annotations() -> bool:
     """Resolve ``Settings.TELEMETRY_JAX_ANNOTATIONS`` (None = by backend).
 
@@ -430,6 +472,8 @@ def set_test_settings() -> None:
     Settings.TELEMETRY_ENABLED = True
     Settings.TELEMETRY_RING_SPANS = 4096
     Settings.TELEMETRY_BEAT_SPANS = False
+    Settings.WEIGHTS_PLANE = "bytes"
+    Settings.ICI_BACKEND = "auto"
     Settings.FEDERATION_MODE = "sync"
     Settings.FEDBUFF_K = 4
     Settings.FEDBUFF_ALPHA = 0.5

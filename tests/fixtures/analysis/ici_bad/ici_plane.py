@@ -1,0 +1,29 @@
+"""Teeth fixture: the ICI weights plane quietly touching the host.
+
+Every call here is a real way the zero-host-bytes contract has been (or
+could be) silently broken — the basename puts this file in the
+``no-host-gather`` scope, so each one MUST flag.
+"""
+
+import jax
+import numpy as np
+
+
+def shard_transfer(tree_leaves):
+    # "just a shape check" that gathers the whole leaf host-side
+    host = [np.asarray(x) for x in tree_leaves]
+    return host
+
+
+def digest(leaf):
+    # byte materialization — the byte codec sneaking back into the plane
+    return leaf.tobytes()
+
+
+def debug_peek(leaf):
+    val = jax.device_get(leaf)
+    return val.item()
+
+
+def rewrap(buf):
+    return np.frombuffer(buf, dtype=np.int8)

@@ -1,0 +1,38 @@
+"""Teeth fixture: the shipped ICI-plane shape — device-side mechanics only.
+
+Zero-copy metadata assembly, D2D re-placement and H2D filler uploads are
+all allowed inside the ``no-host-gather`` scope; this file MUST pass.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def pair_mesh(src_mesh, dst_mesh):
+    return Mesh(
+        np.stack([src_mesh.devices, dst_mesh.devices]),
+        ("ici_pair", *src_mesh.axis_names),
+    )
+
+
+def pair_global(leaf_src, leaf_fill, gsharding):
+    gshape = (2,) + tuple(leaf_src.shape)
+    dmap = {}
+    for s in leaf_src.addressable_shards:
+        dmap[s.device] = s.data.reshape((1,) + s.data.shape)
+    for s in leaf_fill.addressable_shards:
+        dmap[s.device] = s.data.reshape((1,) + s.data.shape)
+    arrs = [dmap[d] for d in gsharding.addressable_devices_indices_map(gshape)]
+    return jax.make_array_from_single_device_arrays(gshape, gsharding, arrs)
+
+
+def filler(leaf, mesh):
+    # H2D upload of zeros is fine — the contract is about payload D2H
+    return jax.device_put(jnp.zeros(tuple(leaf.shape), leaf.dtype), NamedSharding(mesh, P()))
+
+
+def payload_bytes(tree_leaves):
+    # metadata-only accounting: shapes/dtypes, never the buffers
+    return sum(x.size * x.dtype.itemsize for x in tree_leaves)

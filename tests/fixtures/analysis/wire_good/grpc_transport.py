@@ -34,12 +34,20 @@ def encode_weights(env):
     xp = env.xp or env.update.xp
     if xp is not None:
         d["xp"] = xp
+    if env.update.sp is not None:
+        d["sp"] = [list(env.update.sp[0]), env.update.sp[1], env.update.sp[2]]
     return json.dumps(d).encode()
+
+
+def _sp_header(d):
+    sp = d.get("sp")
+    return (tuple(sp[0]), int(sp[1]), str(sp[2])) if sp else None
 
 
 def decode_weights(data):
     d = json.loads(data.decode())
     vv = d.get("vv")
     return WeightsEnvelope(
-        d["src"], d["round"], d["cmd"], version=vv, trace_ctx=_trace_ctx(d), xp=d.get("xp")
+        d["src"], d["round"], d["cmd"], version=vv, trace_ctx=_trace_ctx(d),
+        xp=d.get("xp"), sp=_sp_header(d),
     )

@@ -15,6 +15,7 @@ class InMemoryProtocol:
                 encoded=env.update.encode(),
                 version=env.update.version,
                 xp=env.update.xp,
+                sp=env.update.sp,
             )
             env = WeightsEnvelope(
                 env.source, env.round, env.cmd, wire, env.msg_id,

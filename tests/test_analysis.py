@@ -117,6 +117,29 @@ def test_wire_header_compat_teeth():
     assert analyze([str(FIXTURES / "wire_good")], [WireHeaderCompatRule]) == []
 
 
+def test_no_host_gather_teeth():
+    from p2pfl_tpu.analysis.rules import NoHostGatherRule
+
+    bad = analyze([str(FIXTURES / "ici_bad")], [NoHostGatherRule])
+    assert rules_of(bad) == ["no-host-gather"]
+    msgs = "\n".join(f.message for f in bad)
+    assert "np.asarray" in msgs          # full-gather of a device leaf
+    assert ".tobytes()" in msgs          # byte materialization
+    assert "jax.device_get" in msgs      # explicit host pull
+    assert ".item()" in msgs             # scalar host sync
+    assert "np.frombuffer" in msgs       # byte-codec shape sneaking back
+    assert analyze([str(FIXTURES / "ici_good")], [NoHostGatherRule]) == []
+
+
+def test_no_host_gather_is_scope_targeted():
+    # the SAME host calls outside the ICI basenames are fine — the byte
+    # transports legitimately materialize payloads
+    from p2pfl_tpu.analysis.rules import NoHostGatherRule
+
+    src = (FIXTURES / "ici_bad" / "ici_plane.py").read_text()
+    assert analyze([], [NoHostGatherRule], sources={"weights.py": src}) == []
+
+
 def test_wire_codec_sets_are_per_directory():
     # scanning fixtures alongside a real codec must not let one shadow
     # the other (review regression: basename collisions) — the bad
@@ -231,6 +254,39 @@ def test_shipped_proto_wire_flags_vv_leak():
     assert mutated != src
     found = analyze([], [WireHeaderCompatRule], sources={"communication/proto_wire.py": mutated})
     assert any("protobuf interop codec" in f.message for f in found)
+
+
+def test_shipped_ici_flags_host_gather_reintroduced():
+    """The real weights-plane module with the contract broken in memory:
+    an innocent-looking np.asarray shape probe (the exact way the
+    zero-host-bytes promise would rot) must flag."""
+    from p2pfl_tpu.analysis.rules import NoHostGatherRule
+
+    src = _read("communication/ici.py")
+    assert analyze([], [NoHostGatherRule], sources={"communication/ici.py": src}) == []
+    needle = "    src = proto.get_address()\n"
+    mutated = src.replace(
+        needle,
+        needle + "    _shape_probe = np.asarray(jax.tree.leaves(update.params)[0])\n",
+        1,
+    )
+    assert mutated != src
+    found = analyze(
+        [], [NoHostGatherRule], sources={"communication/ici.py": mutated}
+    )
+    assert any(
+        f.rule == "no-host-gather" and "np.asarray" in f.message for f in found
+    )
+    # the glue module is in scope too
+    glue = _read("parallel/ici_plane.py")
+    assert analyze([], [NoHostGatherRule], sources={"parallel/ici_plane.py": glue}) == []
+    gneedle = "    leaves = jax.tree.leaves(tree)\n"
+    gmut = glue.replace(
+        gneedle, gneedle + "    _host = [x.tobytes() for x in leaves]\n", 1
+    )
+    assert gmut != glue
+    gfound = analyze([], [NoHostGatherRule], sources={"parallel/ici_plane.py": gmut})
+    assert any(".tobytes()" in f.message for f in gfound)
 
 
 # ---- suppression semantics ----

@@ -1,0 +1,564 @@
+"""Shard-native ICI weights plane (ISSUE 13).
+
+Runs on the 8-virtual-device CPU mesh (conftest): the pure-XLA
+``ppermute`` backend is the CPU-runnable bit-parity fallback, so the
+transfer primitive, the zero-host-bytes federation contract, per-peer
+degradation and the chaos composition are all exercised without TPU
+hardware. The Pallas remote-DMA backend shares every line of this module
+except the exchange body (``parallel/ici_plane.py``), so what is pinned
+here pins the routing/fault/telemetry machinery for both.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from p2pfl_tpu.communication import ici
+from p2pfl_tpu.communication.faults import (
+    CrashSpec,
+    EdgeFault,
+    FaultPlan,
+    install_fault_plan,
+    remove_fault_plan,
+)
+from p2pfl_tpu.communication.grpc_transport import decode_weights, encode_weights
+from p2pfl_tpu.communication.memory import MemoryRegistry
+from p2pfl_tpu.communication.message import WeightsEnvelope
+from p2pfl_tpu.learning import weights as W
+from p2pfl_tpu.learning.dataset import FederatedDataset
+from p2pfl_tpu.learning.learner import DummyLearner, JaxLearner
+from p2pfl_tpu.learning.weights import ModelUpdate
+from p2pfl_tpu.management.logger import logger
+from p2pfl_tpu.models import mlp
+from p2pfl_tpu.node import Node
+from p2pfl_tpu.parallel import ici_plane
+from p2pfl_tpu.parallel.mesh import node_slices, submesh_federation_mesh
+from p2pfl_tpu.settings import Settings, ici_backend
+from p2pfl_tpu.utils import full_connection, wait_convergence, wait_to_finish
+
+MLP_RULES = (
+    (r"Dense_0/kernel", (None, "model")),
+    (r"Dense_1/kernel", ("model", None)),
+    (r"Dense_2/kernel", (None, "model")),
+    (r".*", ()),
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    MemoryRegistry.reset()
+    ici.ShardPlaneRegistry.reset()
+    ici.reset_ici_stats()
+    logger.reset_comm_metrics()
+    W.reset_wire_stats()
+    yield
+    MemoryRegistry.reset()
+    ici.ShardPlaneRegistry.reset()
+    Settings.WEIGHTS_PLANE = "bytes"
+    Settings.WIRE_COMPRESSION = "none"
+    Settings.MEMORY_WIRE_CODEC = False
+
+
+def _sum_metric(name: str) -> int:
+    return int(
+        sum(m.get(name, 0) for m in logger.get_comm_metrics().values())
+    )
+
+
+# ---------------------------------------------------------------------------
+# transfer primitive (parallel/ici_plane.py)
+# ---------------------------------------------------------------------------
+
+
+def test_slice_info_of_shapes():
+    devs = jax.devices()
+    # single-device tree → synthesized one-device slice, replicated specs
+    tree = {"w": jax.device_put(jnp.arange(4.0), devs[3])}
+    info = ici_plane.slice_info_of(tree)
+    assert info is not None and info.shape == (1,)
+    assert info.device_ids == frozenset({devs[3].id})
+    assert all(spec == P() for spec in info.specs)
+    # placed tree → the real slice mesh + per-leaf specs
+    mesh = Mesh(np.asarray(devs[:2]), ("model",))
+    placed = {"w": jax.device_put(jnp.arange(8.0), NamedSharding(mesh, P("model")))}
+    pinfo = ici_plane.slice_info_of(placed)
+    assert pinfo is not None and pinfo.shape == (2,)
+    assert pinfo.specs == (P("model"),)
+    # host leaves → not eligible
+    assert ici_plane.slice_info_of({"w": np.arange(4.0)}) is None
+    # leaves scattered across two single devices → not eligible
+    mixed = {
+        "a": jax.device_put(jnp.arange(4.0), devs[0]),
+        "b": jax.device_put(jnp.arange(4.0), devs[1]),
+    }
+    assert ici_plane.slice_info_of(mixed) is None
+
+
+def test_shard_transfer_ppermute_bit_exact_cross_slice():
+    """The core primitive: a multi-leaf tree (fp32 + bf16, sharded +
+    replicated leaves) moves from slice A to slice B bit-exactly and
+    lands already under B's shardings."""
+    devs = jax.devices()
+    src_mesh = Mesh(np.asarray(devs[0:2]), ("model",))
+    dst_mesh = Mesh(np.asarray(devs[2:4]), ("model",))
+    specs = {"k": P("model", None), "b": P(), "h": P()}
+    tree = {
+        "k": jnp.arange(32.0).reshape(8, 4),
+        "b": jnp.ones((5,), jnp.bfloat16) * 3,
+        "h": jnp.arange(7.0),
+    }
+    src_tree = {
+        k: jax.device_put(v, NamedSharding(src_mesh, specs[k])) for k, v in tree.items()
+    }
+    filler = {
+        k: jax.device_put(jnp.zeros_like(v), NamedSharding(dst_mesh, specs[k]))
+        for k, v in tree.items()
+    }
+    src = ici_plane.slice_info_of(src_tree)
+    dst = ici_plane.slice_info_of(filler)
+    assert ici_plane.transfer_compatible(src, dst)
+    out = ici_plane.shard_transfer(src_tree, filler, src, dst, backend="ppermute")
+    dst_ids = {d.id for d in dst_mesh.devices.flat}
+    for key in tree:
+        leaf = out[key]
+        assert {d.id for d in leaf.sharding.device_set} == dst_ids
+        assert leaf.sharding == NamedSharding(dst_mesh, specs[key])
+        np.testing.assert_array_equal(
+            np.asarray(leaf, np.float32), np.asarray(tree[key], np.float32)
+        )
+
+
+def test_conform_specs_counts_moved_leaves():
+    devs = jax.devices()
+    mesh = Mesh(np.asarray(devs[0:2]), ("model",))
+    tree = {
+        "a": jax.device_put(jnp.arange(8.0), NamedSharding(mesh, P("model"))),
+        "b": jax.device_put(jnp.arange(8.0), NamedSharding(mesh, P())),
+    }
+    out, moved = ici_plane.conform_specs(tree, mesh, (P(), P()))
+    assert moved == 1  # only "a" changed layout
+    assert out["a"].sharding == NamedSharding(mesh, P())
+    assert out["b"] is tree["b"]
+    np.testing.assert_array_equal(np.asarray(out["a"]), np.asarray(tree["a"]))
+
+
+def test_tree_align_devices_fast_path_and_counter():
+    from p2pfl_tpu.ops.tree import tree_align_copy_count, tree_align_devices
+
+    devs = jax.devices()
+    a = {"w": jax.device_put(jnp.arange(4.0), devs[0])}
+    like = {"w": jax.device_put(jnp.zeros(4), devs[0])}
+    before = tree_align_copy_count()
+    out = tree_align_devices(a, like)
+    assert out is a  # fast path: the INPUT tree comes back untouched
+    assert tree_align_copy_count() == before
+    # a NamedSharding over a one-device mesh of the SAME device is
+    # placement-equivalent — still the fast path, still zero copies
+    one = Mesh(np.asarray(devs[:1]), ("x",))
+    named = {"w": jax.device_put(jnp.arange(4.0), NamedSharding(one, P()))}
+    assert tree_align_devices(named, like) is named
+    assert tree_align_copy_count() == before
+    # genuinely elsewhere → one counted copy
+    far = {"w": jax.device_put(jnp.arange(4.0), devs[1])}
+    moved = tree_align_devices(far, like)
+    assert tree_align_copy_count() == before + 1
+    assert list(moved["w"].sharding.device_set)[0] == devs[0]
+
+
+def test_ici_backend_resolver():
+    prev = Settings.ICI_BACKEND
+    try:
+        Settings.ICI_BACKEND = "auto"
+        assert ici_backend() == "ppermute"  # CPU backend in tier-1
+        Settings.ICI_BACKEND = "pallas"
+        assert ici_backend() == "pallas"
+    finally:
+        Settings.ICI_BACKEND = prev
+
+
+# ---------------------------------------------------------------------------
+# shard-resident codec composition (ops/compression.py entry points)
+# ---------------------------------------------------------------------------
+
+
+def test_shard_codec_matches_byte_codec():
+    """encode_shard_device → transfer → decode_shard_device reconstructs
+    the same tree as the byte codec's encode/decode for the same params
+    and anchor (same math, same plan, no frame)."""
+    from p2pfl_tpu.ops.compression import (
+        build_topk_plan,
+        decode_shard_device,
+        encode_shard_device,
+    )
+
+    rng = np.random.default_rng(0)
+    params = {
+        "w": jnp.asarray(rng.normal(size=200).astype(np.float32)),
+        "tiny": jnp.arange(4.0),  # under the size floor → dense int8
+        "idx": jnp.arange(6, dtype=jnp.int32),  # non-float → raw
+    }
+    anchor = {k: v * 0.99 if v.dtype.kind == "f" else v for k, v in params.items()}
+    named = dict(params)
+    plan = build_topk_plan(named, anchor, 0.05)
+    assert "w" in plan and "tiny" not in plan
+    tk, dn, payload = encode_shard_device(named, anchor, plan, None)
+    out = decode_shard_device(payload, tk, dn, anchor, named)
+    # byte-path reference through the one shared decoder
+    blob = W.encode_params(params, compression="topk8", anchor=anchor, anchor_tag="0:0")
+    ref = W.decode_params(blob, anchor=anchor, anchor_tag="0:0")
+    for key in ("w", "tiny"):
+        np.testing.assert_allclose(
+            np.asarray(out[key]), ref[key], atol=1e-6,
+            err_msg=f"shard codec diverged from byte codec at {key}",
+        )
+
+
+def test_ef_residual_folds_once_across_planes():
+    """Review regression: when BOTH planes encode the same update content
+    (mixed fleet — ICI peers plus a byte-fallback peer cache under
+    different keys), the error-feedback residual must fold exactly once;
+    whichever plane encodes first owns the fold and the other goes
+    residual-free instead of re-applying the just-written carry."""
+    from p2pfl_tpu.learning.weights import PayloadCache
+
+    cache = PayloadCache(owner="me")
+    key = (3, 1, "topk8", "0:1")
+    assert cache.ef_fold_once(key) is True    # first encoder owns the fold
+    assert cache.ef_fold_once(key) is False   # later encoders go residual-free
+    assert cache.ef_fold_once((3, 2, "topk8", "0:2")) is True  # new content re-arms
+
+    # end to end: a byte encode of content the ICI plane already claimed
+    # leaves the residual store untouched
+    Settings.WIRE_COMPRESSION = "topk8"
+    rng = np.random.default_rng(0)
+    params = {"w": jnp.asarray(rng.normal(size=200).astype(np.float32))}
+    anchor = {"w": params["w"] * 0.99}
+    update = ModelUpdate(dict(params), ["me"], 1)
+    update.anchor = anchor
+    update.anchor_tag = "0:1"
+    update.ef_residual = {"w": jnp.full((200,), 0.5, jnp.float32)}
+    update.payload_cache = cache
+    update.cache_version = 3
+    update.cache_round = 1  # → fold key (3, 1, "topk8", "0:1"), claimed above
+    update.encode()
+    np.testing.assert_allclose(np.asarray(update.ef_residual["w"]), 0.5)
+
+    # unclaimed content still folds normally (the carry gets rewritten)
+    fresh = ModelUpdate(dict(params), ["me"], 1)
+    fresh.anchor = anchor
+    fresh.anchor_tag = "0:1"
+    fresh.ef_residual = {"w": jnp.full((200,), 0.5, jnp.float32)}
+    fresh.payload_cache = cache
+    fresh.cache_version = 4
+    fresh.cache_round = 1
+    fresh.encode()
+    assert not np.allclose(np.asarray(fresh.ef_residual["w"]), 0.5)
+
+
+# ---------------------------------------------------------------------------
+# federations: zero host bytes, parity, degradation, chaos
+# ---------------------------------------------------------------------------
+
+
+def _mlp_fleet(n, placed=False, seed_base=0):
+    full = FederatedDataset.synthetic_mnist(n_train=n * 64, n_test=64, seed=0)
+    slices = None
+    if placed:
+        gm = submesh_federation_mesh(n, model_parallel=2, devices=jax.devices()[: n * 2])
+        slices = node_slices(gm)
+    nodes = []
+    for i in range(n):
+        kw = (
+            dict(mesh=slices[i], partition_rules=MLP_RULES) if placed else {}
+        )
+        learner = JaxLearner(
+            mlp(seed=seed_base + i), full.partition(i, n), batch_size=16,
+            seed=seed_base + i, **kw,
+        )
+        nodes.append(Node(learner=learner))
+    for node in nodes:
+        node.start()
+    for node in nodes:
+        full_connection(node, nodes)
+    wait_convergence(nodes, n - 1, only_direct=True, wait=15)
+    return nodes
+
+
+def _run_fleet(nodes, rounds=1, epochs=1, timeout=90):
+    nodes[0].set_start_learning(rounds=rounds, epochs=epochs)
+    wait_to_finish(nodes, timeout=timeout)
+
+
+def _params_of(nodes):
+    return [
+        [np.asarray(x) for x in jax.tree.leaves(n.learner.get_parameters())]
+        for n in nodes
+    ]
+
+
+def _stop_all(nodes):
+    for n in nodes:
+        n.stop()
+
+
+def test_ici_federation_zero_host_bytes_and_parity():
+    """The acceptance contract: a co-located federation round under
+    WEIGHTS_PLANE="ici" diffuses the model with ZERO host payload bytes
+    (wire/d2h counters flat, zero encode-pipeline runs), zero fallbacks,
+    zero alignment fix-ups — and lands on the same parameters as the
+    memory-transport baseline on the same seed."""
+    nodes = _mlp_fleet(3)
+    try:
+        _run_fleet(nodes, rounds=2)
+        baseline = _params_of(nodes)
+    finally:
+        _stop_all(nodes)
+    MemoryRegistry.reset()
+    ici.ShardPlaneRegistry.reset()
+
+    Settings.WEIGHTS_PLANE = "ici"
+    nodes = _mlp_fleet(3)
+    try:
+        W.reset_wire_stats()
+        enc0 = W.encode_call_count()
+        _run_fleet(nodes, rounds=2)
+        stats = ici.ici_stats()
+        wire = W.wire_stats()
+        assert stats["shard_sends"] > 0
+        assert stats["fallback_bytes"] == 0
+        assert stats["align_violations"] == 0
+        # single-chip co-resident fleet: handoffs are zero-copy, so the
+        # interconnect byte counter honestly stays at zero
+        assert stats["bytes_moved"] == 0
+        # ZERO model-plane bytes over the host: no encode pipeline ran,
+        # no payload/D2H bytes counted anywhere in the process
+        assert W.encode_call_count() == enc0
+        assert wire["payload_bytes"] == 0 and wire["d2h_bytes"] == 0
+        assert _sum_metric("ici_send_shard") == stats["shard_sends"]
+        # receiver-side alignment stayed the no-op the plane asserts
+        assert _sum_metric("tree_align_copies") == 0
+        # within the ICI run, the fleet converges on one model — the
+        # strong per-run statement, immune to cross-run gossip timing
+        params = _params_of(nodes)
+        for other in params[1:]:
+            for x, y in zip(params[0], other):
+                np.testing.assert_allclose(x, y, atol=1e-5)
+        # bit-close to the memory-transport baseline: gossip fold order
+        # is arrival-order dependent, so two runs of the SAME transport
+        # already differ by summation-order noise — this tolerance is
+        # that cross-run floor, far under any codec/transport error
+        for a, b in zip(baseline, params):
+            for x, y in zip(a, b):
+                np.testing.assert_allclose(x, y, atol=1e-3)
+    finally:
+        _stop_all(nodes)
+
+
+def test_ici_cross_slice_placed_federation():
+    """Submesh-placed learners on DISJOINT 2-device slices: the weights
+    plane moves real shards via the ppermute pair program — zero host
+    bytes, zero fallbacks, parameters matching the bytes baseline."""
+    nodes = _mlp_fleet(2, placed=True)
+    try:
+        _run_fleet(nodes, rounds=2)
+        baseline = _params_of(nodes)
+    finally:
+        _stop_all(nodes)
+    MemoryRegistry.reset()
+    ici.ShardPlaneRegistry.reset()
+
+    Settings.WEIGHTS_PLANE = "ici"
+    nodes = _mlp_fleet(2, placed=True)
+    try:
+        W.reset_wire_stats()
+        _run_fleet(nodes, rounds=2)
+        stats = ici.ici_stats()
+        wire = W.wire_stats()
+        assert stats["shard_sends"] > 0 and stats["fallback_bytes"] == 0
+        assert stats["align_violations"] == 0
+        # disjoint slices: real shards crossed the (virtual) interconnect
+        assert stats["bytes_moved"] > 0
+        assert wire["payload_bytes"] == 0 and wire["d2h_bytes"] == 0
+        for a, b in zip(baseline, _params_of(nodes)):
+            for x, y in zip(a, b):
+                np.testing.assert_allclose(x, y, atol=1e-3)
+    finally:
+        _stop_all(nodes)
+
+
+def test_ici_topk8_codec_end_to_end_on_device():
+    """WIRE_COMPRESSION="topk8" composes with the plane: the device
+    codec's buffers move shard-to-shard and reconstruct against the
+    receiver's anchor — still zero host payload bytes, and bit-close to
+    the BYTE-path (MEMORY_WIRE_CODEC) baseline running the same codec."""
+    Settings.WIRE_COMPRESSION = "topk8"
+    Settings.MEMORY_WIRE_CODEC = True
+    nodes = _mlp_fleet(2)
+    try:
+        _run_fleet(nodes, rounds=2)
+        baseline = _params_of(nodes)
+    finally:
+        _stop_all(nodes)
+    MemoryRegistry.reset()
+    ici.ShardPlaneRegistry.reset()
+
+    Settings.MEMORY_WIRE_CODEC = False
+    Settings.WEIGHTS_PLANE = "ici"
+    nodes = _mlp_fleet(2)
+    try:
+        W.reset_wire_stats()
+        _run_fleet(nodes, rounds=2)
+        stats = ici.ici_stats()
+        wire = W.wire_stats()
+        assert stats["shard_sends"] > 0
+        assert stats["align_violations"] == 0
+        assert wire["payload_bytes"] == 0 and wire["d2h_bytes"] == 0
+        # the codec is lossy (topk8), so parity is codec-tolerance close,
+        # not bit-equal — the same budget the byte path grants itself
+        for a, b in zip(baseline, _params_of(nodes)):
+            for x, y in zip(a, b):
+                np.testing.assert_allclose(x, y, atol=5e-2)
+    finally:
+        _stop_all(nodes)
+
+
+def test_ici_mixed_fleet_falls_back_per_peer():
+    """Transport selection + degradation (ISSUE 13 satellite): a 3-node
+    fleet where one peer is NOT on the shard plane must complete the
+    round with per-peer byte fallback — loudly counted, never aborted —
+    and the fallback frames must carry the "sp" handshake header through
+    the real byte path."""
+    Settings.WEIGHTS_PLANE = "ici"
+    Settings.MEMORY_WIRE_CODEC = True  # fallback = the REAL byte path
+    nodes = _mlp_fleet(3)
+    outsider = nodes[-1]
+    # the outsider never joined the shard plane (models another process /
+    # another fabric) — its edges must ride bytes in both directions
+    ici.ShardPlaneRegistry.unregister(outsider.addr)
+    seen_sp = []
+    orig_handle = outsider.protocol.handle_weights
+
+    def spy_handle(env):
+        seen_sp.append(env.update.sp)
+        return orig_handle(env)
+
+    outsider.protocol.handle_weights = spy_handle
+    try:
+        _run_fleet(nodes, rounds=1)
+        stats = ici.ici_stats()
+        assert stats["shard_sends"] > 0, "co-located pair stopped using the plane"
+        assert stats["fallback_bytes"] > 0, "outsider edges never fell back"
+        assert _sum_metric("ici_fallback_bytes") == stats["fallback_bytes"]
+        # every node finished the round — degradation, not abort
+        for n in nodes:
+            assert n.state.round is None
+        # the byte-path frames advertised the sender's slice topology
+        # (and the memory byte path copied the optional header through)
+        assert any(sp is not None and tuple(sp[0]) == (1,) for sp in seen_sp)
+        # params converged across ALL nodes, outsider included
+        params = _params_of(nodes)
+        for a, b in zip(params[0], params[-1]):
+            np.testing.assert_allclose(a, b, atol=1e-3)
+    finally:
+        _stop_all(nodes)
+
+
+def test_ici_chaos_drop_slow_crash_federation():
+    """The chaos suite composes with the plane: 6 nodes under 5% drop,
+    a slow peer and a mid-round crash, weights riding ICI. Survivors
+    finish every round via train-set repair, fault verdicts land on ICI
+    edges (the injector wraps the plane at the _do_send seam), and the
+    corpse's edges fail like any dead peer's."""
+    Settings.WEIGHTS_PLANE = "ici"
+    Settings.TRAIN_SET_SIZE = 6
+    Settings.AGGREGATION_TIMEOUT = 60.0
+    nodes = [Node(learner=DummyLearner(value=float(i))) for i in range(6)]
+    for node in nodes:
+        node.start()
+    for node in nodes:
+        full_connection(node, nodes)
+    wait_convergence(nodes, 5, only_direct=True, wait=10)
+    victim, slow = nodes[3], nodes[-1]
+    plan = FaultPlan(
+        seed=1905,
+        default=EdgeFault(drop=0.05),
+        slow_nodes={slow.addr: 0.2},
+        crashes={victim.addr: CrashSpec(stage="TrainStage", round_no=0)},
+    )
+    install_fault_plan(nodes, plan)
+    survivors = [n for n in nodes if n is not victim]
+    try:
+        t0 = time.monotonic()
+        nodes[0].set_start_learning(rounds=2, epochs=1)
+        wait_to_finish(survivors, timeout=45)
+        assert time.monotonic() - t0 < 45.0
+        assert not victim._running
+        stats = ici.ici_stats()
+        assert stats["shard_sends"] > 0, "chaos federation never used the plane"
+        # the injector saw the ICI sends: drop verdicts were exercised on
+        # weights-plane envelopes too (scope="both" default)
+        assert _sum_metric("fault_drop") > 0
+        assert _sum_metric("train_set_repair") >= 1
+        for n in survivors:
+            assert n.state.round is None
+        params = [np.asarray(n.learner.get_parameters()["w"]) for n in survivors]
+        for p in params[1:]:
+            np.testing.assert_allclose(p, params[0], atol=1e-5)
+    finally:
+        remove_fault_plan(nodes)
+        _stop_all(nodes)
+
+
+def test_ici_dead_peer_fails_send_like_bytes():
+    """A crashed peer's ICI sends must FAIL (feeding breakers/eviction),
+    not fall back or hang — same signals as the byte path."""
+    Settings.WEIGHTS_PLANE = "ici"
+    nodes = [Node(learner=DummyLearner(value=float(i))) for i in range(2)]
+    for n in nodes:
+        n.start()
+    full_connection(nodes[0], nodes)
+    wait_convergence(nodes, 1, only_direct=True, wait=10)
+    try:
+        from p2pfl_tpu.communication.faults import hard_crash
+
+        hard_crash(nodes[1])
+        update = nodes[0].learner.get_model_update()
+        env = nodes[0].protocol.build_weights("add_model", 0, update)
+        assert nodes[0].protocol._send_to_neighbor(nodes[1].addr, env) is False
+        assert ici.ici_stats()["shard_sends"] == 0
+    finally:
+        _stop_all(nodes)
+
+
+# ---------------------------------------------------------------------------
+# the "sp" wire header (handshake satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_sp_header_codec_roundtrip_and_absent_frame():
+    update = ModelUpdate(
+        params=None, contributors=["a"], num_samples=3, encoded=b"\x00payload",
+        sp=((2, 2), 1, "topk8"),
+    )
+    env = WeightsEnvelope("src", 4, "add_model", update)
+    out = decode_weights(encode_weights(env))
+    assert out.update.sp == ((2, 2), 1, "topk8")
+    # absent frame (old sender) decodes unchanged — no key, None field
+    old = ModelUpdate(params=None, contributors=["a"], num_samples=3, encoded=b"\x00p")
+    out2 = decode_weights(encode_weights(WeightsEnvelope("src", 1, "add_model", old)))
+    assert out2.update.sp is None
+
+
+def test_sp_header_never_in_protobuf_interop():
+    import ast as _ast
+    import inspect
+
+    from p2pfl_tpu.communication import proto_wire
+
+    tree = _ast.parse(inspect.getsource(proto_wire))
+    for node in _ast.walk(tree):
+        assert not (isinstance(node, _ast.Constant) and node.value == "sp")
