@@ -30,6 +30,14 @@ fleet under a seeded elastic-churn plan — 5% leaves (graceful + abrupt),
 fleet, so the disruption cost of membership churn is a measured
 time-to-target ratio, not a claim.
 
+The ``byzantine_1k`` section (ISSUE 14) sweeps ADVERSARIES instead of
+failures: 5/10/20% of the fleet running ``ByzantineSpec`` attacks
+(sign-flip, scale, noise) against the hierarchical plane, defense off
+(the FedBuff weighted mean folds whatever arrives) vs on
+(``ASYNC_ROBUST_AGG="trimmed-mean"`` + the admission screen +
+suspicion-EWMA quarantine) — time-to-target, final loss, and how many
+attackers the eviction machinery removed, per cell.
+
 Usage: ``JAX_PLATFORMS=cpu python bench_async.py [--smoke] [--out BENCH_ASYNC.json]``
 """
 
@@ -285,6 +293,95 @@ def run_simulated(n: int = 1000, updates: int = 6, smoke: bool = False) -> dict:
     }
 
 
+def run_byzantine(n: int = 1000, updates: int = 6, smoke: bool = False) -> dict:
+    """ISSUE 14: the cost of lying nodes, and what the defenses buy back.
+
+    Every cell is the same seeded 1k-node hierarchical consensus fleet
+    (cluster 32, K=4) with ``frac`` of the members armed with one
+    ``ByzantineSpec`` attack, driven twice: defenses OFF (the stock
+    FedBuff weighted merge — one poisoned update lands at full staleness
+    weight) and ON (``ASYNC_ROBUST_AGG="trimmed-mean"`` + the admission
+    screen whose suspicion EWMA drives quarantine-by-eviction). Replay
+    is bit-exact per cell — the attack rides the plan's per-edge streams.
+    """
+    from p2pfl_tpu.communication.faults import ByzantineSpec, FaultPlan
+    from p2pfl_tpu.federation.simfleet import SimulatedAsyncFleet
+    from p2pfl_tpu.settings import Settings
+
+    if smoke:
+        n, updates = 100, 4
+    fracs = [0.10] if smoke else [0.05, 0.10, 0.20]
+    kinds = ["sign_flip"] if smoke else ["sign_flip", "scale", "noise"]
+    cluster = 32
+
+    def make_fleet():
+        return SimulatedAsyncFleet(
+            n, seed=SEED, cluster_size=cluster, updates_per_node=updates,
+            local_lr=0.7,
+        )
+
+    probe = make_fleet()
+    dim = len(np.asarray(probe.nodes["sim-0000"].model["w"]))
+    start_loss = float(probe.loss_fn({"w": np.zeros(dim, np.float32)}))
+    target = start_loss * 0.05
+
+    old = (Settings.BYZ_SCREEN, Settings.ASYNC_ROBUST_AGG)
+    rows = []
+    try:
+        for kind in kinds:
+            for frac in fracs:
+                stride = max(1, int(round(1 / frac)))
+                attackers = {
+                    f"sim-{i:04d}": ByzantineSpec(kind=kind, lam=10.0, noise_std=20.0)
+                    for i in range(0, n, stride)
+                }
+                cell = {"kind": kind, "attacker_frac": frac, "attackers": len(attackers)}
+                for defend in (False, True):
+                    Settings.BYZ_SCREEN = defend
+                    Settings.ASYNC_ROBUST_AGG = "trimmed-mean" if defend else "fedavg"
+                    fleet = make_fleet()
+                    fleet.plan = FaultPlan(seed=SEED, byzantine=attackers)
+                    fleet.target_loss = target
+                    res = fleet.run()
+                    final = res.final_loss()
+                    cell["defended" if defend else "undefended"] = {
+                        "time_to_target_s": round(res.time_to_target, 3)
+                        if res.time_to_target
+                        else None,
+                        # a scale attack through the undefended mean can
+                        # blow the consensus to inf: keep the JSON strict
+                        "final_loss": round(final, 5) if np.isfinite(final) else None,
+                        "diverged": not np.isfinite(final),
+                        "merges": res.merges,
+                        "corrupted_payloads": res.byz_corrupted,
+                        "screen_rejects": res.screen_rejects,
+                        "quarantined": len(res.quarantined),
+                    }
+                log(json.dumps(cell))
+                rows.append(cell)
+    finally:
+        Settings.BYZ_SCREEN, Settings.ASYNC_ROBUST_AGG = old
+
+    return {
+        "n_nodes": n,
+        "updates_per_node": updates,
+        "cluster_size": cluster,
+        "start_loss": round(start_loss, 5),
+        "target_loss": round(target, 5),
+        "attack": {"lam": 10.0, "noise_std": 20.0, "seed": SEED},
+        "defense_on": {
+            "robust_agg": "trimmed-mean",
+            "screen": {
+                "norm_gate": 4.0,
+                "cos_gate": 0.5,
+                "suspicion_beta": 0.5,
+                "suspicion_threshold": 0.7,
+            },
+        },
+        "rows": rows,
+    }
+
+
 def run_churn(n: int = 1000, updates: int = 6, smoke: bool = False) -> dict:
     """ISSUE 11: the disruption cost of elastic churn as a number.
 
@@ -394,6 +491,9 @@ def main() -> int:
     log("=== churn 1k ===")
     churn = run_churn(smoke=smoke)
 
+    log("=== byzantine 1k ===")
+    byzantine = run_byzantine(smoke=smoke)
+
     doc = {
         "bench": "async_federation_time_to_accuracy",
         "fleet": {
@@ -408,6 +508,7 @@ def main() -> int:
         "threaded": rows,
         "simulated_1k": simulated,
         "churn_1k": churn,
+        "byzantine_1k": byzantine,
         "smoke": smoke,
     }
     with open(out_path, "w") as f:

@@ -23,7 +23,12 @@ Both weights handlers drop (never stop the node) on malformed payloads:
 an async fleet is long-running by design, and one garbage frame from a
 flaky peer must not take an *aggregator* down with it — the sync plane's
 stop-on-decode-failure matches its initiator-seeded trust model, not this
-one. Drops are loud (``async_decode_fail`` metric + error log).
+one. Drops are loud (``async_decode_fail`` metric + error log). The
+``async_pull``/``async_view`` control verbs hold the same contract
+(``async_ctl_malformed``): a pull or view carrying a weights payload, a
+view missing its member lists, or any frame whose handling raises is
+dropped and counted, never allowed to feed the topology derivation or
+unwind the serving thread.
 """
 
 from __future__ import annotations
@@ -56,11 +61,13 @@ def drain_async_stash(node: "Node", ctx) -> None:
     """Feed every stashed early async_update into the context — the ONE
     drain routine (the workflow's post-install drain and the command
     side's race-close both call it; ``take_async_stash`` pops atomically,
-    so each entry is processed exactly once whichever side wins)."""
-    for early in node.take_async_stash():
+    so each entry is processed exactly once whichever side wins). Entries
+    carry their delivering peer so the Byzantine screen attributes a
+    stashed poison exactly like a direct delivery."""
+    for early, src in node.take_async_stash():
         early = materialize_or_drop(node, early, "async_update(stash)")
         if early is not None:
-            ctx.execute_actions(ctx.handle_update(early))
+            ctx.execute_actions(ctx.handle_update(early, source=src))
 
 
 class AsyncUpdateCommand(Command):
@@ -82,7 +89,7 @@ class AsyncUpdateCommand(Command):
                 # creation (it is still in init gossip / topology
                 # derivation): stash for the workflow to drain — the async
                 # twin of the early-init stash
-                node.stash_async_update(update)
+                node.stash_async_update(update, source)
                 logger.log_comm_metric(node.addr, "async_update_stashed")
                 # close the install race: if the context landed between our
                 # None-read and the stash append, the workflow's one-shot
@@ -102,8 +109,11 @@ class AsyncUpdateCommand(Command):
             return
         # handlers run on whatever thread delivered the message; the
         # context computes under its locks and returns the sends, which
-        # run here OUTSIDE every lock (deadlock contract — workflow docs)
-        ctx.execute_actions(ctx.handle_update(update))
+        # run here OUTSIDE every lock (deadlock contract — workflow docs).
+        # source rides along for the Byzantine screen's attribution: a
+        # poisoned payload indicts its DELIVERER, not the (attacker-
+        # controlled) origin named in its version triple
+        ctx.execute_actions(ctx.handle_update(update, source=source))
 
 
 class AsyncModelCommand(Command):
@@ -188,6 +198,24 @@ class AsyncPullCommand(Command):
 
     def execute(self, source: str, round: int, *args, **kwargs) -> None:  # noqa: A002
         node = self._node
+        if kwargs.get("update") is not None:
+            # a weights frame hijacking a control verb (fuzzed/garbage
+            # wire input): drop loudly — parity with async_update's
+            # decode-or-drop, a long-running fleet must absorb it
+            logger.log_comm_metric(node.addr, "async_ctl_malformed")
+            logger.error(
+                node.addr,
+                f"async_pull from {source} carried a weights payload — dropped",
+            )
+            return
+        try:
+            self._serve(source)
+        except Exception as exc:  # noqa: BLE001 — one garbage frame must not kill a serving node
+            logger.log_comm_metric(node.addr, "async_ctl_malformed")
+            logger.error(node.addr, f"async_pull from {source} failed: {exc!r} — dropped")
+
+    def _serve(self, source: str) -> None:
+        node = self._node
         ctx = node.async_ctx
         if ctx is not None and ctx.accepting:
             logger.log_comm_metric(node.addr, "async_pull_served")
@@ -233,14 +261,27 @@ class AsyncViewCommand(Command):
 
     def execute(self, source: str, round: int, *args, **kwargs) -> None:  # noqa: A002
         node = self._node
+        if kwargs.get("update") is not None or len(args) < 2:
+            # missing member/dead lists, or a weights frame hijacking the
+            # verb: a malformed view must not feed the topology derivation
+            # (and must not kill the node) — drop loudly, parity with
+            # async_update's decode-or-drop
+            logger.log_comm_metric(node.addr, "async_ctl_malformed")
+            logger.error(node.addr, f"malformed async_view from {source} — dropped")
+            return
         ctx = node.async_ctx
         if ctx is None or not ctx.accepting:
             return
         if xp_mismatch(node.addr, kwargs.get("xp"), node.state.experiment_xid):
             return
-        members = [m for m in (args[0] if args else "").split(";") if m]
-        dead = [d for d in (args[1] if len(args) > 1 else "").split(";") if d]
-        ctx.execute_actions(ctx.merge_view(members, dead))
+        try:
+            members = [m for m in str(args[0]).split(";") if m]
+            dead = [d for d in str(args[1]).split(";") if d]
+            ctx.execute_actions(ctx.merge_view(members, dead))
+        except Exception as exc:  # noqa: BLE001 — one garbage frame must not kill a serving node
+            logger.log_comm_metric(node.addr, "async_ctl_malformed")
+            logger.error(node.addr, f"async_view from {source} failed: {exc!r} — dropped")
+            return
         if ctx.accepting and ctx.take_stash_dirty():
             drain_async_stash(node, ctx)
 

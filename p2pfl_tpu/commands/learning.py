@@ -186,7 +186,9 @@ class AddModelCommand(Command):
         try:
             if update.params is None:
                 update = node.learner.materialize(update)
-            covered = node.aggregator.add_model(update)
+            # source = the delivering peer, for Byzantine screen
+            # attribution (gossip relays other nodes' models verbatim)
+            covered = node.aggregator.add_model(update, source=source)
         except AnchorMismatchError as exc:
             # a delta-coded payload against an anchor we don't hold (we are
             # a round behind/ahead of the sender): skip it and wait for one

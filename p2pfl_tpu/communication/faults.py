@@ -16,7 +16,13 @@ inject declaratively:
   latency — the straggler the gossip send budget exists for),
 - **crash-at-stage** hooks (:class:`CrashSpec`): a node hard-crashes — no
   goodbye messages, exactly like a killed process — when its learning
-  thread enters a named stage at a given round.
+  thread enters a named stage at a given round,
+- **Byzantine attackers** (:class:`ByzantineSpec`): a node's model
+  payloads are corrupted at the same send seam — sign-flip, scale-by-λ,
+  Gaussian noise, stale replay, per-edge equivocation — while its
+  control plane stays perfectly healthy: the node lies, it does not
+  stop, so only semantic defenses (robust merge kernels + the admission
+  screen, ``federation/defense.py``) can catch it.
 
 Determinism: every directed edge draws from its own
 ``random.Random(f"{seed}:{src}->{dst}")`` stream, so the k-th send on an
@@ -49,6 +55,8 @@ import threading
 import time
 from dataclasses import dataclass
 from typing import TYPE_CHECKING, Callable, Iterable, Optional
+
+import numpy as np
 
 from p2pfl_tpu.communication.message import Message, WeightsEnvelope
 from p2pfl_tpu.management.logger import logger
@@ -95,6 +103,45 @@ class CrashSpec:
     stage: str
     round_no: Optional[int] = 0
     after_s: float = 0.0
+
+
+@dataclass(frozen=True)
+class ByzantineSpec:
+    """A node that keeps talking and LIES: every model payload it sends is
+    corrupted at the ``_do_send`` seam before it reaches the wire.
+
+    The chaos taxonomy's other specs model nodes that *stop* (crash, drop,
+    delay); this one models the production failure Bonawitz et al. rank
+    worst — a semantically wrong participant that no liveness machinery
+    ever notices. Attack kinds:
+
+    - ``"sign_flip"`` — sends ``−params`` (gradient-ascent poisoning);
+    - ``"scale"`` — sends ``lam × params`` (model-boost / scaling attack);
+    - ``"noise"`` — sends ``params + N(0, noise_std)`` (fresh per send);
+    - ``"stale_replay"`` — re-sends its FIRST payload forever, stamped
+      with the CURRENT version triple (a semantic lie the version-vector
+      dedup and staleness bound cannot catch — the triple is fresh);
+    - ``"equivocate"`` — sends a DIFFERENT corruption to each peer
+      (per-edge scale drawn from the edge's own stream), the classic
+      split-view attack against aggregators that compare contributions.
+
+    Determinism: corruption draws ride dedicated per-edge streams
+    (``FaultPlan.byz_rng`` — ``f"{seed}:byz:{src}->{dst}"``), separate
+    from the drop/duplicate verdict streams so arming an attack never
+    shifts any existing fault verdict; the k-th payload on an edge is
+    corrupted identically on every run. ``cmds`` bounds the blast radius
+    to contribution payloads (an attacker's ``init_model`` or global
+    pushes would model a hostile *initiator/root*, a different threat).
+
+    The ORIGINAL update is never mutated — in-process transports pass
+    payloads by reference, and the attacker's own learner (and other
+    edges' deliveries) must keep the honest object.
+    """
+
+    kind: str = "sign_flip"
+    lam: float = 10.0           # scale factor for "scale" (and the
+    noise_std: float = 1.0      # equivocate magnitude bound) / noise σ
+    cmds: tuple = ("async_update", "add_model")
 
 
 @dataclass(frozen=True)
@@ -154,6 +201,7 @@ class FaultPlan:
         crashes: Optional[dict[str, CrashSpec]] = None,
         joins: Optional[dict[str, "JoinSpec"]] = None,
         leaves: Optional[dict[str, "LeaveSpec"]] = None,
+        byzantine: Optional[dict[str, "ByzantineSpec"]] = None,
     ) -> None:
         self.seed = seed
         self.default = default
@@ -164,10 +212,16 @@ class FaultPlan:
         #: churn events (elastic membership): addr -> JoinSpec / LeaveSpec
         self.joins = dict(joins or {})
         self.leaves = dict(leaves or {})
+        #: adversaries: attacker addr -> ByzantineSpec
+        self.byzantine = dict(byzantine or {})
         self._rngs: dict[tuple[str, str], random.Random] = {}
+        self._byz_rngs: dict[tuple[str, str], random.Random] = {}
         self._rng_lock = threading.Lock()
         #: crash specs already fired (addr) — a spec fires exactly once
         self._crashed: set[str] = set()
+        #: stale_replay capture: attacker addr -> its first payload's
+        #: params (host numpy copy), taken at its first corrupted send
+        self._byz_stale: dict[str, object] = {}
 
     # ---- per-edge state ----
 
@@ -178,6 +232,16 @@ class FaultPlan:
             r = self._rngs.get(key)
             if r is None:
                 r = self._rngs[key] = random.Random(f"{self.seed}:{src}->{dst}")
+            return r
+
+    def byz_rng(self, src: str, dst: str) -> random.Random:
+        """The directed edge's Byzantine-corruption stream — separate from
+        :meth:`rng` so arming an attack never shifts drop/dup verdicts."""
+        key = (src, dst)
+        with self._rng_lock:
+            r = self._byz_rngs.get(key)
+            if r is None:
+                r = self._byz_rngs[key] = random.Random(f"{self.seed}:byz:{src}->{dst}")
             return r
 
     def edge_fault(self, src: str, dst: str) -> EdgeFault:
@@ -227,6 +291,28 @@ class FaultInjector:
                 self.src, "fault_slow", attrs={"peer": nei, "delay_s": slow}
             )
             time.sleep(slow)
+        # corruption runs BEFORE the edge fault's scope gate: a Byzantine
+        # attacker and (say) a control-scoped drop fault are independent
+        # dimensions of one plan, and an applies_to short-circuit must not
+        # silently disarm the attack (the simulator corrupts before its
+        # edge verdict for the same reason — one seam, one behavior)
+        if plan.byzantine and isinstance(env, WeightsEnvelope):
+            bad = byz_corrupt_update(plan, self.src, nei, env.update, env.cmd)
+            if bad is not None:
+                logger.log_comm_metric(self.src, "fault_byzantine")
+                telemetry.event(
+                    self.src,
+                    "fault_byzantine",
+                    attrs={
+                        "peer": nei,
+                        "cmd": cmd,
+                        "kind": plan.byzantine[self.src].kind,
+                    },
+                )
+                env = WeightsEnvelope(
+                    env.source, env.round, env.cmd, bad,
+                    trace_ctx=env.trace_ctx, xp=env.xp,
+                )
         fault = plan.edge_fault(self.src, nei)
         if not fault.applies_to(env):
             return transport_send(nei, env, create_connection=create_connection)
@@ -281,6 +367,85 @@ def _deliver_copy(transport_send, nei, env, create_connection) -> None:
         transport_send(nei, env, create_connection=create_connection)
     except Exception:  # noqa: BLE001 — the node may have stopped meanwhile
         pass
+
+
+# ---- Byzantine corruption ----
+
+
+def _tree_map_np(params: object, fn: Callable) -> object:
+    """Apply ``fn`` to every floating leaf as a host fp32 numpy array,
+    casting back to the leaf dtype; non-float leaves pass through. Always
+    returns NEW arrays — corruption must never alias the honest pytree."""
+    import jax
+
+    def one(x):
+        arr = np.asarray(x)
+        if not (np.issubdtype(arr.dtype, np.floating) or arr.dtype.kind == "V"):
+            # "V" covers ml_dtypes (bfloat16) which numpy reports as void-kind
+            # on some versions; astype below validates either way
+            return np.array(arr, copy=True)
+        try:
+            f32 = arr.astype(np.float32)
+        except (TypeError, ValueError):
+            return np.array(arr, copy=True)
+        return fn(f32).astype(arr.dtype)
+
+    return jax.tree.map(one, params)
+
+
+def byz_corrupt_update(plan: FaultPlan, src: str, dst: str, update, cmd: str):
+    """The corrupted COPY of ``update`` an attacker ``src`` ships to
+    ``dst``, or None when no corruption applies (no spec, wrong command,
+    or a byte-only payload with no materialized params to lie about).
+
+    Shared by both drivers — the live :class:`FaultInjector` and the
+    simulator's virtual wire call exactly this, so a plan's attack
+    replays identically at whichever seam delivers it. Deterministic:
+    draws ride :meth:`FaultPlan.byz_rng`'s per-edge stream, advanced once
+    per corrupted payload.
+    """
+    spec = plan.byzantine.get(src)
+    if spec is None or cmd not in spec.cmds:
+        return None
+    params = getattr(update, "params", None)
+    if params is None:
+        return None
+    rng = plan.byz_rng(src, dst)
+    kind = spec.kind
+    if kind == "sign_flip":
+        corrupted = _tree_map_np(params, lambda a: -a)
+    elif kind == "scale":
+        lam = float(spec.lam)
+        corrupted = _tree_map_np(params, lambda a: lam * a)
+    elif kind == "noise":
+        g = np.random.default_rng(rng.getrandbits(32))
+        std = float(spec.noise_std)
+        corrupted = _tree_map_np(
+            params, lambda a: a + g.normal(0.0, std, a.shape).astype(np.float32)
+        )
+    elif kind == "stale_replay":
+        with plan._rng_lock:
+            stale = plan._byz_stale.get(src)
+            if stale is None:
+                stale = plan._byz_stale[src] = _tree_map_np(params, lambda a: a)
+        # fresh copies per send: receivers must never share the capture
+        corrupted = _tree_map_np(stale, lambda a: a)
+    elif kind == "equivocate":
+        # a DIFFERENT lie per edge per send: sign and magnitude from the
+        # edge's own stream, so no two peers (and no two sends) agree
+        s = (-1.0 if rng.random() < 0.5 else 1.0) * rng.uniform(1.0, max(spec.lam, 1.0))
+        corrupted = _tree_map_np(params, lambda a: np.float32(s) * a)
+    else:
+        raise ValueError(f"unknown ByzantineSpec kind {kind!r}")
+    from p2pfl_tpu.learning.weights import ModelUpdate
+
+    bad = ModelUpdate(corrupted, list(update.contributors), update.num_samples)
+    bad.version = update.version
+    bad.xp = update.xp
+    # topk8 delta coding needs the round anchor to re-encode the lie
+    bad.anchor = update.anchor
+    bad.anchor_tag = update.anchor_tag
+    return bad
 
 
 # ---- crash machinery ----

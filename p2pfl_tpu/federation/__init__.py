@@ -27,10 +27,15 @@ the speed of the **median** instead:
   FaultPlan, retries, breakers and telemetry wrap it for free);
 - :mod:`~p2pfl_tpu.federation.simfleet` — a deterministic event-driven
   fleet simulator (1k–10k virtual nodes, virtual clock) for scale drives
-  and bit-identical replay tests.
+  and bit-identical replay tests;
+- :mod:`~p2pfl_tpu.federation.defense` — Byzantine defense-in-depth:
+  the per-contribution admission screen, the per-origin suspicion EWMA
+  and the quarantine hook into the existing eviction path (robust merge
+  kernels live in ``ops/aggregation``).
 """
 
 from p2pfl_tpu.federation.buffer import BufferedAggregator
+from p2pfl_tpu.federation.defense import ByzantineDefense
 from p2pfl_tpu.federation.routing import BufferPlan, TierRouter, VersionHighWater
 from p2pfl_tpu.federation.simfleet import FleetResult, SimulatedAsyncFleet
 from p2pfl_tpu.federation.staleness import UpdateVersion, VersionVector, staleness_weight
@@ -41,6 +46,7 @@ __all__ = [
     "AsyncLearningWorkflow",
     "BufferPlan",
     "BufferedAggregator",
+    "ByzantineDefense",
     "FleetResult",
     "HierarchicalTopology",
     "SimulatedAsyncFleet",

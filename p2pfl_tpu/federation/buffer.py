@@ -14,6 +14,17 @@ the global model advances one version:
 Nobody ever waits: a slow node's update merges late (with a smaller
 weight) into whatever version the fleet has reached meanwhile.
 
+The P̄ fold is one of the :func:`~p2pfl_tpu.ops.aggregation.
+buffered_robust_merge` kernels, selected by ``Settings.ASYNC_ROBUST_AGG``
+— ``fedavg`` (the formula above, the default), ``trimmed-mean`` /
+``median`` (per-coordinate rank rules, Byzantine-robust, weight-free by
+construction) or ``krum-screen`` (Krum drops the ``BYZ_F`` most outlying
+contributions, the staleness-weighted mean folds the survivors). An
+optional admission screen (``defense`` —
+:class:`~p2pfl_tpu.federation.defense.ByzantineDefense`) additionally
+gates every :meth:`~BufferedAggregator.offer` against the tier's current
+params before buffering.
+
 Determinism contract: given the same *sequence* of ``offer``/``set_global``
 calls, results are bit-identical — the flush sorts its buffer by
 ``(origin, seq)`` so the fold order never depends on arrival interleaving
@@ -83,8 +94,13 @@ class BufferedAggregator:
         server_lr: Optional[float] = None,
         max_staleness: Optional[int] = None,
         bump_on_flush: bool = True,
+        defense: Optional[Any] = None,
     ) -> None:
         self.node_name = node_name
+        #: optional admission screen (federation/defense.py
+        #: ByzantineDefense): every offered contribution is checked
+        #: against this tier's current params before it may buffer
+        self.defense = defense
         self.k = max(1, int(Settings.FEDBUFF_K if k is None else k))
         self.alpha = float(Settings.FEDBUFF_ALPHA if alpha is None else alpha)
         self.server_lr = float(
@@ -141,9 +157,17 @@ class BufferedAggregator:
 
     # ---- the hot path ----
 
-    def offer(self, update: ModelUpdate) -> Optional[FlushResult]:
+    def offer(
+        self, update: ModelUpdate, screen_origin: Optional[str] = None
+    ) -> Optional[FlushResult]:
         """Accept a contribution; returns a :class:`FlushResult` when this
         acceptance completed a buffer of K, else None.
+
+        ``screen_origin`` is who the Byzantine screen blames for a
+        rejection — the DELIVERING peer when the caller knows it (the
+        in-payload ``(origin, seq)`` triple is attacker-controlled and
+        must not be a framing vector); None falls back to the version
+        origin, which equals the sender for every direct push.
 
         Rejections (all counted in the comm metrics, never raising):
 
@@ -171,6 +195,15 @@ class BufferedAggregator:
                     kind="gossip",
                     attrs={"origin": ver.origin, "seq": ver.seq},
                 )
+                return None
+            if self.defense is not None and not self.defense.admit(
+                screen_origin if screen_origin is not None else ver.origin,
+                update.params,
+                self._params,
+            ):
+                # screened out (federation/defense.py): counted there as
+                # screen_reject; the (origin, seq) mark above stays — a
+                # replay of the rejected payload is a dup either way
                 return None
             if (
                 self.bump_on_flush
@@ -263,18 +296,33 @@ class BufferedAggregator:
         import jax
         import jax.numpy as jnp
 
-        from p2pfl_tpu.ops.aggregation import fedavg, server_merge
+        from p2pfl_tpu.ops.aggregation import buffered_robust_merge, server_merge
 
         with telemetry.span(
             self.node_name,
             "async_merge",
             kind="stage",
-            attrs={"k": len(entries), "version": self._version},
+            attrs={
+                "k": len(entries),
+                "version": self._version,
+                "kernel": Settings.ASYNC_ROBUST_AGG,
+            },
         ):
             weights = jnp.asarray([w for _v, _u, w, _t in entries], dtype="float32")
             params_list = [u.params for _v, u, _w, _t in entries]
             stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *params_list)
-            avg = fedavg(stacked, weights, agg_dtype=Settings.AGG_DTYPE)
+            # kernel selected by Settings.ASYNC_ROBUST_AGG ("fedavg" is the
+            # pre-robustness staleness-weighted mean, bit-identical); all
+            # kernels fold the same (origin, seq)-sorted stack, so the
+            # arrival-order determinism contract is kernel-independent
+            avg = buffered_robust_merge(
+                stacked,
+                weights,
+                Settings.ASYNC_ROBUST_AGG,
+                trim=Settings.ASYNC_TRIM,
+                f=Settings.BYZ_F,
+                agg_dtype=Settings.AGG_DTYPE,
+            )
             self._params = server_merge(
                 self._params, avg, lr=self.server_lr, agg_dtype=Settings.AGG_DTYPE
             )

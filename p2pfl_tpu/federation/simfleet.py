@@ -31,7 +31,12 @@ k-th local update" — and the elastic churn events ride the same plan:
 aggregator's current global), ``LeaveSpec(at_s, graceful=True)`` removes
 one (a graceful aggregator forwards its partial buffer to the successor
 tier before exiting; an abrupt one is discovered like a crash, after
-``evict_delay``).
+``evict_delay``), and ``ByzantineSpec`` attackers corrupt their payloads
+on the virtual wire through the SAME ``byz_corrupt_update`` helper the
+live injector runs — with ``Settings.BYZ_SCREEN`` on, each aggregator's
+:class:`~p2pfl_tpu.federation.defense.ByzantineDefense` screens arrivals
+and a crossed suspicion threshold becomes a deterministic evict event
+(the virtual stand-in for the production quarantine → eviction path).
 
 The default workload is a consensus least-squares task: node ``i`` pulls
 its model toward a seeded private target ``tᵢ``; the fleet's fixed point
@@ -76,6 +81,9 @@ class FleetResult:
     joined: List[str] = field(default_factory=list)
     left: List[str] = field(default_factory=list)
     failovers: int = 0  #: how many times the global root changed hands
+    byz_corrupted: int = 0  #: payloads corrupted by ByzantineSpec attackers
+    screen_rejects: int = 0  #: contributions the admission screen refused
+    quarantined: List[str] = field(default_factory=list)  #: evicted attackers
 
     def final_loss(self) -> float:
         return self.loss_curve[-1][2] if self.loss_curve else float("inf")
@@ -196,6 +204,11 @@ class SimulatedAsyncFleet:
 
         self._up_seq: Dict[str, Any] = {}
         self._buffers: Dict[str, Dict[str, BufferedAggregator]] = {}
+        #: per-aggregator admission screens (federation/defense.py) —
+        #: created lazily, only under Settings.BYZ_SCREEN; no callback:
+        #: quarantines are POLLED after each offer and turned into
+        #: deterministic evict events on the virtual clock
+        self._defenses: Dict[str, Any] = {}
         self._reconcile(0.0)
 
         # event heap: (time, insertion seq, kind, payload) — the seq makes
@@ -277,6 +290,34 @@ class SimulatedAsyncFleet:
             return None
         return self.plan.crashes.get(addr)
 
+    def _defense_for(self, addr: str):
+        """The aggregator's admission screen (None when screening is off)."""
+        from p2pfl_tpu.settings import Settings
+
+        if not Settings.BYZ_SCREEN:
+            return None
+        d = self._defenses.get(addr)
+        if d is None:
+            from p2pfl_tpu.federation.defense import ByzantineDefense
+
+            d = self._defenses[addr] = ByzantineDefense(addr)
+        return d
+
+    def _drain_quarantines(self, t: float, addr: str) -> None:
+        """Turn an aggregator's fresh quarantine decisions into evict
+        events — the virtual stand-in for the production path (defense →
+        ``Neighbors.evict`` → eviction listeners → re-derivation). The
+        attacker keeps training and pushing (its control plane is
+        healthy); its arrivals are dropped by the quarantine gate and the
+        topology re-derives around it like around any other hole."""
+        d = self._defenses.get(addr)
+        if d is None:
+            return
+        for origin in d.take_quarantined():
+            if origin not in self.result.quarantined:
+                self.result.quarantined.append(origin)
+            self._push(t, "evict", (origin,))
+
     # ---- membership events (the elastic seam) ----
 
     def _rederive(self, t: float) -> None:
@@ -330,6 +371,7 @@ class SimulatedAsyncFleet:
                         addr, _copy_tree(params), k=op.k,
                         alpha=self._alpha, server_lr=self._server_lr,
                         max_staleness=self._max_staleness, bump_on_flush=not regional,
+                        defense=self._defense_for(addr),
                     )
                     if floor > 0:
                         b.set_global(_copy_tree(params), floor)
@@ -341,6 +383,7 @@ class SimulatedAsyncFleet:
                             self._on_global_flush(t, res, addr)
                         else:
                             self._propagate_regional_flush(t, addr, res)
+                        self._drain_quarantines(t, addr)
             if bufs:
                 self._buffers[addr] = bufs
             else:
@@ -449,6 +492,9 @@ class SimulatedAsyncFleet:
         if gbuf is not None:
             self.result.params, self.result.version = gbuf.snapshot()
             self.result.merges = gbuf.merges
+        self.result.screen_rejects = sum(
+            d.screen_rejects for d in self._defenses.values()
+        )
         return self.result
 
     def _on_train_done(self, t: float, addr: str) -> None:
@@ -488,23 +534,34 @@ class SimulatedAsyncFleet:
 
     def _deliver_update(self, t: float, src: str, dst: str, upd: ModelUpdate) -> None:
         if src == dst:
-            self._push(t, "update_arrive", (dst, upd))
+            self._push(t, "update_arrive", (dst, upd, src))
             return
+        if self.plan is not None and self.plan.byzantine:
+            # the virtual wire's _do_send seam: the SAME corruption helper
+            # the live FaultInjector runs, so a plan's attack replays
+            # bit-exact on the virtual clock (self-pushes above stay
+            # honest, matching production where they skip the send seam)
+            from p2pfl_tpu.communication.faults import byz_corrupt_update
+
+            bad = byz_corrupt_update(self.plan, src, dst, upd, "async_update")
+            if bad is not None:
+                self.result.byz_corrupted += 1
+                upd = bad
         dropped, dup, extra = self._edge_verdict(src, dst)
         if dropped:
             self.result.updates_dropped_wire += 1
             return
-        self._push(t + self.link_delay + extra, "update_arrive", (dst, upd))
+        self._push(t + self.link_delay + extra, "update_arrive", (dst, upd, src))
         if dup:
             self.result.duplicates_injected += 1
             fault = self.plan.edge_fault(src, dst)
             self._push(
                 t + self.link_delay + extra + max(fault.duplicate_delay, 1e-6),
                 "update_arrive",
-                (dst, upd),
+                (dst, upd, src),
             )
 
-    def _on_update_arrive(self, t: float, dst: str, upd: ModelUpdate) -> None:
+    def _on_update_arrive(self, t: float, dst: str, upd: ModelUpdate, src: str) -> None:
         node = self.nodes.get(dst)
         if node is None or node.crashed:
             return
@@ -516,14 +573,19 @@ class SimulatedAsyncFleet:
         if sink is None or bufs is None or sink not in bufs:
             return  # mis-route under the current view (sender ahead of an event)
         self.result.updates_delivered += 1
+        # screen attribution = the delivering peer (production parity:
+        # the in-payload origin is attacker-controlled, a framing vector)
         if sink == "global":
-            res = bufs["global"].offer(upd)
+            res = bufs["global"].offer(upd, screen_origin=src)
             if res:
                 self._on_global_flush(t, res, dst)
-            return
-        res = bufs["regional"].offer(upd)
-        if res:
-            self._propagate_regional_flush(t, dst, res)
+        else:
+            res = bufs["regional"].offer(upd, screen_origin=src)
+            if res:
+                self._propagate_regional_flush(t, dst, res)
+        # an offer may have crossed an origin's suspicion threshold:
+        # quarantine = an evict event, deterministically placed at t
+        self._drain_quarantines(t, dst)
 
     def _propagate_regional_flush(self, t: float, addr: str, res) -> None:
         up = ModelUpdate(res.params, res.contributors, res.num_samples)

@@ -172,13 +172,15 @@ class AsyncContext:
 
     def _apply_initial_plan(self) -> None:
         plan = self.router.buffer_plan(self.addr, Settings.FEDBUFF_K)
+        defense = self.node.defense
         if plan.regional_k is not None:
             self.rbuf = BufferedAggregator(
-                self.addr, self._init_params, k=plan.regional_k, bump_on_flush=False
+                self.addr, self._init_params, k=plan.regional_k,
+                bump_on_flush=False, defense=defense,
             )
         if plan.global_k is not None:
             self.gbuf = BufferedAggregator(
-                self.addr, self._init_params, k=plan.global_k
+                self.addr, self._init_params, k=plan.global_k, defense=defense
             )
 
     @property
@@ -362,13 +364,16 @@ class AsyncContext:
                 params, version = self._global_snapshot_locked()
                 if regional:
                     self.rbuf = BufferedAggregator(
-                        self.addr, params, k=op.k, bump_on_flush=False
+                        self.addr, params, k=op.k, bump_on_flush=False,
+                        defense=self.node.defense,
                     )
                     if version > 0:
                         self.rbuf.set_global(params, version)
                 else:
                     floor = max(version, self.global_version, self.high_water.mark)
-                    self.gbuf = BufferedAggregator(self.addr, params, k=op.k)
+                    self.gbuf = BufferedAggregator(
+                        self.addr, params, k=op.k, defense=self.node.defense
+                    )
                     if floor > 0:
                         self.gbuf.set_global(params, floor)
             else:  # resize
@@ -388,12 +393,22 @@ class AsyncContext:
 
     # ---- receive paths (commands + local offers) ----
 
-    def handle_update(self, update: ModelUpdate) -> List[Action]:
+    def handle_update(self, update: ModelUpdate, source: Optional[str] = None) -> List[Action]:
         """Route a contribution into the buffer the router names; returns
         the sends its flush (if any) produced. An update this node holds
         no buffer for in its CURRENT view is stashed, not dropped — the
         sender's view may be ahead of ours (we are about to observe the
-        death that promotes us)."""
+        death that promotes us).
+
+        ``source`` is the DELIVERING peer (the wire envelope's sender;
+        None only for this node's own local offers). The Byzantine screen
+        attributes rejections to it, NOT to the in-payload version
+        origin: the origin is attacker-controlled, and keying suspicion
+        on it would let a lying sender frame (and get evicted) an honest
+        node. Origin != source legitimately only on buffer-migration
+        forwards — which the forwarder already screened at its own offer,
+        so clean forwards indict nobody and a poisoned forward indicts
+        the forwarder (federation/defense.py threat model)."""
         ver = as_version(update.version)
         with self.lock:
             # cross-experiment straggler (a retried/duplicated tail from
@@ -402,6 +417,21 @@ class AsyncContext:
             # stale-experiment params at full weight — the exact residual
             # the "xp" header was minted to close
             if xp_mismatch(self.addr, update.xp, self.xid):
+                return []
+            in_origin = ver.origin if ver is not None else (
+                update.contributors[0] if update.contributors else None
+            )
+            defense = self.node.defense
+            if (source is not None and defense.is_quarantined(source)) or (
+                in_origin is not None and defense.is_quarantined(in_origin)
+            ):
+                # a quarantined attacker keeps talking (its control plane
+                # is healthy): drop whatever it DELIVERS (source) and
+                # whatever claims to ORIGINATE from it (its content is
+                # suspect even when an honest aggregator forwards it)
+                # before it can stash, inflate the high-water or reach a
+                # buffer
+                logger.log_comm_metric(self.addr, "byz_quarantined_drop")
                 return []
             if (
                 ver is not None
@@ -419,12 +449,12 @@ class AsyncContext:
             )
             sink = self.router.update_sink(self.addr, origin)
             if sink == "global" and self.gbuf is not None:
-                res = self.gbuf.offer(update)
+                res = self.gbuf.offer(update, screen_origin=source)
                 return self._global_flush(res) if res else []
             if sink == "regional" and self.rbuf is not None:
-                res = self.rbuf.offer(update)
+                res = self.rbuf.offer(update, screen_origin=source)
                 return self._regional_flush(res) if res else []
-        self.node.stash_async_update(update)
+        self.node.stash_async_update(update, source)
         logger.log_comm_metric(self.addr, "async_routed_stash")
         logger.debug(
             self.addr,
@@ -654,7 +684,11 @@ class AsyncContext:
                     logger.log_comm_metric(self.addr, "async_push_skipped", len(skipped))
             actions = []
             for upd in local:
-                actions += self.handle_update(upd)
+                # self-delivery (a migration whose successor is this
+                # node's other tier): already screened when first
+                # admitted — attribute to self, never to the in-payload
+                # origin (the screen's self-exemption)
+                actions += self.handle_update(upd, source=self.addr)
 
 
 class AsyncLearningWorkflow:

@@ -53,6 +53,12 @@ class Aggregator:
 
     def __init__(self, node_name: str = "unknown") -> None:
         self.node_name = node_name
+        #: Byzantine admission screen (federation/defense.py) — attached
+        #: by the owning Node; inert while None / Settings.BYZ_SCREEN off
+        self.defense = None
+        #: what contributions are screened AGAINST: the round-start
+        #: params the stage pins via :meth:`set_screen_reference`
+        self._screen_ref = None
         self._lock = threading.Lock()
         self._complete = threading.Event()
         self._complete.set()  # no aggregation in progress
@@ -89,6 +95,14 @@ class Aggregator:
             self._memo_gen += 1
             self._complete.clear()
 
+    def set_screen_reference(self, params) -> None:
+        """Pin the round-start global the admission screen compares
+        contributions against (``federation/defense.py``) — set by
+        TrainStage before the collection window opens; by-reference, no
+        copy. The async plane's buffers screen against their own current
+        params instead."""
+        self._screen_ref = params
+
     def set_waiting_aggregated_model(self, nodes: list[str]) -> None:
         """Non-train-set path: accept the first incoming update as the result.
 
@@ -97,6 +111,10 @@ class Aggregator:
         with self._lock:
             self._train_set = list(nodes)
             self._waiting = True
+            # waiting mode accepts only the full aggregate — no screen
+            # (and a stale reference from a previous round this node DID
+            # train must not reject the real result)
+            self._screen_ref = None
             self._removed = set()
             self._models = {}
             self._partial_memo = {}
@@ -107,6 +125,7 @@ class Aggregator:
         with self._lock:
             self._train_set = []
             self._waiting = False
+            self._screen_ref = None
             self._removed = set()
             self._models = {}
             self._partial_memo = {}
@@ -133,11 +152,19 @@ class Aggregator:
         with self._lock:
             return sorted({c for key in self._models for c in key})
 
-    def add_model(self, update: ModelUpdate) -> list[str]:
+    def add_model(self, update: ModelUpdate, source: Optional[str] = None) -> list[str]:
         """Add a model/partial. Returns the updated contributor coverage list.
 
         An empty return means the update was rejected (duplicate, overlapping,
-        foreign contributor, or no collection window open).
+        foreign contributor, screened out, or no collection window open).
+
+        ``source`` is the DELIVERING peer (the wire envelope's sender) —
+        used only for Byzantine screen attribution: gossip relays other
+        nodes' models verbatim, so a corrupted payload indicts the link
+        that delivered it, not the contributor named inside it (a lying
+        sender could otherwise frame an honest origin). Screen-enabled
+        receivers never store a rejected payload, so honest nodes never
+        relay poison and the attribution converges on the attacker.
 
         Accepts fully DEVICE-RESIDENT contributions: ``update.params`` may
         be uncommitted jax arrays (futures of an in-flight dispatch) and
@@ -150,6 +177,33 @@ class Aggregator:
         if not contributors:
             logger.debug(self.node_name, "Rejecting model with no contributors")
             return []
+        if not self.SUPPORTS_PARTIALS and update.partial_acc is not None:
+            # the fused round's (psum, wsum) accumulator is pre-averaged
+            # state: silently folding it would hand a robust aggregate
+            # exactly the poisoned-mean input its SUPPORTS_PARTIALS=False
+            # contract exists to refuse — fail LOUDLY instead (the stages
+            # strip partial_acc for robust strategies before this seam,
+            # so reaching here is a caller bug, not a runtime condition)
+            raise ValueError(
+                f"({self.node_name}) {type(self).__name__} declares "
+                "SUPPORTS_PARTIALS=False but was handed a partial_acc-folded "
+                "contribution — robust aggregation needs the individual "
+                "model, not the fused-round accumulator; strip partial_acc "
+                "or use the staged path"
+            )
+        if (
+            self.defense is not None
+            and self._screen_ref is not None
+            and update.params is not None
+            and not Settings.SECURE_AGGREGATION  # masked updates are
+            # DESIGNED to look like noise; only their sum is meaningful
+        ):
+            origin = source if source is not None else next(iter(contributors))
+            if not self.defense.admit(origin, update.params, self._screen_ref):
+                # screened out (federation/defense.py counts screen_reject
+                # / byz_quarantined_drop); rejection, not an error — the
+                # suspicion EWMA decides whether this origin is evicted
+                return []
         with self._lock:
             if self._waiting:
                 # only a FULL-train-set aggregate is acceptable while waiting

@@ -83,8 +83,17 @@ class Node:
         self.addr = self.protocol.get_address()
 
         self.state = NodeState(self.addr, simulation=simulation)
+        # Byzantine defense-in-depth (federation/defense.py): one
+        # screen + suspicion tracker shared by BOTH aggregation seams
+        # (the sync aggregator below, the async context's buffers);
+        # inert until Settings.BYZ_SCREEN. Quarantine drives the same
+        # eviction funnel a heartbeat death does (_quarantine_peer).
+        from p2pfl_tpu.federation.defense import ByzantineDefense
+
+        self.defense = ByzantineDefense(self.addr, on_quarantine=self._quarantine_peer)
         self.aggregator = aggregator if aggregator is not None else FedAvg(self.addr)
         self.aggregator.node_name = self.addr
+        self.aggregator.defense = self.defense
 
         # learner: instance, or class to instantiate with (model, data)
         if learner is None and model is not None:
@@ -341,6 +350,10 @@ class Node:
             self._learning_thread.start()
 
     def _run_learning(self) -> None:
+        # suspicion/quarantine are per-experiment state: a new experiment
+        # re-admits every origin (the overlay-level eviction a previous
+        # run drove has its own re-admission rules)
+        self.defense.reset()
         # control-plane selection: the sync round FSM (the reference
         # semantics) or the async bounded-staleness plane (ROADMAP 3)
         if Settings.FEDERATION_MODE == "async":
@@ -403,14 +416,17 @@ class Node:
             return None
         return update
 
-    def stash_async_update(self, update: ModelUpdate) -> None:
+    def stash_async_update(self, update: ModelUpdate, source: Optional[str] = None) -> None:
         """Hold an async_update that beat the AsyncContext's creation
         (commands/federation.py) for the workflow to drain — bounded: in
         async-land a superseded update is droppable by design, so overflow
-        evicts the oldest instead of growing."""
+        evicts the oldest instead of growing. ``source`` (the delivering
+        peer) rides along so the drain's Byzantine screen attributes a
+        poisoned stashed payload to whoever DELIVERED it, exactly like a
+        direct delivery (federation/defense.py framing contract)."""
         with self._early_async_lock:
             self._early_async.append(
-                (self.state.experiment_epoch, time.monotonic(), update)
+                (self.state.experiment_epoch, time.monotonic(), update, source)
             )
             while len(self._early_async) > 64:
                 self._early_async.pop(0)
@@ -434,16 +450,30 @@ class Node:
         epoch = self.state.experiment_epoch
         xid = self.state.experiment_xid
         fresh = []
-        for e, t, u in entries:
+        for e, t, u, src in entries:
             if u.xp is not None and xid is not None:
                 if u.xp == xid:
-                    fresh.append(u)
+                    fresh.append((u, src))
                 continue
             if e == epoch and now - t <= Settings.EARLY_INIT_TTL:
-                fresh.append(u)
+                fresh.append((u, src))
         if len(fresh) < len(entries):
             logger.debug(self.addr, "Discarded stale early async_update stash entries")
         return fresh
+
+    def _quarantine_peer(self, addr: str) -> None:
+        """Byzantine quarantine (federation/defense.py): drive the SAME
+        eviction path a corpse takes — ``Neighbors.evict`` fires the
+        protocol's eviction listeners, which run sync train-set repair /
+        the async ``TierRouter`` re-derivation — with the quarantine flag
+        set so the attacker's (perfectly healthy) heartbeats cannot
+        immediately re-admit it. Runs on the defense's daemon thread,
+        never under an aggregator or buffer lock.
+        """
+        logger.warning(
+            self.addr, f"Evicting {addr} from the overlay (Byzantine quarantine)"
+        )
+        self.protocol.neighbors.evict(addr, quarantine=True)
 
     def _on_peer_evicted(self, addr: str) -> None:
         """Mid-round train-set repair (ISSUE 5): a train-set member was

@@ -102,6 +102,151 @@ def fedavg_fold_stacked(stacked_psum: Pytree, stacked_wsum: jax.Array, ref: Pytr
     )
 
 
+@partial(jax.jit, static_argnames=("f",))
+def krum_screen_merge(stacked: Pytree, weights: jax.Array, f: int) -> Pytree:
+    """Krum SCREENING + weighted mean: drop the ``f`` most outlying
+    contributions (Multi-Krum selection with ``multi = N − f``), then fold
+    the survivors with the caller's weights — for the async buffer those
+    are the staleness weights ``num_samples × w(τ)``, so the FedBuff
+    weighting survives the screen (unlike the rank-based kernels, which
+    have no weighted analogue). One dispatch: selection indices feed a
+    gathered tensordot.
+    """
+    idx = krum_select(stacked, n_byzantine=f, multi=stacked_n(stacked) - f)
+    w = jnp.take(weights.astype("float32"), idx)
+    w = w / jnp.sum(w)
+
+    def pick(x):
+        sel = jnp.take(x, idx, axis=0).astype("float32")
+        return jnp.tensordot(w, sel, axes=(0, 0)).astype(x.dtype)
+
+    return jax.tree.map(pick, stacked)
+
+
+def stacked_n(stacked: Pytree) -> int:
+    """Node-axis length of a stacked pytree (static under jit)."""
+    return jax.tree.leaves(stacked)[0].shape[0]
+
+
+def buffered_robust_merge(
+    stacked: Pytree,
+    weights: jax.Array,
+    kind: str,
+    *,
+    trim: int = 1,
+    f: int = 1,
+    agg_dtype: str = "float32",
+) -> Pytree:
+    """The async buffer's flush kernel, selected by
+    ``Settings.ASYNC_ROBUST_AGG`` (``federation/buffer.py``).
+
+    Every branch folds the same ``(origin, seq)``-sorted stack, so the
+    buffer's arrival-order-independence determinism contract holds for
+    all of them; every branch is a jitted device-resident fold (shape-
+    keyed executables, one per K like the sync kernels). Weighting
+    semantics per kind:
+
+    - ``"fedavg"`` — the FedBuff staleness-weighted mean (pre-robust
+      behavior, bit-identical to the old hardcoded fold);
+    - ``"trimmed-mean"`` / ``"median"`` — per-coordinate rank statistics;
+      they IGNORE the staleness weights by construction (a weighted rank
+      rule forfeits the breakdown-point guarantee that makes it robust);
+      τ still bounds admission (over-stale updates were already dropped);
+    - ``"krum-screen"`` — Krum drops the ``f`` most outlying
+      contributions, the staleness-weighted mean folds the survivors
+      (weights kept).
+
+    ``trim``/``f`` are clamped so at least one contribution survives —
+    a buffer smaller than the configured robustness degrades to the mean
+    of what it has rather than refusing to flush.
+    """
+    n = stacked_n(stacked)
+    if kind == "fedavg" or n == 1:
+        return fedavg(stacked, weights, agg_dtype=agg_dtype)
+    if kind == "trimmed-mean":
+        t = min(int(trim), (n - 1) // 2)
+        if t <= 0:
+            return fedavg(stacked, weights, agg_dtype=agg_dtype)
+        return trimmed_mean(stacked, t)
+    if kind == "median":
+        return fedmedian(stacked)
+    if kind == "krum-screen":
+        fc = min(int(f), n - 1)
+        # krum_select scores against N − f − 2 nearest neighbors; below
+        # that population the screen cannot rank and the mean is all
+        # there is
+        if fc <= 0 or n - fc - 2 < 1:
+            return fedavg(stacked, weights, agg_dtype=agg_dtype)
+        return krum_screen_merge(stacked, weights, fc)
+    raise ValueError(
+        f"unknown ASYNC_ROBUST_AGG {kind!r} "
+        "(expected fedavg | trimmed-mean | median | krum-screen)"
+    )
+
+
+def robust_fold_stacked(stacked: Pytree, ref: Pytree, kind: str, *, trim: int = 1) -> Pytree:
+    """Robust per-coordinate fold over a NODE-STACKED sharded layout —
+    the robust twin of :func:`fedavg_fold_stacked`.
+
+    ``stacked`` leaves are ``[N, ...]`` stacks of per-node PARAMS (raw
+    models, not ``weight × params`` accumulators: a median of scaled
+    terms is not a median of models), node axis sharded over the mesh's
+    nodes axis. Per-coordinate rank statistics reduce the node axis;
+    under ``jit`` with model-sharded ``out_shardings`` the partitioner
+    re-shards node-stacks to coordinate-shards, so each device only ever
+    holds the N values of ITS OWN model shard — N × (1/m) of the model,
+    never a full copy (the PR-10 contract; callers assert the sharding
+    metadata like ``ShardedNodeFederation._assert_fold_shardings``).
+
+    Deliberately NOT jitted here: callers wrap it with their own
+    ``out_shardings`` (``parallel/submesh.py`` robust aggregation).
+    ``ref`` gives the output dtypes.
+    """
+    n = stacked_n(stacked)
+    if kind == "median":
+        return jax.tree.map(
+            lambda x, r: jnp.median(x.astype("float32"), axis=0).astype(r.dtype),
+            stacked,
+            ref,
+        )
+    if kind == "trimmed-mean":
+        t = min(int(trim), (n - 1) // 2)
+
+        def tm(x, r):
+            xs = jnp.sort(x.astype("float32"), axis=0)
+            kept = jax.lax.slice_in_dim(xs, t, n - t, axis=0)
+            return jnp.mean(kept, axis=0).astype(r.dtype)
+
+        if t <= 0:
+            return jax.tree.map(
+                lambda x, r: jnp.mean(x.astype("float32"), axis=0).astype(r.dtype),
+                stacked,
+                ref,
+            )
+        return jax.tree.map(tm, stacked, ref)
+    raise ValueError(f"unknown robust fold kind {kind!r} (expected median | trimmed-mean)")
+
+
+@jax.jit
+def screen_stats(params: Pytree, ref: Pytree) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Admission-screen statistics for one contribution vs the current
+    global: ``(‖params‖₂, ‖ref‖₂, cos(params, ref))`` — one fused
+    device reduction (``federation/defense.py`` reads the three scalars).
+    """
+    dot = jnp.float32(0.0)
+    p2 = jnp.float32(0.0)
+    r2 = jnp.float32(0.0)
+    for x, y in zip(jax.tree.leaves(params), jax.tree.leaves(ref)):
+        xf = x.astype("float32").ravel()
+        yf = y.astype("float32").ravel()
+        dot = dot + jnp.dot(xf, yf)
+        p2 = p2 + jnp.dot(xf, xf)
+        r2 = r2 + jnp.dot(yf, yf)
+    pn = jnp.sqrt(jnp.maximum(p2, 1e-24))
+    rn = jnp.sqrt(jnp.maximum(r2, 1e-24))
+    return pn, rn, dot / (pn * rn)
+
+
 @partial(jax.jit, static_argnames=("lr", "agg_dtype"))
 def server_merge(prev: Pytree, avg: Pytree, lr: float = 1.0, agg_dtype: str = "float32") -> Pytree:
     """FedBuff server step: ``new = (1−η)·prev + η·avg`` in ``agg_dtype``.

@@ -36,10 +36,19 @@ algebra). With equal node weights that is bit-identical to
 commutes with rounding); with unequal weights they agree to
 summation-order ulp — see ``ops/aggregation.py``.
 
-Scope: FedAvg (+ FedProx local steps). Robust aggregators need the full
-``[K, ...]`` stack on one program and the SPMD runtime already serves
-them; SCAFFOLD / FedOpt / DP-SGD stay on :class:`SpmdFederation`
-(rejected loudly here). Non-elected nodes are not dispatched at all —
+Scope: FedAvg (+ FedProx local steps), and — via ``robust_agg=
+"median"|"trimmed-mean"`` — per-coordinate ROBUST folds over the same
+node-stacked layout (:func:`~p2pfl_tpu.ops.aggregation.
+robust_fold_stacked`): the raw params stack assembles through the same
+zero-copy GDA idiom and the partitioner re-shards node-stacks to
+coordinate-shards for the per-coordinate sort, so each device only ever
+holds the N values of its own model shard — the no-materialization
+contract holds for the robust fold too (same sharding asserts). Robust
+folds require full participation per round (a stale non-elected stack
+entry would be folded as if Byzantine). Krum-family strategies need the
+full ``[K, P]`` distance matrix on one program and the SPMD runtime
+already serves them; SCAFFOLD / FedOpt / DP-SGD stay on
+:class:`SpmdFederation` (rejected loudly here). Non-elected nodes are not dispatched at all —
 they contribute an all-zeros accumulator to the fold (the exact ``w=0``
 term the SPMD masked reduce carries) and receive the aggregate like
 everyone else; under ``keep_opt_state=True`` their optimizer state
@@ -258,6 +267,7 @@ class ShardedNodeFederation:
         keep_opt_state: bool = False,
         prox_mu: float = 0.0,
         seed: int = 0,
+        robust_agg: Optional[str] = None,
     ) -> None:
         self.model = model
         self.module = model.module
@@ -272,6 +282,18 @@ class ShardedNodeFederation:
                 "ShardedNodeFederation: the mesh is one trust domain. Use "
                 "gossip Node mode for secure aggregation."
             )
+        if robust_agg not in (None, "median", "trimmed-mean"):
+            raise ValueError(
+                f"robust_agg must be None | 'median' | 'trimmed-mean', got {robust_agg!r}"
+            )
+        #: robust cross-slice fold (ROADMAP 3): per-coordinate
+        #: median/trimmed-mean over the node-stacked PARAMS shard-by-shard
+        #: instead of the weighted accumulator mean — same zero-copy stack
+        #: assembly, same sharding asserts (no device ever materializes a
+        #: full model). Requires full participation per round: a rank
+        #: statistic over a stack holding non-elected nodes' stale params
+        #: would silently fold garbage, so run_round raises instead.
+        self.robust_agg = robust_agg
         self.datasets = datasets
         self.batch_size = batch_size
         self.learning_rate = learning_rate
@@ -407,6 +429,37 @@ class ShardedNodeFederation:
             return fedavg_fold_stacked(stacked_psum, stacked_wsum, ref)
 
         self._fold = jax.jit(fold, out_shardings=agg_shardings)
+        self._robust_fold = None
+        self._expand_params = None
+        if self.robust_agg is not None:
+            from p2pfl_tpu.ops.aggregation import robust_fold_stacked
+
+            kind = self.robust_agg
+            # read OUTSIDE the traced fn: a Settings read inside would be
+            # baked at first trace and silently go stale (jit-staleness)
+            trim = int(Settings.ASYNC_TRIM)
+
+            def rfold(stacked_params):
+                return robust_fold_stacked(stacked_params, ref, kind, trim=trim)
+
+            # model-sharded out_shardings: the partitioner re-shards the
+            # node-stack to coordinate-shards for the per-coordinate sort,
+            # so each device only ever holds the N values of ITS model
+            # shard — N × (1/m) of the model, never a full copy
+            self._robust_fold = jax.jit(rfold, out_shardings=agg_shardings)
+            # per-slice leading-axis expansion [*] -> [1, *] so the raw
+            # params stack assembles through the same zero-copy GDA idiom
+            # as the accumulators (stack_across_slices wants P(None, *))
+            self._expand_params = [
+                jax.jit(
+                    lambda p: jax.tree.map(lambda x: x[None], p),
+                    out_shardings=jax.tree.map(
+                        lambda s: NamedSharding(self.slices[i], P(None, *s.spec)),
+                        self._param_shardings[i],
+                    ),
+                )
+                for i in range(self.n)
+            ]
         self._nodes_axis = nodes_axis
         # zero accumulator programs for non-elected nodes: the explicit
         # w=0 term of the SPMD masked reduce, keeping the fold's stacked
@@ -486,6 +539,14 @@ class ShardedNodeFederation:
             self.train_mask = self.elect_train_set()
         perms = draw_node_perms(self._rng, self._sizes, self._nb, self.batch_size, epochs)
         eff = self._effective_mask()
+        robust = self.robust_agg is not None
+        if robust and not all(eff):
+            raise RuntimeError(
+                f"robust_agg={self.robust_agg!r} requires full "
+                "participation: a rank statistic over a stack holding "
+                "non-elected/dropped nodes' stale params would silently "
+                "fold garbage (elect everyone, or use the FedAvg fold)"
+            )
         agg_dtype = Settings.AGG_DTYPE
         from p2pfl_tpu.management.profiling import dispatch_span
 
@@ -507,6 +568,10 @@ class ShardedNodeFederation:
                         self._x_dev[i], self._y_dev[i], perms[i],
                         jnp.float32(self._sizes[i]), xt, yt,
                         module=self.module, tx=self.tx, prox_mu=self.prox_mu,
+                        # the robust fold consumes raw params, never the
+                        # weight x params accumulator — compile it out
+                        # (saves a full fp32 params copy per node)
+                        with_acc=not robust,
                         agg_dtype=agg_dtype,
                         batch_shardings=self._batch_shardings[i],
                     )
@@ -515,26 +580,43 @@ class ShardedNodeFederation:
                 raise
             self.params[i] = out["params"]
             self.opt_state[i] = out["opt_state"]
-            psums.append(out["psum"])
-            wsums.append(out["wsum"])
+            if not robust:
+                psums.append(out["psum"])
+                wsums.append(out["wsum"])
             losses.append(out["train_losses"])
             if eval:
                 evals.append((out["eval_loss"], out["eval_acc"]))
 
-        stacked_psum = stack_across_slices(self.mesh, psums)
-        stacked_wsum = stack_across_slices(self.mesh, wsums)
-        with dispatch_span("cross_slice_fold", "spmd", nodes=self.n):
-            agg = self._fold(stacked_psum, stacked_wsum)
-        self._assert_fold_shardings(stacked_psum, agg)
-        # introspection record for tests/benches: the fold INPUT shardings
-        # (metadata) and the tiny [N] weight vector — deliberately NOT the
-        # stacked psum itself, which is a full fp32 weight x params shard
-        # per device that must not outlive the fold (it would silently add
-        # ~one params copy per device to steady-state HBM)
-        self.last_fold = {
-            "psum_shardings": jax.tree.map(lambda l: l.sharding, stacked_psum),
-            "wsum": stacked_wsum,
-        }
+        if robust:
+            # robust fold runs over the raw node-stacked PARAMS (a median
+            # of weight x params accumulators is not a median of models);
+            # assembly is the same zero-copy GDA idiom as the accumulators
+            expanded = [self._expand_params[i](self.params[i]) for i in range(self.n)]
+            stacked = stack_across_slices(self.mesh, expanded)
+            with dispatch_span("cross_slice_robust_fold", "spmd", nodes=self.n):
+                agg = self._robust_fold(stacked)
+            self._assert_fold_shardings(stacked, agg)
+            self.last_fold = {
+                "psum_shardings": jax.tree.map(lambda l: l.sharding, stacked),
+                # rank-based fold: no weight vector enters the aggregate
+                "wsum": None,
+            }
+        else:
+            stacked_psum = stack_across_slices(self.mesh, psums)
+            stacked_wsum = stack_across_slices(self.mesh, wsums)
+            with dispatch_span("cross_slice_fold", "spmd", nodes=self.n):
+                agg = self._fold(stacked_psum, stacked_wsum)
+            self._assert_fold_shardings(stacked_psum, agg)
+            # introspection record for tests/benches: the fold INPUT
+            # shardings (metadata) and the tiny [N] weight vector —
+            # deliberately NOT the stacked psum itself, which is a full
+            # fp32 weight x params shard per device that must not outlive
+            # the fold (it would silently add ~one params copy per device
+            # to steady-state HBM)
+            self.last_fold = {
+                "psum_shardings": jax.tree.map(lambda l: l.sharding, stacked_psum),
+                "wsum": stacked_wsum,
+            }
 
         # diffusion: every node's slice already holds its shards of the
         # node-replicated aggregate — re-wrap per slice, zero copy

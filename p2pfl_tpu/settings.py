@@ -268,6 +268,58 @@ class Settings:
     # single direct round-trip, so this only needs to cover connection
     # setup plus one full-model push.
     ASYNC_JOIN_TIMEOUT: float = 15.0
+    # --- Byzantine robustness (federation/defense.py, ops/aggregation.py) ---
+    # Which merge kernel the async plane's BufferedAggregator folds a
+    # flushed buffer with: "fedavg" is the FedBuff staleness-weighted mean
+    # (the pre-robustness behavior); "trimmed-mean" and "median" are the
+    # per-coordinate rank-based robust rules (they ignore the staleness
+    # weights by construction — rank statistics have no weighted analogue
+    # that keeps their breakdown point); "krum-screen" runs Krum selection
+    # to DROP the BYZ_F most outlying contributions and then applies the
+    # normal staleness-weighted mean over the survivors (weights kept).
+    # Every kernel folds the same (origin, seq)-sorted buffer, so the
+    # arrival-order-independence determinism contract is unchanged.
+    ASYNC_ROBUST_AGG: str = "fedavg"
+    # Coordinates trimmed from EACH side per coordinate by the
+    # "trimmed-mean" kernel (clamped to (K-1)//2 — at least one value must
+    # survive). Robust to ASYNC_TRIM Byzantine contributions per buffer.
+    ASYNC_TRIM: int = 1
+    # Assumed Byzantine contribution count f for "krum-screen" (and the
+    # sharded robust folds' krum variant): f contributions are screened
+    # out of each flush. Clamped so at least one contribution survives.
+    BYZ_F: int = 1
+    # Defense-in-depth admission screen (federation/defense.py): every
+    # single-origin contribution at BOTH aggregator seams (the sync
+    # Aggregator.add_model and the async BufferedAggregator.offer) is
+    # checked against the current global — an L2-norm gate plus a
+    # cosine-distance outlier score, one tiny jitted reduction per
+    # contribution — before it may enter a fold. Rejections feed a
+    # per-origin suspicion EWMA; past BYZ_SUSPICION_THRESHOLD the origin
+    # is QUARANTINED through the existing eviction path (breaker /
+    # mark_dead / TierRouter re-derivation), so a semantic attacker is
+    # removed by the same machinery that removes a corpse. Off by
+    # default: screening is a behavioral change (it can reject honest
+    # outliers under extreme non-IID data) and is opt-in like the robust
+    # kernels.
+    BYZ_SCREEN: bool = False
+    # Norm gate: reject a contribution whose L2 norm is more than this
+    # factor away from the current global's (ratio outside
+    # [1/gate, gate]). Sized for weights-space updates (a local step's
+    # norm stays near the global's); scale attacks at |λ| >= gate are
+    # caught here.
+    BYZ_NORM_GATE: float = 4.0
+    # Cosine gate: reject when cos(update, global) falls below this.
+    # Honest weights-space updates stay close to the global they trained
+    # from (cos ≈ 1); sign flips sit at −1, heavy noise near 0.
+    BYZ_COS_GATE: float = 0.5
+    # Suspicion EWMA step: s ← (1−β)·s + β·[rejected]. At 0.5 two
+    # consecutive rejections cross the default threshold.
+    BYZ_SUSPICION_BETA: float = 0.5
+    # Suspicion level at which an origin is quarantined (monotone: once
+    # quarantined, an origin's contributions are dropped for the rest of
+    # the experiment even if it starts behaving).
+    BYZ_SUSPICION_THRESHOLD: float = 0.7
+
     # Secure aggregation (pairwise masking, learning/secagg.py): when True,
     # train-set nodes Diffie-Hellman a seed per peer at experiment start and
     # mask their model contribution; masks cancel in the FedAvg sum, so no
@@ -475,6 +527,14 @@ def set_test_settings() -> None:
     Settings.WEIGHTS_PLANE = "bytes"
     Settings.ICI_BACKEND = "auto"
     Settings.FEDERATION_MODE = "sync"
+    Settings.ASYNC_ROBUST_AGG = "fedavg"
+    Settings.ASYNC_TRIM = 1
+    Settings.BYZ_F = 1
+    Settings.BYZ_SCREEN = False
+    Settings.BYZ_NORM_GATE = 4.0
+    Settings.BYZ_COS_GATE = 0.5
+    Settings.BYZ_SUSPICION_BETA = 0.5
+    Settings.BYZ_SUSPICION_THRESHOLD = 0.7
     Settings.FEDBUFF_K = 4
     Settings.FEDBUFF_ALPHA = 0.5
     Settings.FEDBUFF_SERVER_LR = 1.0

@@ -321,6 +321,10 @@ class TrainStage(Stage):
         # contributions that reached peers must stay aggregatable), then
         # earlier rounds'/pre-stage evictions shrink the coverage target —
         # the same repair Node._on_peer_evicted applies mid-round
+        # pin what the Byzantine admission screen compares contributions
+        # against: the round-start global every train-set member shares
+        # (by reference — no copy, no host sync; federation/defense.py)
+        node.aggregator.set_screen_reference(node.learner.get_parameters())
         node.aggregator.set_nodes_to_aggregate(state.train_set)
         for gone in list(state.train_set_evicted):
             node.aggregator.discard_member(gone)
@@ -368,6 +372,13 @@ class TrainStage(Stage):
             own.ef_residual = node.learner.ef_residual_store()
         if Settings.SECURE_AGGREGATION and len(state.train_set) > 1:
             own = TrainStage._secagg_mask(node, own)
+        if own is not None and not node.aggregator.SUPPORTS_PARTIALS:
+            # robust strategies fold INDIVIDUAL models: the fused round's
+            # pre-averaged (psum, wsum) accumulator must never reach them
+            # — add_model raises loudly on it (the defense-in-depth half
+            # of this contract); own.params is the individual model either
+            # way, so stripping loses nothing
+            own.partial_acc = None
         if own is not None:
             covered = node.aggregator.add_model(own)
             node.protocol.broadcast(

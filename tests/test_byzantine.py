@@ -1,0 +1,674 @@
+"""Byzantine chaos (ISSUE 14): adversarial fault injection, robust async
+merge, admission screening, and attacker quarantine on both control planes.
+
+Five layers, mirroring the subsystem's structure:
+
+- ByzantineSpec determinism + non-mutation at the fault seam (the same
+  ``byz_corrupt_update`` both the live injector and the simulator run);
+- the robust merge kernels against numpy references, and the buffer's
+  arrival-order-independence contract under every kernel;
+- the admission screen + suspicion EWMA + one-shot quarantine, and both
+  aggregator seams consuming it (async ``offer``, sync ``add_model`` with
+  delivering-peer attribution);
+- malformed ``async_pull``/``async_view`` control payloads dropping
+  loudly without killing the node (parity with ``async_update``);
+- scale + acceptance: a simulated fleet with 10% sign-flip attackers
+  fails with defenses off and converges (attackers quarantined,
+  bit-exact replay) with them on; a live 6-node equivocation federation
+  converges with the attacker evicted through the existing path; robust
+  folds over sharded node-stacks keep the no-materialization contract.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from p2pfl_tpu.communication.faults import (
+    ByzantineSpec,
+    CrashSpec,
+    EdgeFault,
+    FaultInjector,
+    FaultPlan,
+    byz_corrupt_update,
+    install_fault_plan,
+    remove_fault_plan,
+)
+from p2pfl_tpu.communication.memory import MemoryRegistry
+from p2pfl_tpu.communication.message import WeightsEnvelope
+from p2pfl_tpu.federation.buffer import BufferedAggregator
+from p2pfl_tpu.federation.defense import ByzantineDefense
+from p2pfl_tpu.federation.simfleet import SimulatedAsyncFleet
+from p2pfl_tpu.learning.learner import DummyLearner
+from p2pfl_tpu.learning.weights import ModelUpdate
+from p2pfl_tpu.management.logger import logger
+from p2pfl_tpu.node import Node
+from p2pfl_tpu.settings import Settings
+from p2pfl_tpu.utils import full_connection, wait_convergence, wait_to_finish
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    MemoryRegistry.reset()
+    logger.reset_comm_metrics()
+    yield
+    Settings.FEDERATION_MODE = "sync"
+    Settings.HIER_CLUSTER_SIZE = 0
+    Settings.ASYNC_ROBUST_AGG = "fedavg"
+    Settings.ASYNC_TRIM = 1
+    Settings.BYZ_F = 1
+    Settings.BYZ_SCREEN = False
+    Settings.BYZ_SUSPICION_BETA = 0.5
+    Settings.BYZ_SUSPICION_THRESHOLD = 0.7
+    MemoryRegistry.reset()
+
+
+def _sum_metric(metric):
+    return sum(d.get(metric, 0.0) for d in logger.get_comm_metrics().values())
+
+
+def _upd(value, origin, seq, base=0, dim=4, samples=1):
+    u = ModelUpdate({"w": np.full(dim, value, np.float32)}, [origin], samples)
+    u.version = (origin, seq, base)
+    return u
+
+
+# ---------------------------------------------------------------------------
+# ByzantineSpec at the fault seam
+# ---------------------------------------------------------------------------
+
+
+def test_byz_corruption_deterministic_and_non_mutating():
+    """Same (seed, plan) ⇒ bit-identical corruption; the honest update is
+    never touched (in-process transports pass payloads by reference)."""
+    for kind in ("sign_flip", "scale", "noise", "stale_replay", "equivocate"):
+        plans = [
+            FaultPlan(seed=11, byzantine={"a": ByzantineSpec(kind=kind, lam=3.0)})
+            for _ in range(2)
+        ]
+        outs = []
+        for plan in plans:
+            honest = _upd(1.0, "a", 1)
+            bad = byz_corrupt_update(plan, "a", "b", honest, "async_update")
+            assert bad is not None, kind
+            # the original is untouched and the corruption does not alias it
+            np.testing.assert_array_equal(honest.params["w"], np.ones(4, np.float32))
+            assert bad.params["w"] is not honest.params["w"]
+            assert bad.version == honest.version  # the lie rides a true triple
+            outs.append(np.asarray(bad.params["w"]))
+        np.testing.assert_array_equal(outs[0], outs[1])
+        if kind == "sign_flip":
+            np.testing.assert_array_equal(outs[0], -np.ones(4, np.float32))
+        if kind == "scale":
+            np.testing.assert_array_equal(outs[0], 3.0 * np.ones(4, np.float32))
+
+
+def test_byz_equivocation_differs_per_edge():
+    plan = FaultPlan(seed=11, byzantine={"a": ByzantineSpec(kind="equivocate", lam=5.0)})
+    to_b = byz_corrupt_update(plan, "a", "b", _upd(1.0, "a", 1), "async_update")
+    to_c = byz_corrupt_update(plan, "a", "c", _upd(1.0, "a", 1), "async_update")
+    assert not np.allclose(to_b.params["w"], to_c.params["w"])
+
+
+def test_byz_stale_replay_freezes_first_payload():
+    plan = FaultPlan(seed=11, byzantine={"a": ByzantineSpec(kind="stale_replay")})
+    first = byz_corrupt_update(plan, "a", "b", _upd(1.0, "a", 1), "async_update")
+    later = byz_corrupt_update(plan, "a", "b", _upd(9.0, "a", 7, base=5), "async_update")
+    np.testing.assert_array_equal(later.params["w"], first.params["w"])
+    assert later.version == ("a", 7, 5)  # fresh triple: vv dedup cannot catch it
+
+
+def test_byz_scope_and_arming_do_not_shift_fault_verdicts():
+    """Out-of-scope commands pass untouched, and arming an attack must not
+    consume the drop/duplicate verdict streams (separate byz streams)."""
+    spec = ByzantineSpec(kind="sign_flip")
+    armed = FaultPlan(seed=3, default=EdgeFault(drop=0.3), byzantine={"a": spec})
+    plain = FaultPlan(seed=3, default=EdgeFault(drop=0.3))
+    assert byz_corrupt_update(armed, "a", "b", _upd(1.0, "a", 1), "async_model") is None
+    assert byz_corrupt_update(armed, "x", "b", _upd(1.0, "x", 1), "async_update") is None
+    byz_corrupt_update(armed, "a", "b", _upd(1.0, "a", 1), "async_update")
+    draws_armed = [armed.rng("a", "b").random() for _ in range(16)]
+    draws_plain = [plain.rng("a", "b").random() for _ in range(16)]
+    assert draws_armed == draws_plain
+
+
+def test_byz_corruption_not_disarmed_by_control_scoped_edge_fault():
+    """A control-scoped edge fault and a Byzantine attacker are
+    independent plan dimensions: the scope gate's weights short-circuit
+    must not ship the attacker's payload uncorrupted (review-pinned)."""
+    plan = FaultPlan(
+        seed=3,
+        default=EdgeFault(drop=1.0, scope="control"),
+        byzantine={"a": ByzantineSpec(kind="sign_flip")},
+    )
+    sent = []
+
+    def transport(nei, env, create_connection=False):
+        sent.append(env)
+        return True
+
+    env = WeightsEnvelope("a", 0, "async_update", _upd(1.0, "a", 1))
+    assert FaultInjector(plan, "a")("b", env, False, transport)
+    assert len(sent) == 1  # weights pass the control-scoped drop...
+    np.testing.assert_array_equal(  # ...but corrupted, not disarmed
+        np.asarray(sent[0].update.params["w"]), -np.ones(4, np.float32)
+    )
+
+
+# ---------------------------------------------------------------------------
+# robust merge kernels
+# ---------------------------------------------------------------------------
+
+
+def _stack(rows):
+    return {"w": jnp.asarray(np.stack([np.asarray(r, np.float32) for r in rows]))}
+
+
+def test_robust_kernels_against_numpy_reference():
+    from p2pfl_tpu.ops.aggregation import buffered_robust_merge
+
+    rows = [[1.0, 2.0], [1.2, 1.8], [0.8, 2.2], [100.0, -100.0]]  # last = poison
+    stacked = _stack(rows)
+    w = jnp.asarray([1.0, 2.0, 1.0, 1.0])
+    arr = np.asarray(rows, np.float32)
+
+    med = buffered_robust_merge(stacked, w, "median")
+    np.testing.assert_allclose(np.asarray(med["w"]), np.median(arr, axis=0), rtol=1e-6)
+
+    tm = buffered_robust_merge(stacked, w, "trimmed-mean", trim=1)
+    ref = np.mean(np.sort(arr, axis=0)[1:-1], axis=0)
+    np.testing.assert_allclose(np.asarray(tm["w"]), ref, rtol=1e-6)
+
+    ks = buffered_robust_merge(stacked, w, "krum-screen", f=1)
+    # Krum screens out the outlier; survivors fold at their weights
+    sel = arr[:3]
+    wsel = np.asarray([1.0, 2.0, 1.0], np.float32)
+    ref = (wsel[:, None] * sel).sum(0) / wsel.sum()
+    np.testing.assert_allclose(np.asarray(ks["w"]), ref, rtol=1e-5)
+
+    fa = buffered_robust_merge(stacked, w, "fedavg")
+    wf = np.asarray([1.0, 2.0, 1.0, 1.0], np.float32)
+    ref = (wf[:, None] * arr).sum(0) / wf.sum()
+    np.testing.assert_allclose(np.asarray(fa["w"]), ref, rtol=1e-5)
+
+    with pytest.raises(ValueError, match="ASYNC_ROBUST_AGG"):
+        buffered_robust_merge(stacked, w, "nonsense")
+
+
+def test_robust_kernels_degrade_below_population():
+    """Under-populated buffers fold the mean instead of refusing."""
+    from p2pfl_tpu.ops.aggregation import buffered_robust_merge
+
+    stacked = _stack([[2.0, 4.0]])
+    w = jnp.ones(1)
+    for kind in ("trimmed-mean", "median", "krum-screen", "fedavg"):
+        out = np.asarray(buffered_robust_merge(stacked, w, kind)["w"])
+        np.testing.assert_allclose(out, [2.0, 4.0], rtol=1e-6)
+
+
+@pytest.mark.parametrize("kind", ["trimmed-mean", "median", "krum-screen"])
+def test_buffer_flush_arrival_order_independent_per_kernel(kind):
+    """The (origin, seq)-sorted determinism contract holds for every
+    robust kernel, not just the weighted mean."""
+    Settings.ASYNC_ROBUST_AGG = kind
+    orders = [
+        [("n1", 1.0), ("n2", 1.2), ("n3", 0.8), ("n4", 50.0)],
+        [("n4", 50.0), ("n2", 1.2), ("n1", 1.0), ("n3", 0.8)],
+    ]
+    results = []
+    for order in orders:
+        buf = BufferedAggregator("agg", {"w": np.zeros(4, np.float32)}, k=4)
+        res = None
+        for origin, val in order:
+            res = buf.offer(_upd(val, origin, 1)) or res
+        results.append(np.asarray(res.params["w"]))
+    np.testing.assert_array_equal(results[0], results[1])
+    # and the poison stayed bounded: the merged value is near the honest ones
+    assert float(np.abs(results[0]).max()) < 2.0
+
+
+def test_buffer_robust_merge_keeps_version_and_regional_semantics():
+    """Kernel swap changes the fold only: version minting (bump_on_flush)
+    and the regional no-bump contract are untouched."""
+    Settings.ASYNC_ROBUST_AGG = "median"
+    gbuf = BufferedAggregator("g", {"w": np.zeros(4, np.float32)}, k=2)
+    rbuf = BufferedAggregator("r", {"w": np.zeros(4, np.float32)}, k=2, bump_on_flush=False)
+    for i, buf in enumerate((gbuf, rbuf)):
+        a = buf.offer(_upd(1.0, f"a{i}", 1))
+        b = buf.offer(_upd(3.0, f"b{i}", 1))
+        assert a is None and b is not None
+    assert gbuf.version == 1 and rbuf.version == 0
+
+
+# ---------------------------------------------------------------------------
+# screening + suspicion + quarantine
+# ---------------------------------------------------------------------------
+
+
+def test_screen_stats_math():
+    from p2pfl_tpu.ops.aggregation import screen_stats
+
+    rng = np.random.default_rng(5)
+    p = {"a": rng.normal(size=(8,)).astype(np.float32), "b": rng.normal(size=(3,)).astype(np.float32)}
+    r = {"a": rng.normal(size=(8,)).astype(np.float32), "b": rng.normal(size=(3,)).astype(np.float32)}
+    pn, rn, cos = screen_stats(p, r)
+    pf = np.concatenate([p["a"], p["b"]])
+    rf = np.concatenate([r["a"], r["b"]])
+    np.testing.assert_allclose(float(pn), np.linalg.norm(pf), rtol=1e-5)
+    np.testing.assert_allclose(float(rn), np.linalg.norm(rf), rtol=1e-5)
+    np.testing.assert_allclose(
+        float(cos), pf @ rf / (np.linalg.norm(pf) * np.linalg.norm(rf)), rtol=1e-4, atol=1e-6
+    )
+
+
+def test_defense_gates_ewma_and_one_shot_quarantine():
+    Settings.BYZ_SCREEN = True
+    fired = []
+    d = ByzantineDefense("agg", on_quarantine=fired.append)
+    ref = {"w": np.ones(8, np.float32)}
+    # honest: near the global
+    assert d.admit("x", {"w": np.full(8, 1.01, np.float32)}, ref)
+    assert d.suspicion("x") == 0.0
+    # sign flip: cos gate
+    assert not d.admit("x", {"w": -np.ones(8, np.float32)}, ref)
+    # scale: norm gate
+    assert not d.admit("x", {"w": np.full(8, 100.0, np.float32)}, ref)
+    deadline = time.monotonic() + 5
+    while not fired and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert fired == ["x"] and d.is_quarantined("x")
+    assert d.take_quarantined() == ["x"] and d.take_quarantined() == []
+    # quarantine is monotone: even honest payloads are dropped now, and
+    # the callback never fires twice
+    assert not d.admit("x", {"w": np.ones(8, np.float32)}, ref)
+    time.sleep(0.05)
+    assert fired == ["x"]
+    # self-contributions and zero-norm references abstain
+    assert d.admit("agg", {"w": -np.ones(8, np.float32)}, ref)
+    assert d.admit("y", {"w": -np.ones(8, np.float32)}, {"w": np.zeros(8, np.float32)})
+
+
+def test_defense_screen_off_only_enforces_quarantine():
+    Settings.BYZ_SCREEN = False
+    d = ByzantineDefense("agg")
+    ref = {"w": np.ones(4, np.float32)}
+    assert d.admit("x", {"w": -np.ones(4, np.float32)}, ref)  # no screening
+    assert d.suspicion("x") == 0.0
+
+
+def test_buffer_offer_screens_and_quarantines():
+    Settings.BYZ_SCREEN = True
+    logger.reset_comm_metrics()
+    d = ByzantineDefense("agg")
+    buf = BufferedAggregator("agg", {"w": np.ones(4, np.float32)}, k=3, defense=d)
+    assert buf.offer(_upd(-1.0, "evil", 1)) is None
+    assert buf.offer(_upd(-1.0, "evil", 2)) is None
+    assert d.is_quarantined("evil")
+    # post-quarantine, even an honest-looking payload from it is dropped
+    assert buf.offer(_upd(1.0, "evil", 3)) is None
+    assert buf.pending() == 0
+    # honest contributors still merge
+    for i, (origin, val) in enumerate([("a", 1.0), ("b", 1.1), ("c", 0.9)]):
+        res = buf.offer(_upd(val, origin, 1))
+    assert res is not None and res.version == 1
+    assert _sum_metric("screen_reject") >= 2
+    assert _sum_metric("byz_suspect") >= 2
+    assert _sum_metric("byz_evicted") >= 1
+    assert _sum_metric("byz_quarantined_drop") >= 1
+
+
+def test_async_screen_attributes_to_deliverer_not_payload_origin():
+    """The version triple's origin is ATTACKER-CONTROLLED: poison stamped
+    with an honest node's origin must indict the delivering peer, or a
+    lying sender could frame (and evict) the honest node (review-pinned)."""
+    Settings.BYZ_SCREEN = True
+    d = ByzantineDefense("agg")
+    buf = BufferedAggregator("agg", {"w": np.ones(4, np.float32)}, k=3, defense=d)
+    poison = _upd(-1.0, "victim", 1)  # framed: origin says "victim"
+    assert buf.offer(poison, screen_origin="attacker") is None
+    assert d.suspicion("attacker") > 0.0
+    assert d.suspicion("victim") == 0.0
+    # and the victim's real contributions keep merging after the
+    # attacker crosses the threshold
+    assert buf.offer(_upd(-1.0, "victim", 2), screen_origin="attacker") is None
+    assert d.is_quarantined("attacker") and not d.is_quarantined("victim")
+    assert buf.offer(_upd(1.0, "victim", 3), screen_origin="victim") is None  # buffers
+    assert buf.pending() == 1
+
+
+def test_add_model_screens_with_source_attribution():
+    """The sync seam: a poisoned payload indicts the DELIVERING peer (a
+    corrupted relay must not frame the honest contributor named inside)."""
+    from p2pfl_tpu.learning.aggregators.fedavg import FedAvg
+
+    Settings.BYZ_SCREEN = True
+    d = ByzantineDefense("me")
+    agg = FedAvg("me")
+    agg.defense = d
+    ref = {"w": np.ones(4, np.float32)}
+    agg.set_screen_reference(ref)
+    agg.set_nodes_to_aggregate(["me", "honest", "attacker"])
+    # the attacker relays a corrupted copy of honest's model
+    poisoned = ModelUpdate({"w": -np.ones(4, np.float32)}, ["honest"], 1)
+    assert agg.add_model(poisoned, source="attacker") == []
+    assert d.suspicion("attacker") > 0.0 and d.suspicion("honest") == 0.0
+    # honest's real model, delivered by honest, is accepted
+    good = ModelUpdate({"w": np.full(4, 1.05, np.float32)}, ["honest"], 1)
+    assert agg.add_model(good, source="honest") == ["honest"]
+
+
+def test_add_model_rejects_partial_acc_for_robust_aggregators():
+    """SUPPORTS_PARTIALS=False strategies fail LOUDLY on a fused-round
+    accumulator instead of silently folding pre-averaged state."""
+    from p2pfl_tpu.learning.aggregators.krum import Krum
+    from p2pfl_tpu.learning.aggregators.trimmed_mean import TrimmedMean
+
+    for cls in (Krum, TrimmedMean):
+        agg = cls("me")
+        agg.set_nodes_to_aggregate(["me", "peer"])
+        fused = ModelUpdate({"w": np.ones(4, np.float32)}, ["me"], 1)
+        fused.partial_acc = ({"w": np.ones(4, np.float32)}, np.float32(1.0))
+        with pytest.raises(ValueError, match="SUPPORTS_PARTIALS"):
+            agg.add_model(fused)
+        agg.clear()
+    # FedAvg (partial-supporting) keeps accepting the accumulator seam
+    from p2pfl_tpu.learning.aggregators.fedavg import FedAvg
+
+    agg = FedAvg("me")
+    agg.set_nodes_to_aggregate(["me", "peer"])
+    fused = ModelUpdate({"w": np.ones(4, np.float32)}, ["me"], 1)
+    fused.partial_acc = (
+        {"w": jnp.ones(4, dtype=jnp.float32)},
+        jnp.float32(1.0),
+    )
+    assert agg.add_model(fused) == ["me"]
+
+
+# ---------------------------------------------------------------------------
+# malformed control payloads (async_pull / async_view fuzz)
+# ---------------------------------------------------------------------------
+
+
+def test_malformed_async_ctl_payloads_drop_loudly_without_killing_node():
+    """Parity with async_update's decode-or-drop: garbage async_pull /
+    async_view frames are counted + dropped, the node keeps serving, and
+    a later experiment on the same overlay works."""
+    Settings.FEDERATION_MODE = "async"
+    nodes = [Node(learner=DummyLearner(value=float(i))) for i in range(2)]
+    for n in nodes:
+        n.start()
+    try:
+        full_connection(nodes[0], nodes)
+        wait_convergence(nodes, 1, only_direct=True, wait=10)
+        victim = nodes[0]
+        garbage = ModelUpdate(None, [nodes[1].addr], 1, encoded=b"NOT WEIGHTS")
+        # a weights frame hijacking the control verbs
+        for cmd in ("async_pull", "async_view"):
+            res = victim.protocol._dispatch(cmd, nodes[1].addr, 0, [], garbage)
+            assert res.ok  # absorbed, not an escaping error
+        # async_view with missing/garbage member lists
+        for args in ([], ["only-one"], ["\x00\xff;;;", ""]):
+            res = victim.protocol._dispatch("async_view", nodes[1].addr, 0, list(args), None)
+            assert res.ok
+        # the weights-frame variants count even with no context installed;
+        # view-arg validation needs one — install a live experiment and
+        # re-fuzz mid-run
+        assert _sum_metric("async_ctl_malformed") >= 2
+        nodes[0].set_start_learning(rounds=2, epochs=1)
+        deadline = time.monotonic() + 10
+        while victim.async_ctx is None and time.monotonic() < deadline:
+            time.sleep(0.02)
+        for args in ([], ["only-one"]):
+            res = victim.protocol._dispatch("async_view", nodes[1].addr, 0, list(args), None)
+            assert res.ok
+        res = victim.protocol._dispatch("async_pull", nodes[1].addr, 0, [], garbage)
+        assert res.ok
+        assert _sum_metric("async_ctl_malformed") >= 5
+        wait_to_finish(nodes, timeout=30)
+        assert all(n._running for n in nodes)
+        params = [np.asarray(n.learner.get_parameters()["w"]) for n in nodes]
+        np.testing.assert_allclose(params[0], params[1], atol=1e-6)
+    finally:
+        for n in nodes:
+            n.stop()
+
+
+# ---------------------------------------------------------------------------
+# simulated scale: defense off vs on, replay
+# ---------------------------------------------------------------------------
+
+
+def _byz_fleet(n, frac, kind, seed=1905, updates=6, cluster=16, target=0.5):
+    attackers = {
+        f"sim-{i:04d}": ByzantineSpec(kind=kind)
+        for i in range(0, n, max(1, int(round(1 / frac))))
+    }
+    plan = FaultPlan(seed=seed, byzantine=attackers)
+    fleet = SimulatedAsyncFleet(
+        n, seed=seed, cluster_size=cluster, k=4,
+        updates_per_node=updates, target_loss=target,
+    )
+    fleet.plan = plan
+    return fleet, attackers
+
+
+def test_simfleet_byzantine_defense_off_fails_on_converges_and_replays():
+    """The acceptance drive at test scale (the 1k row lives in
+    BENCH_ASYNC): 10% sign-flip attackers — undefended, the fleet never
+    reaches the loss target; with ASYNC_ROBUST_AGG + screening on it
+    converges, quarantines attackers through the eviction machinery, and
+    the whole run replays bit-exact from (seed, plan)."""
+    n, frac = 200, 0.10
+
+    Settings.BYZ_SCREEN = False
+    Settings.ASYNC_ROBUST_AGG = "fedavg"
+    undefended = _byz_fleet(n, frac, "sign_flip")[0].run()
+    assert undefended.byz_corrupted > 0
+    assert undefended.time_to_target is None  # measurably fails
+
+    Settings.BYZ_SCREEN = True
+    Settings.ASYNC_ROBUST_AGG = "trimmed-mean"
+    runs = [_byz_fleet(n, frac, "sign_flip")[0].run() for _ in range(2)]
+    defended, replay = runs
+    attackers = _byz_fleet(n, frac, "sign_flip")[1]
+    assert defended.time_to_target is not None
+    assert defended.final_loss() < undefended.final_loss() / 10
+    assert defended.screen_rejects > 0
+    # quarantined attackers really are attackers (no honest node evicted)
+    assert set(defended.quarantined) <= set(attackers)
+    assert len(defended.quarantined) >= len(attackers) // 2
+    # bit-exact replay: loss curve, quarantine sequence, corruption count
+    assert replay.loss_curve == defended.loss_curve
+    assert replay.quarantined == defended.quarantined
+    assert replay.byz_corrupted == defended.byz_corrupted
+    np.testing.assert_array_equal(replay.params["w"], defended.params["w"])
+
+
+def test_simfleet_byzantine_composes_with_crash_chaos():
+    """Adversaries are one more fault class: a plan mixing sign-flip
+    attackers with crashes still replays bit-exact and still converges
+    with defenses on."""
+    Settings.BYZ_SCREEN = True
+    Settings.ASYNC_ROBUST_AGG = "median"
+
+    def drive():
+        plan = FaultPlan(
+            seed=7,
+            default=EdgeFault(drop=0.02),
+            byzantine={"sim-0005": ByzantineSpec(kind="scale", lam=40.0)},
+            crashes={"sim-0011": CrashSpec(stage="AsyncTrainStage", round_no=1)},
+        )
+        fleet = SimulatedAsyncFleet(
+            24, seed=7, cluster_size=8, k=3, updates_per_node=5, target_loss=0.5
+        )
+        fleet.plan = plan
+        return fleet.run()
+
+    a, b = drive(), drive()
+    assert a.loss_curve == b.loss_curve and a.quarantined == b.quarantined
+    assert a.crashed == ["sim-0011"]
+    assert a.quarantined == ["sim-0005"]
+    # bounded damage: a λ=40 scale attack through an undefended mean would
+    # blow the consensus loss past 1e2; the median keeps it at the rank
+    # kernel's small-fleet bias (median-of-targets vs weighted-mean target)
+    assert a.final_loss() < 5.0
+
+
+# ---------------------------------------------------------------------------
+# live fleet: equivocation attacker quarantined via the eviction path
+# ---------------------------------------------------------------------------
+
+
+def test_async_live_equivocation_federation_quarantines_attacker():
+    """ISSUE 14 acceptance (threaded half): 6 nodes in 2 clusters, one
+    EQUIVOCATING attacker (a different corrupted payload per edge per
+    send). With robust merge + screening on, the survivors converge and
+    the attacker is evicted by the same machinery that evicts a corpse
+    (defense → Neighbors.evict → mark_dead → TierRouter re-derivation)."""
+    Settings.FEDERATION_MODE = "async"
+    Settings.FEDBUFF_K = 3
+    Settings.HIER_CLUSTER_SIZE = 3
+    Settings.ASYNC_ROBUST_AGG = "trimmed-mean"
+    Settings.BYZ_SCREEN = True
+    Settings.BYZ_SUSPICION_BETA = 0.8  # one clear rejection quarantines
+    nodes = [Node(learner=DummyLearner(value=float(i))) for i in range(6)]
+    for n in nodes:
+        n.start()
+    try:
+        for n in nodes:
+            full_connection(n, nodes)
+        wait_convergence(nodes, 5, only_direct=True, wait=10)
+        # members sort node-1..node-6 → clusters [1,2,3],[4,5,6]; pick an
+        # EDGE (not a regional, not the root) as the attacker
+        by_addr = {n.addr: n for n in nodes}
+        attacker = by_addr[sorted(by_addr)[1]]
+        plan = FaultPlan(
+            seed=1905,
+            byzantine={attacker.addr: ByzantineSpec(kind="equivocate", lam=40.0)},
+        )
+        install_fault_plan(nodes, plan)
+        survivors = [n for n in nodes if n is not attacker]
+        nodes[0].set_start_learning(rounds=3, epochs=1)
+        wait_to_finish(nodes, timeout=45)
+        assert _sum_metric("fault_byzantine") >= 1
+        assert _sum_metric("screen_reject") >= 1
+        assert _sum_metric("byz_evicted") >= 1  # quarantine fired
+        # the existing eviction path ran: somebody marked the attacker
+        # dead and re-derived (membership_changed counts every event)
+        assert _sum_metric("membership_changed") >= 1
+        # survivors converged on one finite global
+        params = [np.asarray(n.learner.get_parameters()["w"]) for n in survivors]
+        assert np.all(np.isfinite(params[0]))
+        for p in params[1:]:
+            np.testing.assert_allclose(p, params[0], atol=1e-5)
+        # bounded damage: one equivocated payload inside both gates can
+        # leak before quarantine lands (the documented threat model — the
+        # norm gate caps it at gate x the global's norm), but a λ=40
+        # payload landing at full weight would sit two orders higher; the
+        # QUANTITATIVE convergence claim is the simulated drive's
+        assert float(np.abs(params[0]).max()) < 50.0
+    finally:
+        remove_fault_plan(nodes)
+        for n in nodes:
+            n.stop()
+
+
+# ---------------------------------------------------------------------------
+# robust folds over sharded node-stacks (PR-10 contract)
+# ---------------------------------------------------------------------------
+
+
+def test_robust_fold_stacked_sharded_median_matches_numpy():
+    """Per-coordinate median over a node-axis-SHARDED stack: result
+    matches numpy, output lands under the requested (model-sharded)
+    specs — the fold never needs a full-model gather."""
+    from p2pfl_tpu.ops.aggregation import robust_fold_stacked
+    from p2pfl_tpu.parallel.mesh import federation_mesh
+
+    rng = np.random.default_rng(3)
+    n = 4
+    mesh = federation_mesh(devices=jax.devices()[:n])
+    shard = NamedSharding(mesh, P(Settings.MESH_NODES_AXIS))
+    stacked = {
+        "a": jax.device_put(rng.normal(size=(n, 6, 4)).astype(np.float32), shard),
+        "b": jax.device_put(rng.normal(size=(n, 8)).astype(np.float32), shard),
+    }
+    ref = jax.tree.map(lambda x: jax.ShapeDtypeStruct(x.shape[1:], x.dtype), stacked)
+    out_sh = {
+        "a": NamedSharding(mesh, P(None, Settings.MESH_MODEL_AXIS)),
+        "b": NamedSharding(mesh, P()),
+    }
+    for kind in ("median", "trimmed-mean"):
+        fold = jax.jit(
+            lambda s, kind=kind: robust_fold_stacked(s, ref, kind, trim=1),
+            out_shardings=out_sh,
+        )
+        out = fold(stacked)
+        want = (
+            np.median(np.asarray(stacked["a"]), axis=0)
+            if kind == "median"
+            else np.mean(np.sort(np.asarray(stacked["a"]), axis=0)[1:-1], axis=0)
+        )
+        np.testing.assert_allclose(np.asarray(out["a"]), want, rtol=1e-5, atol=1e-6)
+        assert out["a"].sharding.spec == P(None, Settings.MESH_MODEL_AXIS)
+
+
+def _mk_sharded(robust_agg, n=4, model_parallel=2, vote=False):
+    from p2pfl_tpu.learning.dataset import FederatedDataset
+    from p2pfl_tpu.models import mlp
+    from p2pfl_tpu.parallel import ShardedNodeFederation
+
+    rules = (
+        (r"Dense_0/kernel", (None, "model")),
+        (r"Dense_1/kernel", ("model", None)),
+        (r"Dense_2/kernel", (None, "model")),
+        (r".*", ()),
+    )
+    data = FederatedDataset.synthetic_mnist(n_train=64 * n, n_test=32, seed=5)
+    return ShardedNodeFederation.from_dataset(
+        mlp(seed=0), data, n_nodes=n, rules=rules, model_parallel=model_parallel,
+        batch_size=16, vote=vote, seed=3, optimizer="sgd", learning_rate=1e-2,
+        robust_agg=robust_agg,
+    )
+
+
+def test_sharded_federation_robust_fold_survives_poison_without_materializing():
+    """A sharded node whose slice diverges wildly (a Byzantine slice) is
+    absorbed by the median fold — and the robust fold keeps the PR-10
+    contract: inputs node-sharded, outputs model-sharded, no device holds
+    a full-model stack entry it shouldn't."""
+    from p2pfl_tpu.parallel.submesh import per_device_bytes, slice_views
+
+    fed = _mk_sharded("median")
+    fed.run_round(epochs=1)
+    honest = [np.concatenate([np.asarray(x).ravel() for x in jax.tree.leaves(fed.node_params(0))])]
+    # poison node 3's params in place (a Byzantine slice between rounds)
+    poisoned = jax.tree.map(lambda x: x * -37.0, fed.params[3])
+    fed.params[3] = poisoned
+    fed.run_round(epochs=1)
+    # fold input shardings: node-stacked params sharded over nodes
+    for sharding in jax.tree.leaves(
+        fed.last_fold["psum_shardings"], is_leaf=lambda x: hasattr(x, "spec")
+    ):
+        assert sharding.spec[0] == Settings.MESH_NODES_AXIS
+        assert not sharding.is_fully_replicated
+    # the aggregate stayed sane (the poisoned slice was rank-rejected):
+    after = np.concatenate(
+        [np.asarray(x).ravel() for x in jax.tree.leaves(fed.node_params(0))]
+    )
+    assert np.all(np.isfinite(after))
+    assert float(np.abs(after).max()) < 50.0  # -37x poison would dominate a mean
+    # live-buffer bound: no device holds a full params copy post-round
+    full = sum(np.asarray(x).nbytes for x in jax.tree.leaves(fed.model.params))
+    per_dev = per_device_bytes(fed.params)
+    assert max(per_dev.values()) < full  # model_parallel=2 ⇒ ~half + slack
+
+
+def test_sharded_robust_fold_requires_full_participation():
+    fed = _mk_sharded("trimmed-mean")
+    fed.drop_node(2)
+    with pytest.raises(RuntimeError, match="full participation"):
+        fed.run_round(epochs=1)

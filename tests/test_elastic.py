@@ -267,7 +267,7 @@ def test_async_stash_filters_on_experiment_identity():
         # an epoch bump invalidates the heuristic path but NOT the exact one
         node.state.experiment_epoch += 1
         kept = node.take_async_stash()
-        assert [u.xp for u in kept] == ["this-exp"]
+        assert [u.xp for u, _src in kept] == ["this-exp"]
         # early-init filter: a mismatched init is dropped, a matched one
         # survives past the TTL
         init = _update(4.0, ["s"])
