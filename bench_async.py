@@ -38,7 +38,23 @@ failures: 5/10/20% of the fleet running ``ByzantineSpec`` attacks
 suspicion-EWMA quarantine) — time-to-target, final loss, and how many
 attackers the eviction machinery removed, per cell.
 
-Usage: ``JAX_PLATFORMS=cpu python bench_async.py [--smoke] [--out BENCH_ASYNC.json]``
+The ``megafleet_1m`` section (ISSUE 15) drives the VECTORIZED engine
+(:class:`p2pfl_tpu.federation.megafleet.MegaFleet` — the simulator as one
+jitted ``lax.scan``) at ≥1M clients through the hierarchical plane, with
+the Bonawitz production knobs (pace steering, selection
+over-provisioning, per-tier rate limits) swept as array-level controls —
+a parameter sweep no Python event loop could produce — plus honest
+wall-clock/clients-per-second rows for the heap driver at 1k/10k next to
+the vectorized engine at the same and at 1M, and the 1k heap-parity
+check (merge count + version sequence exact).
+
+Usage: ``JAX_PLATFORMS=cpu python bench_async.py [--smoke]
+[--sections a,b,...] [--out BENCH_ASYNC.json]``
+
+``--sections`` (any of ``threaded,simulated,churn,byzantine,megafleet``)
+runs a subset and MERGES it into the existing ``--out`` document,
+leaving the other sections' rows untouched — so CI can refresh one
+section without paying for the full grid.
 """
 
 from __future__ import annotations
@@ -469,34 +485,207 @@ def run_churn(n: int = 1000, updates: int = 6, smoke: bool = False) -> dict:
     }
 
 
+def run_megafleet(smoke: bool = False) -> dict:
+    """ISSUE 15: the vectorized engine at fleet scale.
+
+    Three parts: (a) honest wall-clock rows — the heap driver at 1k and
+    10k vs the vectorized engine at 1k, 10k, 100k and 1M clients (heap
+    events grow as merges × fan-out, which is why its wall-clock
+    explodes where the scan's per-event cost stays flat). The mega rows
+    are megafleet-native ``FleetSpec.synth`` populations with matching
+    STATISTICS, not the heap's exported population, so compare
+    throughput across rows, not losses; (b) the same-task anchor is the
+    inline 1k heap-parity check (``from_sim`` export, merge count +
+    version sequence exact, final loss within the documented tolerance)
+    run against the event-exact driver in the same process; (c) the
+    ≥1M-client hierarchical drive with Bonawitz-knob sweeps — pace
+    steering and selection over-provisioning against time-to-target and
+    the staleness profile, a grid only an array engine can afford.
+    """
+    from p2pfl_tpu.federation.megafleet import FleetSpec, MegaFleet
+    from p2pfl_tpu.federation.simfleet import SimulatedAsyncFleet
+
+    heap_sizes = [1000] if smoke else [1000, 10_000]
+    mega_sizes = [1000, 20_000] if smoke else [1000, 10_000, 100_000, 1_000_000]
+    big_n = mega_sizes[-1]
+    updates = 4
+
+    def heap_fleet(n):
+        return SimulatedAsyncFleet(
+            n, seed=SEED, cluster_size=32, updates_per_node=updates,
+            slow_frac=0.10, local_lr=0.7,
+        )
+
+    # -- heap rows + the 1k parity anchor --
+    heap_rows, parity = [], None
+    for n in heap_sizes:
+        fleet = heap_fleet(n)
+        t0 = time.monotonic()
+        heap = fleet.run()
+        wall = time.monotonic() - t0
+        heap_rows.append({
+            "driver": "heap", "n_clients": n, "wall_s": round(wall, 2),
+            "clients_per_sec": int(n / wall), "merges": heap.merges,
+            "final_loss": round(heap.final_loss(), 5),
+        })
+        log(json.dumps(heap_rows[-1]))
+        if n == 1000:
+            mega = MegaFleet(
+                FleetSpec.from_sim(fleet), cluster_size=32,
+                updates_per_node=updates, local_lr=0.7,
+            ).run()
+            hl = heap.final_loss()
+            parity = {
+                "merge_count_exact": mega.merges == heap.merges,
+                "version_sequence_exact": [v for _t, v, _l in mega.loss_curve]
+                == [v for _t, v, _l in heap.loss_curve],
+                "final_loss_rel_diff": round(
+                    abs(mega.final_loss() - hl) / max(hl, 1e-12), 6
+                ),
+            }
+            log(json.dumps({"parity_1k": parity}))
+
+    # -- vectorized rows (megafleet-native population at every scale);
+    # the sweep below reuses the big row's run as its pace=0 baseline,
+    # so target_loss is threaded through (host-side post-processing
+    # only: the scan is identical) --
+    big_spec = FleetSpec.synth(big_n, seed=SEED, slow_frac=0.10)
+    start_loss = big_spec.loss(big_spec.init)
+    target = start_loss * 0.05
+    mega_rows, big_res, big_cluster, big_k = [], None, 0, None
+    for n in mega_sizes:
+        spec = big_spec if n == big_n else FleetSpec.synth(
+            n, seed=SEED, slow_frac=0.10
+        )
+        cluster = 32 if n <= 10_000 else 1024
+        k = None if n <= 10_000 else 64
+        res = MegaFleet(
+            spec, cluster_size=cluster, k=k, updates_per_node=updates,
+            local_lr=0.7, target_loss=target if n == big_n else 0.0,
+        ).run()
+        if n == big_n:
+            big_res, big_cluster, big_k = res, cluster, k
+        mega_rows.append({
+            "driver": "megafleet", "n_clients": n, "cluster_size": cluster,
+            "wall_s": round(res.wall_s, 2),
+            "clients_per_sec": int(res.clients_per_sec),
+            "events": res.n_events, "merges": res.merges,
+            "regional_merges": res.regional_merges,
+            "final_loss": round(res.final_loss(), 6),
+        })
+        log(json.dumps(mega_rows[-1]))
+
+    # -- the 1M knob sweep: pace steering × selection, plus a rate-limit
+    # cell — time-to-target (5% of cold-start loss) per cell, every cell
+    # at the big row's exact (cluster, k) config --
+
+    def cell_stats(res, **kw):
+        hist = res.staleness_hist_edge
+        tot = max(sum(hist), 1)
+        mean_tau = sum(i * c for i, c in enumerate(hist)) / tot
+        return {
+            **kw,
+            "time_to_target_s": round(res.time_to_target, 3)
+            if res.time_to_target
+            else None,
+            "final_loss": round(res.final_loss(), 6),
+            "merges": res.merges,
+            "mean_staleness": round(mean_tau, 3),
+            "stale_dropped": res.stale_dropped,
+            "rate_limited": res.rate_limited,
+            "unselected": res.unselected,
+            "wall_s": round(res.wall_s, 2),
+        }
+
+    def cell(**kw):
+        return cell_stats(
+            MegaFleet(
+                big_spec, cluster_size=big_cluster, k=big_k,
+                updates_per_node=updates, local_lr=0.7, target_loss=target,
+                **kw,
+            ).run(),
+            **kw,
+        )
+
+    # pace=0 is the big wall-clock row's exact config — reuse its run
+    sweep = [cell_stats(big_res, pace_window=0.0)]
+    log(json.dumps(sweep[-1]))
+    for pace in [0.5] if smoke else [0.5, 1.0]:
+        sweep.append(cell(pace_window=pace))
+        log(json.dumps(sweep[-1]))
+    for frac in ([0.5] if smoke else [0.75, 0.5]):
+        sweep.append(cell(select_frac=frac))
+        log(json.dumps(sweep[-1]))
+    sweep.append(cell(rate_limit_regional=0.02, rate_limit_global=0.005))
+    log(json.dumps(sweep[-1]))
+
+    return {
+        "engine": "federation/megafleet.py (one jitted lax.scan, "
+                  "ops/fleet_kernels.py)",
+        "task": "consensus least-squares, hierarchical FedBuff, "
+                f"{updates} updates/client, 10% stragglers at 10x",
+        "parity_1k": parity,
+        "parity_note": "flat merge count/version sequence/staleness "
+                       "decisions are event-exact vs the heap; "
+                       "hierarchical merge counts exact with loss "
+                       "trajectory tolerance-bounded (aggregate "
+                       "interleaving within one link_delay window) — "
+                       "see docs/design.md 'megafleet'",
+        "wall_clock": {"heap": heap_rows, "megafleet": mega_rows},
+        "sweep_1m": {
+            "n_clients": big_n,
+            "start_loss": round(start_loss, 5),
+            "target_loss": round(target, 5),
+            "cells": sweep,
+        },
+        "smoke": smoke,
+    }
+
+
+ALL_SECTIONS = ("threaded", "simulated", "churn", "byzantine", "megafleet")
+
+
 def main() -> int:
     smoke = "--smoke" in sys.argv
     out_path = "BENCH_ASYNC.json"
     if "--out" in sys.argv:
         out_path = sys.argv[sys.argv.index("--out") + 1]
+    sections = ALL_SECTIONS
+    if "--sections" in sys.argv:
+        sections = tuple(sys.argv[sys.argv.index("--sections") + 1].split(","))
+        unknown = set(sections) - set(ALL_SECTIONS)
+        if unknown:
+            log(f"unknown sections: {sorted(unknown)} (known: {ALL_SECTIONS})")
+            return 2
 
-    rows = []
-    for mode in ("sync", "async", "hier"):
-        log(f"=== threaded {mode} ===")
-        row = run_threaded(mode, rounds=2 if smoke else 4)
-        log(json.dumps(row))
-        rows.append(row)
-    sync_wall = next(r["wall_s"] for r in rows if r["mode"] == "sync")
-    for r in rows:
-        r["speedup_vs_sync"] = round(sync_wall / r["wall_s"], 2)
+    # partial runs merge into the existing document instead of dropping
+    # the sections they didn't pay for
+    doc = {}
+    if sections != ALL_SECTIONS:
+        try:
+            with open(out_path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            doc = {}
+    doc["bench"] = "async_federation_time_to_accuracy"
+    if sections == ALL_SECTIONS:
+        # partial runs must not relabel the merged document's untouched
+        # sections; section_smoke below records each section's own grid
+        doc["smoke"] = smoke
+    for s in sections:
+        doc.setdefault("section_smoke", {})[s] = smoke
 
-    log("=== simulated 1k ===")
-    simulated = run_simulated(smoke=smoke)
-
-    log("=== churn 1k ===")
-    churn = run_churn(smoke=smoke)
-
-    log("=== byzantine 1k ===")
-    byzantine = run_byzantine(smoke=smoke)
-
-    doc = {
-        "bench": "async_federation_time_to_accuracy",
-        "fleet": {
+    if "threaded" in sections:
+        rows = []
+        for mode in ("sync", "async", "hier"):
+            log(f"=== threaded {mode} ===")
+            row = run_threaded(mode, rounds=2 if smoke else 4)
+            log(json.dumps(row))
+            rows.append(row)
+        sync_wall = next(r["wall_s"] for r in rows if r["mode"] == "sync")
+        for r in rows:
+            r["speedup_vs_sync"] = round(sync_wall / r["wall_s"], 2)
+        doc["fleet"] = {
             "n_nodes": 10, "rounds": 2 if smoke else 4, "epochs": 1,
             "model": "mnist mlp (synthetic_mnist 8192/2048)",
             "plan": "seed=1905: 1 slow node (0.5s inbound weights), 1 crash "
@@ -504,19 +693,36 @@ def main() -> int:
             "target_acc": TARGET_ACC,
             "budget_note": "rounds == async local updates: identical total "
                            "local training in every mode",
-        },
-        "threaded": rows,
-        "simulated_1k": simulated,
-        "churn_1k": churn,
-        "byzantine_1k": byzantine,
-        "smoke": smoke,
-    }
+        }
+        doc["threaded"] = rows
+
+    if "simulated" in sections:
+        log("=== simulated 1k ===")
+        doc["simulated_1k"] = run_simulated(smoke=smoke)
+
+    if "churn" in sections:
+        log("=== churn 1k ===")
+        doc["churn_1k"] = run_churn(smoke=smoke)
+
+    if "byzantine" in sections:
+        log("=== byzantine 1k ===")
+        doc["byzantine_1k"] = run_byzantine(smoke=smoke)
+
+    if "megafleet" in sections:
+        log("=== megafleet ===")
+        doc["megafleet_1m"] = run_megafleet(smoke=smoke)
+
     with open(out_path, "w") as f:
         json.dump(doc, f, indent=1)
         f.write("\n")
     log(f"wrote {out_path}")
-    print(json.dumps({"metric": "bench_async", **{r['mode']: r['wall_s'] for r in rows},
-                      "speedups": {r['mode']: r['speedup_vs_sync'] for r in rows}}))
+    summary = {"metric": "bench_async", "sections": list(sections)}
+    if "threaded" in doc:
+        summary.update({r["mode"]: r["wall_s"] for r in doc["threaded"]})
+    if "megafleet_1m" in doc:
+        mrows = doc["megafleet_1m"]["wall_clock"]["megafleet"]
+        summary["megafleet_clients_per_sec"] = mrows[-1]["clients_per_sec"]
+    print(json.dumps(summary))
     return 0
 
 

@@ -28,6 +28,11 @@ the speed of the **median** instead:
 - :mod:`~p2pfl_tpu.federation.simfleet` — a deterministic event-driven
   fleet simulator (1k–10k virtual nodes, virtual clock) for scale drives
   and bit-identical replay tests;
+- :mod:`~p2pfl_tpu.federation.megafleet` — the simulator vectorized
+  into one jitted array program (``ops/fleet_kernels.py``): ≥1M
+  simulated clients with the heap driver as the bit-parity anchor at
+  1k, plus the Bonawitz fleet-scale knobs (pace steering, selection
+  over-provisioning, per-tier rate limits) as array-level controls;
 - :mod:`~p2pfl_tpu.federation.defense` — Byzantine defense-in-depth:
   the per-contribution admission screen, the per-origin suspicion EWMA
   and the quarantine hook into the existing eviction path (robust merge
@@ -36,6 +41,7 @@ the speed of the **median** instead:
 
 from p2pfl_tpu.federation.buffer import BufferedAggregator
 from p2pfl_tpu.federation.defense import ByzantineDefense
+from p2pfl_tpu.federation.megafleet import FleetSpec, MegaFleet, MegaFleetResult
 from p2pfl_tpu.federation.routing import BufferPlan, TierRouter, VersionHighWater
 from p2pfl_tpu.federation.simfleet import FleetResult, SimulatedAsyncFleet
 from p2pfl_tpu.federation.staleness import UpdateVersion, VersionVector, staleness_weight
@@ -48,7 +54,10 @@ __all__ = [
     "BufferedAggregator",
     "ByzantineDefense",
     "FleetResult",
+    "FleetSpec",
     "HierarchicalTopology",
+    "MegaFleet",
+    "MegaFleetResult",
     "SimulatedAsyncFleet",
     "TierRouter",
     "UpdateVersion",

@@ -1,0 +1,594 @@
+"""Megafleet: the async fleet simulator vectorized to ≥1M clients.
+
+:class:`~p2pfl_tpu.federation.simfleet.SimulatedAsyncFleet` is a Python
+event heap pushing real per-node buffers through ``heapq`` — exact and
+churn/adversary-capable, but ~10⁴ events/sec caps it at 1k–10k nodes.
+This module re-expresses the same run as dense arrays advanced by one
+jitted ``lax.scan`` (:mod:`~p2pfl_tpu.ops.fleet_kernels`): per-client
+``(params, adopted version, train schedule, fault stream)`` state, the
+regional tier as vectorized scatter-addressed windows, and the REAL math
+as inner functions — the FedBuff ``w(τ)`` weighting, the
+``(origin, seq)``-sorted K-flush fold (the very
+:func:`~p2pfl_tpu.ops.aggregation.fedavg` /
+:func:`~p2pfl_tpu.ops.aggregation.server_merge` kernels the live
+:class:`~p2pfl_tpu.federation.buffer.BufferedAggregator` calls), and
+:class:`~p2pfl_tpu.federation.routing.TierRouter`'s membership→tier
+derivation (clusters, regional election, K clamps come from a real
+router over the same addresses).
+
+**The heap driver stays the bit-parity anchor.** At 1k nodes on the
+consensus task, the flat vectorized engine reproduces the heap's merge
+count, version sequence and staleness decisions EXACTLY (the scan's
+chronological order is the heap's pop order — see
+``ops/fleet_kernels.py``), with the loss trajectory matching to float
+reassociation tolerance (the heap weights in Python f64, the scan in
+f32; XLA may fuse the consensus step's multiply-add). The hierarchical
+engine additionally approximates aggregate-arrival interleaving within
+one ``link_delay`` window (documented in ``docs/design.md``); its parity
+anchor pins merge counts exactly under a staleness bound wide enough
+that boundary reorderings cannot flip an admission.
+
+**Fault contract.** A :class:`~p2pfl_tpu.communication.faults.FaultPlan`
+is consumed through counter-based seed-derived streams — dense verdict
+grids indexed by ``(edge, send index)`` and generated in one vectorized
+draw from ``(plan.seed, stream id)`` — instead of the heap's per-edge
+Python ``random.Random`` streams, so a plan replays bit-exact from
+``(seed, plan)`` without a million generator objects (the verdict
+streams therefore differ from the heap's: plan-parity between the
+drivers is statistical, not per-send). Supported: ``default``
+drop/delay/jitter on upward sends — both the client→aggregator hop and
+the regional→root aggregate hop, each from its own stream (downward
+model pushes are delivered reliably with delay only; the heap can also
+drop those — a documented divergence under drop plans),
+``slow_nodes`` (inbound latency of the aggregator / the push-down hops),
+``crashes`` (``AsyncTrainStage`` → the client stops producing after
+``round_no`` updates; megafleet does NOT model the eviction/K-repair
+that follows — at fleet scale K ≪ cluster fan-in and no buffer wedges).
+Churn (joins/leaves), Byzantine specs, per-edge overrides, partitions
+and duplicate injection raise loudly: the heap driver remains the
+authority for membership and adversarial dynamics; megafleet exists for the phenomena that only
+appear at fleet scale (Bonawitz et al., MLSys'19) — staleness
+distributions, pace steering, selection over-provisioning, per-tier
+rate limits — which it exposes as array-level controls no per-edge
+Python loop could sweep.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from p2pfl_tpu.federation.routing import TierRouter
+from p2pfl_tpu.federation.simfleet import FleetResult
+
+Pytree = Any
+
+#: dedicated stream ids for the counter-based draws — one sub-seed per
+#: concern, FaultPlan-style, so arming one knob never shifts another's
+#: verdicts (e.g. enabling selection must not move drop outcomes)
+_STREAM_POP = 17  #: population shape (durations, slow membership)
+_STREAM_TARGET = 7  #: per-client consensus targets (matches simfleet)
+_STREAM_SELECT = 19
+_STREAM_DROP = 23
+_STREAM_JITTER = 29
+_STREAM_PACE = 31
+_STREAM_AGG_DROP = 37  #: regional→root aggregate send verdicts
+_STREAM_AGG_JIT = 41
+
+
+@dataclass
+class FleetSpec:
+    """The dense edge population: everything per-client as one array.
+
+    Built two ways: :meth:`from_sim` exports a live heap fleet's exact
+    population (durations, sample weights, targets — the parity hook:
+    both drivers then simulate the SAME fleet), and :meth:`synth`
+    derives a population of any size from vectorized counter-based
+    streams (the ≥1M path — deterministic in ``(n, seed)``, but not the
+    heap's per-idx streams, which would cost one Python generator per
+    client).
+    """
+
+    durations: np.ndarray  #: [N] f64 — per-update train duration
+    num_samples: np.ndarray  #: [N] f32 — sample weights (FedAvg numerators)
+    targets: np.ndarray  #: [N, dim] f32 — consensus-task private targets
+    slow: np.ndarray  #: [N] f64 — extra inbound latency when aggregator
+    init: np.ndarray  #: [dim] f32 — shared initial model
+    seed: int
+    #: the exporting fleet's wire latency (None: engine default). Carried
+    #: so a from_sim spec drives the vectorized twin with the SAME clock
+    #: without the caller re-passing it.
+    link_delay: Optional[float] = None
+
+    @property
+    def n(self) -> int:
+        return int(self.durations.shape[0])
+
+    @property
+    def dim(self) -> int:
+        return int(self.targets.shape[1])
+
+    def target_mean(self) -> np.ndarray:
+        """The fleet's consensus fixed point: the sample-weighted target
+        mean (the heap's ``_default_loss`` reference over full
+        membership)."""
+        w = self.num_samples.astype(np.float32)
+        return (w[:, None] * self.targets).sum(0) / w.sum()
+
+    def loss(self, params: np.ndarray) -> float:
+        d = np.asarray(params, np.float32) - self.target_mean()
+        return float((d * d).sum())
+
+    @classmethod
+    def from_sim(cls, fleet) -> "FleetSpec":
+        """Export a :class:`SimulatedAsyncFleet`'s population via its
+        :meth:`~p2pfl_tpu.federation.simfleet.SimulatedAsyncFleet.
+        export_spec` hook (sorted address order == index order — the two
+        drivers' fold keys agree)."""
+        d = fleet.export_spec()
+        return cls(
+            durations=d["durations"],
+            num_samples=d["num_samples"],
+            targets=d["targets"],
+            slow=d["slow"],
+            init=d["init"],
+            seed=d["seed"],
+            link_delay=d["link_delay"],
+        )
+
+    @classmethod
+    def synth(
+        cls,
+        n: int,
+        *,
+        seed: int = 0,
+        dim: int = 16,
+        base_duration: float = 1.0,
+        slow_frac: float = 0.0,
+        slow_factor: float = 10.0,
+    ) -> "FleetSpec":
+        """A megafleet-native population: same statistics as the heap's
+        (duration jitter U[0.8, 1.2]·base, a ``slow_frac`` straggler
+        population at ``slow_factor``×, samples ``1 + i mod 3``, targets
+        = shared offset + private noise), drawn in three vectorized
+        batches instead of N per-idx streams."""
+        rng = np.random.default_rng([seed, _STREAM_POP])
+        durations = base_duration * (0.8 + 0.4 * rng.random(n))
+        if slow_frac > 0.0:
+            durations = np.where(
+                rng.random(n) < slow_frac, durations * slow_factor, durations
+            )
+        base = np.random.default_rng([seed, 5]).normal(size=dim).astype(np.float32) * 2.0
+        noise = np.random.default_rng([seed, _STREAM_TARGET, n]).normal(
+            size=(n, dim)
+        ).astype(np.float32)
+        return cls(
+            durations=durations.astype(np.float64),
+            num_samples=(1 + np.arange(n) % 3).astype(np.float32),
+            targets=base[None, :] + noise,
+            slow=np.zeros(n, np.float64),
+            init=np.zeros(dim, np.float32),
+            seed=int(seed),
+        )
+
+
+@dataclass
+class MegaFleetResult(FleetResult):
+    """A :class:`FleetResult` (the heap drivers' determinism-test
+    surface — parity tests compare the shared fields directly) plus the
+    array engine's fleet-scale statistics."""
+
+    regional_merges: int = 0
+    buffered: int = 0  #: client contributions admitted into a window
+    stale_dropped: int = 0  #: τ > max_staleness at any admission gate
+    rate_limited: int = 0  #: rejected by a per-tier rate limit
+    unselected: int = 0  #: update slots skipped by selection
+    staleness_hist_edge: List[int] = field(default_factory=list)
+    staleness_hist_global: List[int] = field(default_factory=list)
+    n_events: int = 0  #: scan length (trained updates incl. dropped sends)
+    wall_s: float = 0.0  #: host wall-clock of the whole run
+    clients_per_sec: float = 0.0  #: n_clients / wall_s
+
+
+class MegaFleet:
+    """One vectorized fleet; :meth:`run` compiles and drives it.
+
+    Mirrors :class:`SimulatedAsyncFleet`'s constructor surface where the
+    semantics coincide (seed/cluster_size/k/alpha/server_lr/
+    max_staleness/updates_per_node/link_delay/local_lr/target_loss/plan)
+    and adds the Bonawitz array-level production knobs:
+
+    - ``pace_window`` — pace steering: each client's whole schedule is
+      offset by a seeded uniform draw in ``[0, pace_window)``, spreading
+      the thundering-herd first wave (and with it the staleness
+      distribution — the histograms make the effect measurable);
+    - ``select_frac`` — selection: each ``(client, update)`` slot
+      participates with this probability (an unselected device idles
+      that period, Bonawitz §4). Over-provisioning is selecting more
+      than the buffers need and measuring the wasted work;
+    - ``rate_limit_regional`` / ``rate_limit_global`` — per-tier rate
+      limits: a tier refuses offers arriving within the gap of its last
+      accepted one (counted, never raising).
+
+    Defaults for the knobs come from ``Settings.MEGAFLEET_*`` at
+    construction time (never read inside the program — the
+    jit-staleness contract).
+    """
+
+    def __init__(
+        self,
+        spec: FleetSpec,
+        *,
+        cluster_size: int = 0,
+        k: Optional[int] = None,
+        alpha: Optional[float] = None,
+        server_lr: Optional[float] = None,
+        max_staleness: Optional[int] = None,
+        updates_per_node: int = 4,
+        link_delay: Optional[float] = None,
+        local_lr: float = 0.5,
+        target_loss: float = 0.0,
+        plan=None,
+        pace_window: Optional[float] = None,
+        select_frac: Optional[float] = None,
+        rate_limit_regional: Optional[float] = None,
+        rate_limit_global: Optional[float] = None,
+        unroll: Optional[int] = None,
+    ) -> None:
+        from p2pfl_tpu.settings import Settings
+
+        self.spec = spec
+        self.n = spec.n
+        self.dim = spec.dim
+        self.seed = int(spec.seed)
+        self.cluster_size = int(cluster_size)
+        self.updates_per_node = int(updates_per_node)
+        if link_delay is None:
+            link_delay = spec.link_delay if spec.link_delay is not None else 0.01
+        self.link_delay = float(link_delay)
+        self.local_lr = float(local_lr)
+        self.target_loss = float(target_loss)
+        self.k = max(1, int(Settings.FEDBUFF_K if k is None else k))
+        self.alpha = float(Settings.FEDBUFF_ALPHA if alpha is None else alpha)
+        self.server_lr = float(
+            Settings.FEDBUFF_SERVER_LR if server_lr is None else server_lr
+        )
+        self.max_staleness = int(
+            Settings.ASYNC_MAX_STALENESS if max_staleness is None else max_staleness
+        )
+        self.pace_window = float(
+            Settings.MEGAFLEET_PACE_WINDOW if pace_window is None else pace_window
+        )
+        self.select_frac = float(
+            Settings.MEGAFLEET_SELECT_FRAC if select_frac is None else select_frac
+        )
+        self.rate_limit_regional = float(
+            Settings.MEGAFLEET_REGIONAL_RATE_S
+            if rate_limit_regional is None
+            else rate_limit_regional
+        )
+        self.rate_limit_global = float(
+            Settings.MEGAFLEET_GLOBAL_RATE_S
+            if rate_limit_global is None
+            else rate_limit_global
+        )
+        self.unroll = max(1, int(Settings.MEGAFLEET_SCAN_UNROLL if unroll is None else unroll))
+        self.plan = plan
+        self._check_plan(plan)
+
+        # membership → tiers through the REAL router: clusters, regional
+        # election and K clamps are TierRouter's derivation, not a
+        # re-implementation (sorted zero-padded addresses == index order,
+        # so cluster slices are contiguous index ranges)
+        width = max(4, len(str(self.n - 1)))
+        self.addrs = [f"sim-{i:0{width}d}" for i in range(self.n)]
+        self.router = TierRouter(self.addrs, self.cluster_size)
+        self._addr_idx = {a: j for j, a in enumerate(self.addrs)}
+        self.hier = not self.router.topo.is_flat()
+
+    def _check_plan(self, plan) -> None:
+        if plan is None:
+            return
+        unsupported = [
+            name
+            for name, val in (
+                ("edges", plan.edges),
+                ("partitions", plan.partitions),
+                ("joins", plan.joins),
+                ("leaves", plan.leaves),
+                ("byzantine", plan.byzantine),
+                ("default.duplicate", plan.default.duplicate),
+            )
+            if val
+        ]
+        if unsupported:
+            raise ValueError(
+                "MegaFleet supports FaultPlan default drop/delay/jitter, "
+                "slow_nodes and AsyncTrainStage crashes; "
+                f"{'/'.join(unsupported)} need the heap driver "
+                "(SimulatedAsyncFleet — megafleet is the steady-state "
+                "fleet-scale engine, not the churn/adversary one)"
+            )
+
+    # ---- array derivation (host, vectorized numpy) ----
+
+    def _tier_arrays(self):
+        """Per-client and per-regional routing arrays from the router."""
+        n, L = self.n, self.link_delay
+        plan_delay = float(self.plan.default.delay) if self.plan is not None else 0.0
+        slow = self.spec.slow
+        if self.plan is not None and self.plan.slow_nodes:
+            # fold the plan's inbound latencies into the population by
+            # max: idempotent whether or not the spec already carries
+            # them (export_spec folds the same plan; synth exports zeros)
+            plan_slow = np.zeros(n, np.float64)
+            for addr, extra in self.plan.slow_nodes.items():
+                j = self._addr_idx.get(addr)
+                if j is not None:
+                    plan_slow[j] = float(extra)
+            slow = np.maximum(slow, plan_slow)
+        clusters = self.router.topo.clusters
+        regionals = self.router.regionals
+        root = self.router.root
+        regional_of = np.zeros(n, np.int32)
+        for ci, cluster in enumerate(clusters):
+            for a in cluster:
+                regional_of[self._addr_idx[a]] = ci
+        reg_idx = np.asarray([self._addr_idx[a] for a in regionals], np.int32)
+        is_regional = np.zeros(n, bool)
+        is_regional[reg_idx] = True
+        root_i = self._addr_idx[root]
+
+        hop_reg = L + plan_delay + slow[reg_idx[regional_of]]  # [N] edge→its regional
+        hop_down_self = L + plan_delay + slow  # [N] aggregator→this client
+        # arrival of a client's own update at its aggregator: regionals
+        # (incl. the root) self-offer at t exactly (the heap's src==dst
+        # bypass — no delay, no fault verdict)
+        arr_delay = np.where(is_regional, 0.0, hop_reg)
+        # adoption: how long a fresh global takes to reach this client
+        # (root 0; regionals one hop; root-cluster edges one hop; other
+        # edges two hops — each hop pays the receiver's slow_nodes latency)
+        reg_adopt = np.where(reg_idx == root_i, 0.0, L + plan_delay + slow[reg_idx])
+        adopt_delay = np.where(
+            regional_of == regional_of[root_i],
+            hop_down_self,
+            reg_adopt[regional_of] + hop_down_self,
+        )
+        adopt_delay[reg_idx] = reg_adopt
+        adopt_delay[root_i] = 0.0
+        # regional→root aggregate delay (0: the root's own cluster offers
+        # its regional flush into the global window directly)
+        agg_delay = np.where(
+            reg_idx == root_i, 0.0, L + plan_delay + slow[root_i]
+        )
+        k_reg = np.asarray(
+            [
+                self.router.buffer_plan(a, self.k).regional_k or 1
+                for a in regionals
+            ],
+            np.int32,
+        )
+        k_global = self.router.buffer_plan(root, self.k).global_k or 1
+        return {
+            "regional_of": regional_of,
+            "is_regional": is_regional,
+            "arr_delay": arr_delay,
+            "adopt_delay": adopt_delay,
+            "reg_adopt": reg_adopt,
+            "agg_delay": agg_delay,
+            "is_root_reg": reg_idx == root_i,
+            "k_reg": k_reg,
+            "k_global": int(k_global),
+        }
+
+    def _agg_grids(self, tiers, stride: int):
+        """Per-(regional, up_seq) drop verdicts and jitter for the
+        regional→root aggregate sends — the heap routes these through
+        ``_edge_verdict`` too, so the plan's default drop/jitter must
+        reach this seam (counter-based streams; the root's own cluster
+        offers directly and bypasses the wire, heap semantics)."""
+        r = len(tiers["k_reg"])
+        ok = np.ones((r, stride), bool)
+        jit = np.zeros((r, stride), np.float32)
+        plan = self.plan
+        if plan is not None and self.hier:
+            if plan.default.drop > 0.0:
+                ok = (
+                    np.random.default_rng([self.seed, _STREAM_AGG_DROP]).random(
+                        (r, stride)
+                    )
+                    >= plan.default.drop
+                )
+                ok[tiers["is_root_reg"], :] = True
+            if plan.default.jitter > 0.0:
+                jit = (
+                    np.random.default_rng([self.seed, _STREAM_AGG_JIT])
+                    .random((r, stride))
+                    .astype(np.float32)
+                    * np.float32(plan.default.jitter)
+                )
+                jit[tiers["is_root_reg"], :] = 0.0
+        return ok, jit
+
+    def _events(self, tiers) -> Dict[str, np.ndarray]:
+        """The sorted arrival rows + verdict grids (counter-based)."""
+        n, M = self.n, self.updates_per_node
+        d = self.spec.durations
+        seed = self.seed
+        crash_limit = np.full(n, M, np.int64)
+        if self.plan is not None:
+            for addr, spec in self.plan.crashes.items():
+                j = self._addr_idx.get(addr)
+                if j is not None and spec.stage == "AsyncTrainStage":
+                    crash_limit[j] = min(M, spec.round_no or 0)
+        pace = np.zeros(n, np.float64)
+        if self.pace_window > 0.0:
+            pace = (
+                np.random.default_rng([seed, _STREAM_PACE]).random(n)
+                * self.pace_window
+            )
+        m = np.arange(1, M + 1)
+        alive = m[None, :] <= crash_limit[:, None]  # [N, M]
+        selected = np.ones((n, M), bool)
+        if self.select_frac < 1.0:
+            selected = (
+                np.random.default_rng([seed, _STREAM_SELECT]).random((n, M))
+                < self.select_frac
+            )
+        unselected = int((alive & ~selected).sum())
+        mask = alive & selected
+        t_train = pace[:, None] + m[None, :] * d[:, None]  # [N, M]
+        t_arr = t_train + tiers["arr_delay"][:, None]
+        plan = self.plan
+        if plan is not None and plan.default.jitter > 0.0:
+            jit = (
+                np.random.default_rng([seed, _STREAM_JITTER]).random((n, M))
+                * plan.default.jitter
+            )
+            # regionals self-offer — no wire, no jitter (src==dst bypass;
+            # keyed on the explicit mask, not arr_delay, which collapses
+            # to 0 for everyone at link_delay=0)
+            jit[tiers["is_regional"], :] = 0.0
+            t_arr = t_arr + jit
+        send_ok = np.ones((n, M), bool)
+        if plan is not None and plan.default.drop > 0.0:
+            dropped = (
+                np.random.default_rng([seed, _STREAM_DROP]).random((n, M))
+                < plan.default.drop
+            )
+            dropped[tiers["is_regional"], :] = False  # src==dst bypass
+            send_ok = ~dropped
+        ii, mm = np.nonzero(mask)
+        tt, ta = t_train[ii, mm], t_arr[ii, mm]
+        ok = send_ok[ii, mm]
+        order = np.lexsort((mm, ii, ta))
+        key = (ii * (M + 1) + (mm + 1)).astype(np.int64)
+        if key.size and key.max() >= np.iinfo(np.int32).max:
+            raise ValueError("fold-key overflow: n_clients * updates too large")
+        return {
+            "client": ii[order].astype(np.int32),
+            "key": key[order].astype(np.int32),
+            "t_train": tt[order].astype(np.float32),
+            "t_arr": ta[order].astype(np.float32),
+            "send_ok": ok[order],
+            "_unselected": unselected,
+        }
+
+    # ---- the drive ----
+
+    def run(self) -> MegaFleetResult:
+        import jax.numpy as jnp
+
+        from p2pfl_tpu.ops import fleet_kernels as fk
+
+        t0 = time.monotonic()
+        tiers = self._tier_arrays()
+        ev = self._events(tiers)
+        unselected = ev.pop("_unselected")
+        E = int(ev["client"].shape[0])
+        dropped_wire = int((~ev["send_ok"]).sum())
+
+        # capacity bounds (exact: every flush consumes K distinct
+        # accepted events / aggregates)
+        if self.hier:
+            counts = np.bincount(
+                tiers["regional_of"][ev["client"]], minlength=len(tiers["k_reg"])
+            )
+            per_reg = counts // np.maximum(tiers["k_reg"], 1)
+            agg_cap = int(per_reg.sum()) + 1
+            v_cap = agg_cap // tiers["k_global"] + 2
+            stride = int(per_reg.max(initial=0)) + 2
+            if stride * len(tiers["k_reg"]) >= np.iinfo(np.int32).max:
+                raise ValueError("aggregate fold-key overflow")
+        else:
+            v_cap = E // tiers["k_global"] + 2
+            stride = 2
+        cfg = fk.FleetConfig(
+            hier=self.hier,
+            n_clients=self.n,
+            dim=self.dim,
+            n_regionals=len(self.router.regionals),
+            k_global=tiers["k_global"],
+            k_reg_max=int(tiers["k_reg"].max(initial=1)) if self.hier else 1,
+            v_cap=max(v_cap, 2),
+            alpha=self.alpha,
+            server_lr=self.server_lr,
+            local_lr=self.local_lr,
+            max_staleness=self.max_staleness,
+            rate_gap_reg=self.rate_limit_regional,
+            rate_gap_glob=self.rate_limit_global,
+            hist_bins=self.max_staleness + 2,
+            agg_key_stride=stride,
+            unroll=self.unroll,
+        )
+        events = {
+            "client": jnp.asarray(ev["client"]),
+            "key": jnp.asarray(ev["key"]),
+            "t_train": jnp.asarray(ev["t_train"]),
+            "t_arr": jnp.asarray(ev["t_arr"]),
+            "send_ok": jnp.asarray(ev["send_ok"]),
+        }
+        clients = {
+            "targets": jnp.asarray(self.spec.targets, jnp.float32),
+            "samples": jnp.asarray(self.spec.num_samples, jnp.float32),
+            "adopt_delay": jnp.asarray(tiers["adopt_delay"], jnp.float32),
+            "regional_of": jnp.asarray(tiers["regional_of"]),
+        }
+        agg_ok, agg_jit = self._agg_grids(tiers, stride)
+        reg = {
+            "k": jnp.asarray(tiers["k_reg"]),
+            "adopt_delay": jnp.asarray(tiers["reg_adopt"], jnp.float32),
+            "agg_delay": jnp.asarray(tiers["agg_delay"], jnp.float32),
+            "send_ok": jnp.asarray(agg_ok),
+            "jit": jnp.asarray(agg_jit),
+        }
+        init = jnp.asarray(self.spec.init, jnp.float32)
+        out = fk.run_fleet_program(cfg, events, clients, reg, init)
+
+        version = int(out["version"])
+        G = np.asarray(out["G"][: version + 1])
+        mint = np.asarray(out["mint"][:version], np.float64)
+        t_mean = self.spec.target_mean()
+        diffs = G - t_mean[None, :]
+        losses = (diffs * diffs).sum(axis=1).astype(np.float64)
+        curve = [(float(mint[v - 1]), v, float(losses[v])) for v in range(1, version + 1)]
+        ttt = next(
+            (t for t, _v, loss in curve if loss <= self.target_loss), None
+        )
+        wall = time.monotonic() - t0
+        res = MegaFleetResult(
+            params={"w": G[version].copy()},
+            version=version,
+            virtual_time=float(ev["t_arr"][-1]) if E else 0.0,
+            time_to_target=ttt,
+            loss_curve=curve,
+            updates_sent=E,
+            updates_delivered=E - dropped_wire,
+            # the heap's counter includes dropped regional→root aggregates
+            updates_dropped_wire=dropped_wire + int(out.get("agg_drop", 0)),
+            merges=int(out["merges"]),
+            regional_merges=int(out.get("rmerges", 0)),
+            buffered=int(np.asarray(out["hist_edge"]).sum()),
+            stale_dropped=int(out["stale_edge"]) + int(out["stale_agg"]),
+            rate_limited=int(out["rate_edge"]) + int(out["rate_agg"]),
+            unselected=unselected,
+            staleness_hist_edge=[int(x) for x in np.asarray(out["hist_edge"])],
+            staleness_hist_global=[int(x) for x in np.asarray(out["hist_glob"])],
+            n_events=E,
+            wall_s=wall,
+            clients_per_sec=self.n / wall if wall > 0 else 0.0,
+        )
+        if self.plan is not None:
+            # heap parity: only crashes that actually FIRE are recorded —
+            # a round_no past the schedule never enters AsyncTrainStage
+            res.crashed = [
+                a
+                for a, s in self.plan.crashes.items()
+                if a in self._addr_idx
+                and s.stage == "AsyncTrainStage"
+                and (s.round_no or 0) < self.updates_per_node
+            ]
+        return res

@@ -128,6 +128,18 @@ class SimulatedAsyncFleet:
     plane exists for). ``evict_delay`` is the virtual stand-in for the
     heartbeat eviction window: how long after a crash/abrupt leave the
     survivors re-derive the topology around the corpse.
+
+    **Ownership contract (copy-on-write):** params trees on the virtual
+    wire are immutable and pass by REFERENCE — deliveries, adoptions,
+    buffer seeds and bootstrap pulls alias the producer's tree instead
+    of deep-copying it per event (the pre-megafleet per-delivery
+    ``_copy_tree`` was the 1k-heap drives' hottest line). The sites that
+    *change* a tree already produce fresh ones: ``train_fn`` must return
+    a new tree (the default does — mutating its input in place is a
+    contract violation that would corrupt aliased buffer snapshots),
+    ``BufferedAggregator`` merges build new params via the jitted
+    kernels, and ``byz_corrupt_update`` corrupts a fresh copy, never the
+    honest original.
     """
 
     def __init__(
@@ -216,7 +228,7 @@ class SimulatedAsyncFleet:
         self._heap: list = []
         self._evseq = itertools.count()
         self.result = FleetResult(
-            params=_copy_tree(init_params), version=0, virtual_time=0.0,
+            params=init_params, version=0, virtual_time=0.0,
             time_to_target=None, loss_curve=[],
         )
 
@@ -232,7 +244,7 @@ class SimulatedAsyncFleet:
         dur = self._base_duration * (0.8 + 0.4 * float(rng.random()))
         if self._slow_frac > 0.0 and float(rng.random()) < self._slow_frac:
             dur *= self._slow_factor
-        node = _SimNode(addr, idx, _copy_tree(self._init), 1 + idx % 3, dur)
+        node = _SimNode(addr, idx, self._init, 1 + idx % 3, dur)
         self.nodes[addr] = node
         return node
 
@@ -252,6 +264,56 @@ class SimulatedAsyncFleet:
         if c is None:
             c = self._up_seq[addr] = itertools.count(1)
         return next(c)
+
+    def export_spec(self) -> Dict[str, Any]:
+        """Dense-array export of this fleet's population — the megafleet
+        parity hook: :meth:`p2pfl_tpu.federation.megafleet.FleetSpec.
+        from_sim` builds the vectorized engine's population from exactly
+        these arrays (sorted-address order == index order, so the two
+        drivers' fold keys agree), which is what lets the 1k parity
+        tests drive the SAME fleet through both engines."""
+        if set(self._init) != {"w"}:
+            raise ValueError(
+                "export_spec supports the consensus-task layout "
+                "({'w': [dim]}) — custom workloads have no vectorized twin"
+            )
+        if (
+            getattr(self.train_fn, "__func__", None)
+            is not SimulatedAsyncFleet._default_train
+            or getattr(self.loss_fn, "__func__", None)
+            is not SimulatedAsyncFleet._default_loss
+        ):
+            raise ValueError(
+                "export_spec supports the default consensus workload — "
+                "a custom train_fn/loss_fn has no vectorized twin"
+            )
+        if self.n > 10_000:
+            # simfleet pads addresses to 4 digits; past 10k its
+            # lexicographic order no longer equals index order and the
+            # two drivers' address schemes diverge — the parity hook
+            # covers the heap's reachable scale, megafleet-native
+            # populations use FleetSpec.synth
+            raise ValueError(
+                "export_spec is the <=10k parity hook (4-digit address "
+                "regime); use FleetSpec.synth for larger populations"
+            )
+        addrs = sorted(self.nodes)
+        nodes = [self.nodes[a] for a in addrs]
+        slow = np.zeros(len(addrs), np.float64)
+        if self.plan is not None:
+            for j, a in enumerate(addrs):
+                slow[j] = float(self.plan.slow_nodes.get(a, 0.0))
+        return {
+            "durations": np.asarray([n.duration for n in nodes], np.float64),
+            "num_samples": np.asarray([n.num_samples for n in nodes], np.float32),
+            "targets": np.stack(
+                [self._target(n.idx) for n in nodes]
+            ).astype(np.float32),
+            "slow": slow,
+            "init": np.asarray(self._init["w"], np.float32),
+            "seed": self.seed,
+            "link_delay": self.link_delay,
+        }
 
     # ---- default workload ----
 
@@ -368,13 +430,13 @@ class SimulatedAsyncFleet:
                     regional = op.tier == "regional"
                     floor = version if regional else max(version, node.high_water)
                     b = BufferedAggregator(
-                        addr, _copy_tree(params), k=op.k,
+                        addr, params, k=op.k,
                         alpha=self._alpha, server_lr=self._server_lr,
                         max_staleness=self._max_staleness, bump_on_flush=not regional,
                         defense=self._defense_for(addr),
                     )
                     if floor > 0:
-                        b.set_global(_copy_tree(params), floor)
+                        b.set_global(params, floor)
                     bufs[op.tier] = b
                 else:  # resize
                     res = bufs[op.tier].set_k(op.k)
@@ -412,7 +474,7 @@ class SimulatedAsyncFleet:
             if version > 0:
                 self._push(
                     t + self.link_delay, "model_arrive",
-                    (addr, _copy_tree(params), version, target),
+                    (addr, params, version, target),
                 )
         self._push(t + self.link_delay + node.duration, "train_done", (addr,))
 
@@ -448,7 +510,7 @@ class SimulatedAsyncFleet:
             for tgt in sorted(targets):
                 if tgt not in self._dead:
                     self._deliver_model(
-                        t, addr, tgt, _copy_tree(node.global_params), node.known_version
+                        t, addr, tgt, node.global_params, node.known_version
                     )
 
     def _on_evict(self, t: float, addr: str) -> None:
@@ -523,7 +585,7 @@ class SimulatedAsyncFleet:
         rng = np.random.default_rng([self.seed, 13, node.idx, node.updates_done])
         node.model = self.train_fn(node.idx, node.model, rng)
         node.updates_done += 1
-        upd = ModelUpdate(_copy_tree(node.model), [addr], node.num_samples)
+        upd = ModelUpdate(node.model, [addr], node.num_samples)
         upd.version = (addr, next(node.seq), node.base_version)
         self.result.updates_sent += 1
         target = self.router.push_target(addr)
@@ -647,5 +709,3 @@ class SimulatedAsyncFleet:
                     self._deliver_model(t, addr, child, params, version)
 
 
-def _copy_tree(tree: Pytree) -> Pytree:
-    return {k: np.array(v, copy=True) for k, v in tree.items()}

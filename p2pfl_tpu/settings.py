@@ -268,6 +268,28 @@ class Settings:
     # single direct round-trip, so this only needs to cover connection
     # setup plus one full-model push.
     ASYNC_JOIN_TIMEOUT: float = 15.0
+    # --- Megafleet (federation/megafleet.py, ops/fleet_kernels.py) ---
+    # Default Bonawitz production knobs for the vectorized fleet engine,
+    # read ONCE at MegaFleet construction (never inside a traced body —
+    # the jit-staleness contract). Pace steering: each simulated client's
+    # whole schedule is offset by a seeded uniform draw in
+    # [0, PACE_WINDOW) virtual seconds, spreading the first-wave
+    # thundering herd (0 disables).
+    MEGAFLEET_PACE_WINDOW: float = 0.0
+    # Selection: each (client, update) slot participates with this
+    # probability (an unselected device idles the period — Bonawitz §4's
+    # device selection; over-provisioning = selecting more than the
+    # buffers need and measuring the wasted work). 1.0 = everyone.
+    MEGAFLEET_SELECT_FRAC: float = 1.0
+    # Per-tier rate limits (virtual seconds between ACCEPTED offers at a
+    # regional window / the global window): a tier refuses offers landing
+    # inside the gap (counted rate_limited, never raising). 0 disables
+    # and compiles the gate out of the scan.
+    MEGAFLEET_REGIONAL_RATE_S: float = 0.0
+    MEGAFLEET_GLOBAL_RATE_S: float = 0.0
+    # lax.scan unroll factor for the fleet program — a throughput/compile
+    # -time trade on multi-million-event scans.
+    MEGAFLEET_SCAN_UNROLL: int = 1
     # --- Byzantine robustness (federation/defense.py, ops/aggregation.py) ---
     # Which merge kernel the async plane's BufferedAggregator folds a
     # flushed buffer with: "fedavg" is the FedBuff staleness-weighted mean
@@ -542,6 +564,11 @@ def set_test_settings() -> None:
     Settings.HIER_CLUSTER_SIZE = 0
     Settings.ASYNC_DRAIN_TIMEOUT = 15.0
     Settings.ASYNC_JOIN_TIMEOUT = 5.0
+    Settings.MEGAFLEET_PACE_WINDOW = 0.0
+    Settings.MEGAFLEET_SELECT_FRAC = 1.0
+    Settings.MEGAFLEET_REGIONAL_RATE_S = 0.0
+    Settings.MEGAFLEET_GLOBAL_RATE_S = 0.0
+    Settings.MEGAFLEET_SCAN_UNROLL = 1
     Settings.TRAIN_SET_SIZE = 4
     Settings.VOTE_TIMEOUT = 10.0
     Settings.AGGREGATION_TIMEOUT = 10.0
