@@ -48,13 +48,24 @@ wall-clock/clients-per-second rows for the heap driver at 1k/10k next to
 the vectorized engine at the same and at 1M, and the 1k heap-parity
 check (merge count + version sequence exact).
 
+The ``megafleet_chunks`` section (ISSUE 16) sweeps the chunked engine's
+``MEGAFLEET_CHUNK`` knob at the 1M scale against the per-event reference
+scan — clients/second per chunk size, with an inline bit-identity check
+(flat chunked results must equal the per-event scan EXACTLY) — and the
+``megafleet_robust`` section runs the full-fault-algebra sweep the array
+engine exists for: attacker fraction (5–20%) × corruption kind
+(sign_flip/scale/noise) × window fold (fedavg/trimmed-mean/median) at
+1M clients, with one cell tolerance-pinned against the heap driver at
+1k.
+
 Usage: ``JAX_PLATFORMS=cpu python bench_async.py [--smoke]
 [--sections a,b,...] [--out BENCH_ASYNC.json]``
 
-``--sections`` (any of ``threaded,simulated,churn,byzantine,megafleet``)
-runs a subset and MERGES it into the existing ``--out`` document,
-leaving the other sections' rows untouched — so CI can refresh one
-section without paying for the full grid.
+``--sections`` (any of ``threaded,simulated,churn,byzantine,megafleet,
+megafleet_chunks,megafleet_robust``) runs a subset and MERGES it into
+the existing ``--out`` document, leaving the other sections' rows
+untouched — so CI can refresh one section without paying for the full
+grid.
 """
 
 from __future__ import annotations
@@ -642,7 +653,181 @@ def run_megafleet(smoke: bool = False) -> dict:
     }
 
 
-ALL_SECTIONS = ("threaded", "simulated", "churn", "byzantine", "megafleet")
+def run_megafleet_chunks(smoke: bool = False) -> dict:
+    """ISSUE 16: the chunked-event engine vs the per-event reference.
+
+    Two parts: (a) an inline BIT-IDENTITY check on a flat fleet — the
+    chunked engine's batched gather → segment-fold → predicated scatter
+    must reproduce the per-event scan's every float (this is the pinned
+    invariant, run here at a scale the test suite doesn't pay for); (b)
+    the chunk-size sweep at the big hierarchical scale: clients/second
+    per ``MEGAFLEET_CHUNK``, including the ``chunk=1`` per-event
+    baseline row the ≥2× acceptance is measured against.
+    """
+    from p2pfl_tpu.federation.megafleet import FleetSpec, MegaFleet
+
+    big_n = 50_000 if smoke else 1_000_000
+    updates = 4
+
+    # -- (a) flat bit-identity at 20k --
+    pn = 5000 if smoke else 20_000
+    pspec = FleetSpec.synth(pn, seed=SEED, slow_frac=0.10)
+
+    def flat(chunk):
+        return MegaFleet(
+            pspec, cluster_size=0, k=32, updates_per_node=updates,
+            local_lr=0.7, chunk=chunk,
+        ).run()
+
+    ref, got = flat(1), flat(256)
+    identity = {
+        "n_clients": pn,
+        "merges_equal": got.merges == ref.merges,
+        "loss_curve_bit_equal": got.loss_curve == ref.loss_curve,
+        "params_bit_equal": bool(
+            np.array_equal(got.params["w"], ref.params["w"])
+        ),
+    }
+    log(json.dumps({"chunked_bit_identity": identity}))
+
+    # -- (b) the chunk sweep at scale --
+    spec = FleetSpec.synth(big_n, seed=SEED, slow_frac=0.10)
+    rows = []
+    chunks = [1, 64, 256] if smoke else [1, 64, 256, 512]
+    for chunk in chunks:
+        res = MegaFleet(
+            spec, cluster_size=1024, k=64, updates_per_node=updates,
+            local_lr=0.7, chunk=chunk,
+        ).run()
+        rows.append({
+            "chunk": chunk, "n_clients": big_n,
+            "wall_s": round(res.wall_s, 2),
+            "clients_per_sec": int(res.clients_per_sec),
+            "events_per_sec": int(res.n_events / max(res.wall_s, 1e-9)),
+            "merges": res.merges, "regional_merges": res.regional_merges,
+        })
+        log(json.dumps(rows[-1]))
+    base = rows[0]["clients_per_sec"]
+    best = max(rows[1:], key=lambda r: r["clients_per_sec"])
+    return {
+        "engine": "run_fleet_program_chunked (ops/fleet_kernels.py)",
+        "bit_identity_flat": identity,
+        "sweep": rows,
+        "speedup_best_vs_per_event": round(
+            best["clients_per_sec"] / max(base, 1), 2
+        ),
+        "smoke": smoke,
+    }
+
+
+def run_megafleet_robust(smoke: bool = False) -> dict:
+    """ISSUE 16: the robust-aggregation attacker sweep at fleet scale.
+
+    Attacker fraction × corruption kind × window fold, every cell a full
+    1M-client hierarchical drive with the attackers spread across
+    clusters (stride placement, so elected regionals corrupt their
+    aggregate sends too). The defense claim is measured, not asserted:
+    trimmed-mean/median final losses vs fedavg's under the same attack.
+    One cell re-runs at 1k against the heap driver (which flushes
+    through ``Settings.ASYNC_ROBUST_AGG``) as the tolerance pin.
+    """
+    from p2pfl_tpu.communication.faults import ByzantineSpec, FaultPlan
+    from p2pfl_tpu.federation.megafleet import FleetSpec, MegaFleet
+    from p2pfl_tpu.federation.simfleet import SimulatedAsyncFleet
+    from p2pfl_tpu.settings import Settings
+
+    big_n = 20_000 if smoke else 1_000_000
+    updates = 4
+    width = max(4, len(str(big_n - 1)))
+    spec = FleetSpec.synth(big_n, seed=SEED, slow_frac=0.10)
+
+    def attack_plan(frac, kind):
+        step = max(1, round(1.0 / frac))
+        spec_kw = {"scale": {"lam": 50.0}, "noise": {"noise_std": 5.0}}.get(
+            kind, {}
+        )
+        byz = {
+            f"sim-{i:0{width}d}": ByzantineSpec(kind=kind, **spec_kw)
+            for i in range(0, big_n, step)
+        }
+        return FaultPlan(seed=SEED, byzantine=byz)
+
+    fracs = [0.10] if smoke else [0.05, 0.10, 0.20]
+    kinds = ["sign_flip"] if smoke else ["sign_flip", "scale", "noise"]
+    folds = ["fedavg", "median"] if smoke else [
+        "fedavg", "trimmed-mean", "median"
+    ]
+    cells = []
+    for frac in fracs:
+        for kind in kinds:
+            plan = attack_plan(frac, kind)
+            for fold in folds:
+                res = MegaFleet(
+                    spec, cluster_size=1024, k=64, updates_per_node=updates,
+                    local_lr=0.7, plan=plan, fold=fold,
+                ).run()
+                fl = res.final_loss()
+                cells.append({
+                    "attacker_frac": frac, "kind": kind, "fold": fold,
+                    "final_loss": round(fl, 6) if np.isfinite(fl) else None,
+                    "diverged": not bool(np.isfinite(fl)),
+                    "merges": res.merges,
+                    "byz_corrupted": res.byz_corrupted,
+                    "wall_s": round(res.wall_s, 2),
+                    "clients_per_sec": int(res.clients_per_sec),
+                })
+                log(json.dumps(cells[-1]))
+
+    # -- the 1k heap pin: one cell, both drivers, same plan+fold --
+    pin_kind, pin_fold, pin_frac = kinds[0], folds[-1], fracs[0]
+    step = max(1, round(1.0 / pin_frac))
+    pin_byz = {
+        f"sim-{i:04d}": ByzantineSpec(kind=pin_kind)
+        for i in range(0, 1000, step)
+    }
+    pin_plan = FaultPlan(seed=SEED, byzantine=pin_byz)
+    old_fold = Settings.ASYNC_ROBUST_AGG
+    try:
+        Settings.ASYNC_ROBUST_AGG = pin_fold
+        fleet = SimulatedAsyncFleet(
+            1000, seed=SEED, cluster_size=32, updates_per_node=updates,
+            slow_frac=0.10, local_lr=0.7, plan=pin_plan,
+        )
+        pspec = FleetSpec.from_sim(fleet)
+        heap = fleet.run()
+        mega = MegaFleet(
+            pspec, cluster_size=32, updates_per_node=updates, local_lr=0.7,
+            plan=pin_plan, fold=pin_fold,
+        ).run()
+    finally:
+        Settings.ASYNC_ROBUST_AGG = old_fold
+    hl = heap.final_loss()
+    pin = {
+        "n_clients": 1000, "kind": pin_kind, "fold": pin_fold,
+        "attacker_frac": pin_frac,
+        "merge_count_exact": mega.merges == heap.merges,
+        "byz_corrupted_exact": mega.byz_corrupted == heap.byz_corrupted,
+        "final_loss_rel_diff": round(
+            abs(mega.final_loss() - hl) / max(hl, 1e-12), 6
+        ),
+    }
+    log(json.dumps({"robust_pin_1k": pin}))
+    return {
+        "engine": "fold_window kind=trimmed-mean/median "
+                  "(ops/fleet_kernels.py) == ops/aggregation."
+                  "buffered_robust_merge's rank statistics",
+        "attack": "stride-placed attackers (regionals corrupt aggregate "
+                  "sends), scale lam=50, noise std=5",
+        "cells": cells,
+        "heap_pin_1k": pin,
+        "smoke": smoke,
+    }
+
+
+ALL_SECTIONS = (
+    "threaded", "simulated", "churn", "byzantine", "megafleet",
+    "megafleet_chunks", "megafleet_robust",
+)
 
 
 def main() -> int:
@@ -712,6 +897,14 @@ def main() -> int:
         log("=== megafleet ===")
         doc["megafleet_1m"] = run_megafleet(smoke=smoke)
 
+    if "megafleet_chunks" in sections:
+        log("=== megafleet chunk sweep ===")
+        doc["megafleet_chunks"] = run_megafleet_chunks(smoke=smoke)
+
+    if "megafleet_robust" in sections:
+        log("=== megafleet robust-agg attacker sweep ===")
+        doc["megafleet_robust"] = run_megafleet_robust(smoke=smoke)
+
     with open(out_path, "w") as f:
         json.dump(doc, f, indent=1)
         f.write("\n")
@@ -722,6 +915,12 @@ def main() -> int:
     if "megafleet_1m" in doc:
         mrows = doc["megafleet_1m"]["wall_clock"]["megafleet"]
         summary["megafleet_clients_per_sec"] = mrows[-1]["clients_per_sec"]
+    if "megafleet_chunks" in doc:
+        summary["chunked_speedup"] = (
+            doc["megafleet_chunks"]["speedup_best_vs_per_event"]
+        )
+    if "megafleet_robust" in doc:
+        summary["robust_cells"] = len(doc["megafleet_robust"]["cells"])
     print(json.dumps(summary))
     return 0
 

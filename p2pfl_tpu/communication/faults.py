@@ -448,6 +448,51 @@ def byz_corrupt_update(plan: FaultPlan, src: str, dst: str, update, cmd: str):
     return bad
 
 
+#: ByzantineSpec kinds with a vectorized payload-transform twin — the
+#: corruptions expressible as one elementwise op per payload, which is
+#: what lets the megafleet engine apply them as masked array transforms
+#: inside its scan. stale_replay and equivocate are *stateful per edge*
+#: (a capture, a per-peer split view) and stay heap-only.
+BYZ_VECTOR_KINDS = ("sign_flip", "scale", "noise")
+_BYZ_KIND_CODE = {"sign_flip": 1, "scale": 2, "noise": 3}
+
+
+def byz_payload_grid(plan: FaultPlan, addrs: list) -> tuple:
+    """Dense per-node corruption codes for a plan's Byzantine specs —
+    ``(kind_code [N] int32, lam [N] f32, std [N] f32)`` over ``addrs`` in
+    index order, code 0 = honest. The array-engine twin of
+    :func:`byz_corrupt_update`'s kind dispatch: ``1`` → ``−a``, ``2`` →
+    ``lam·a``, ``3`` → ``a + N(0, std)`` (noise rows drawn by the caller
+    from its own counter-based stream — the per-edge ``byz_rng`` streams
+    have no vectorized form, so cross-driver noise parity is
+    statistical). A spec whose ``cmds`` excludes ``"async_update"`` never
+    touches the async contribution seam and maps to code 0; a kind
+    outside :data:`BYZ_VECTOR_KINDS` raises — those attacks need the
+    heap driver.
+    """
+    n = len(addrs)
+    code = np.zeros(n, np.int32)
+    lam = np.ones(n, np.float32)
+    std = np.zeros(n, np.float32)
+    idx = {a: j for j, a in enumerate(addrs)}
+    for addr, spec in plan.byzantine.items():
+        j = idx.get(addr)
+        if j is None:
+            continue
+        if spec.kind not in BYZ_VECTOR_KINDS:
+            raise ValueError(
+                f"ByzantineSpec kind {spec.kind!r} is stateful per edge "
+                "and needs the heap driver; vectorized kinds: "
+                f"{'/'.join(BYZ_VECTOR_KINDS)}"
+            )
+        if "async_update" not in spec.cmds:
+            continue
+        code[j] = _BYZ_KIND_CODE[spec.kind]
+        lam[j] = np.float32(spec.lam)
+        std[j] = np.float32(spec.noise_std)
+    return code, lam, std
+
+
 # ---- crash machinery ----
 
 

@@ -237,14 +237,17 @@ class SimulatedAsyncFleet:
         """Full-membership cluster chunking (routing.TierRouter view)."""
         return self.router.topo
 
-    def _make_node(self, addr: str) -> _SimNode:
-        idx = self._next_idx
-        self._next_idx += 1
+    def _draw_duration(self, idx: int) -> float:
         rng = np.random.default_rng([self.seed, 11, idx])
         dur = self._base_duration * (0.8 + 0.4 * float(rng.random()))
         if self._slow_frac > 0.0 and float(rng.random()) < self._slow_frac:
             dur *= self._slow_factor
-        node = _SimNode(addr, idx, self._init, 1 + idx % 3, dur)
+        return dur
+
+    def _make_node(self, addr: str) -> _SimNode:
+        idx = self._next_idx
+        self._next_idx += 1
+        node = _SimNode(addr, idx, self._init, 1 + idx % 3, self._draw_duration(idx))
         self.nodes[addr] = node
         return node
 
@@ -265,19 +268,28 @@ class SimulatedAsyncFleet:
             c = self._up_seq[addr] = itertools.count(1)
         return next(c)
 
-    def export_spec(self) -> Dict[str, Any]:
+    def export_spec(self, extra: int = 0, allow_custom: bool = False) -> Dict[str, Any]:
         """Dense-array export of this fleet's population — the megafleet
         parity hook: :meth:`p2pfl_tpu.federation.megafleet.FleetSpec.
         from_sim` builds the vectorized engine's population from exactly
         these arrays (sorted-address order == index order, so the two
         drivers' fold keys agree), which is what lets the 1k parity
-        tests drive the SAME fleet through both engines."""
+        tests drive the SAME fleet through both engines.
+
+        ``extra`` appends that many PENDING-JOINER rows past the current
+        population — drawn from the same per-idx counter streams a later
+        :meth:`inject_join` would use, so a churn plan's joiners carry
+        identical durations/samples/targets in both drivers before they
+        exist in the heap. ``allow_custom`` skips only the
+        train_fn/loss_fn check: the gradient-task parity pin drives the
+        heap with a vectorized-twin closure and exports the same
+        population shape."""
         if set(self._init) != {"w"}:
             raise ValueError(
                 "export_spec supports the consensus-task layout "
                 "({'w': [dim]}) — custom workloads have no vectorized twin"
             )
-        if (
+        if not allow_custom and (
             getattr(self.train_fn, "__func__", None)
             is not SimulatedAsyncFleet._default_train
             or getattr(self.loss_fn, "__func__", None)
@@ -287,7 +299,7 @@ class SimulatedAsyncFleet:
                 "export_spec supports the default consensus workload — "
                 "a custom train_fn/loss_fn has no vectorized twin"
             )
-        if self.n > 10_000:
+        if self.n + extra > 10_000:
             # simfleet pads addresses to 4 digits; past 10k its
             # lexicographic order no longer equals index order and the
             # two drivers' address schemes diverge — the parity hook
@@ -299,15 +311,24 @@ class SimulatedAsyncFleet:
             )
         addrs = sorted(self.nodes)
         nodes = [self.nodes[a] for a in addrs]
+        # (idx, addr, samples, duration) rows: live nodes then pending
+        # joiners continuing the idx sequence (same streams inject_join
+        # will draw from)
+        table = [(n.idx, n.addr, n.num_samples, n.duration) for n in nodes]
+        for idx in range(self._next_idx, self._next_idx + extra):
+            table.append(
+                (idx, f"sim-{idx:04d}", 1 + idx % 3, self._draw_duration(idx))
+            )
+        addrs = [t[1] for t in table]
         slow = np.zeros(len(addrs), np.float64)
         if self.plan is not None:
             for j, a in enumerate(addrs):
                 slow[j] = float(self.plan.slow_nodes.get(a, 0.0))
         return {
-            "durations": np.asarray([n.duration for n in nodes], np.float64),
-            "num_samples": np.asarray([n.num_samples for n in nodes], np.float32),
+            "durations": np.asarray([t[3] for t in table], np.float64),
+            "num_samples": np.asarray([t[2] for t in table], np.float32),
             "targets": np.stack(
-                [self._target(n.idx) for n in nodes]
+                [self._target(t[0]) for t in table]
             ).astype(np.float32),
             "slow": slow,
             "init": np.asarray(self._init["w"], np.float32),

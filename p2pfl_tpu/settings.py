@@ -290,6 +290,13 @@ class Settings:
     # lax.scan unroll factor for the fleet program — a throughput/compile
     # -time trade on multi-million-event scans.
     MEGAFLEET_SCAN_UNROLL: int = 1
+    # Events per scan step of the chunked engine (ops/fleet_kernels.py
+    # run_fleet_program_chunked): each step batch-gathers CHUNK sorted
+    # arrivals, runs the sequential admission logic as cheap scalar ops,
+    # and scatters every dense-carry write back in one predicated pass —
+    # amortizing XLA:CPU's per-op dispatch over the chunk. 1 selects the
+    # per-event reference engine (the bit-parity baseline).
+    MEGAFLEET_CHUNK: int = 256
     # --- Byzantine robustness (federation/defense.py, ops/aggregation.py) ---
     # Which merge kernel the async plane's BufferedAggregator folds a
     # flushed buffer with: "fedavg" is the FedBuff staleness-weighted mean
@@ -569,6 +576,9 @@ def set_test_settings() -> None:
     Settings.MEGAFLEET_REGIONAL_RATE_S = 0.0
     Settings.MEGAFLEET_GLOBAL_RATE_S = 0.0
     Settings.MEGAFLEET_SCAN_UNROLL = 1
+    # small odd chunk in tests: every parity suite then crosses chunk
+    # boundaries (masked tails, mid-chunk flushes, fresh-mint adoption)
+    Settings.MEGAFLEET_CHUNK = 48
     Settings.TRAIN_SET_SIZE = 4
     Settings.VOTE_TIMEOUT = 10.0
     Settings.AGGREGATION_TIMEOUT = 10.0

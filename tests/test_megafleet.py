@@ -15,8 +15,15 @@ deterministic effects.
 import numpy as np
 import pytest
 
-from p2pfl_tpu.communication.faults import CrashSpec, EdgeFault, FaultPlan
-from p2pfl_tpu.federation.megafleet import FleetSpec, MegaFleet
+from p2pfl_tpu.communication.faults import (
+    ByzantineSpec,
+    CrashSpec,
+    EdgeFault,
+    FaultPlan,
+    JoinSpec,
+    LeaveSpec,
+)
+from p2pfl_tpu.federation.megafleet import FleetSpec, GradTask, MegaFleet
 from p2pfl_tpu.federation.simfleet import SimulatedAsyncFleet
 
 SEED = 1905
@@ -192,13 +199,53 @@ def test_fault_plan_mapping():
     assert res.updates_dropped_wire > 0
     assert res.crashed == ["sim-0007"]
 
-    for bad in (
-        FaultPlan(seed=SEED, partitions=[("sim-0001", "sim-0002")]),
-        FaultPlan(seed=SEED, edges={("a", "b"): EdgeFault(drop=1.0)}),
+    # the full vectorized fault algebra CONSTRUCTS (byzantine payload
+    # kinds, duplicates, churn — each runs through counter grids now)...
+    n = spec.n
+    for good in (
         FaultPlan(seed=SEED, default=EdgeFault(duplicate=0.5)),
+        FaultPlan(
+            seed=SEED, byzantine={"sim-0002": ByzantineSpec(kind="sign_flip")}
+        ),
+        FaultPlan(seed=SEED, joins={f"sim-{n - 1:04d}": JoinSpec(at_s=3.0)}),
+        FaultPlan(seed=SEED, leaves={"sim-0005": LeaveSpec(at_s=2.0)}),
     ):
-        with pytest.raises(ValueError, match="heap driver"):
-            MegaFleet(spec, plan=bad)
+        MegaFleet(spec, plan=good)
+
+    # ...while per-edge overrides, pairwise cuts, stateful attacker
+    # kinds and the stateful churn combinations still route to the heap
+    with pytest.raises(ValueError, match="per-edge"):
+        MegaFleet(spec, plan=FaultPlan(seed=SEED, edges={("a", "b"): EdgeFault(drop=1.0)}))
+    with pytest.raises(ValueError, match="heap driver"):
+        MegaFleet(
+            spec, plan=FaultPlan(seed=SEED, partitions=[("sim-0001", "sim-0002")])
+        )
+    with pytest.raises(ValueError, match="heap driver"):
+        MegaFleet(
+            spec,
+            plan=FaultPlan(
+                seed=SEED, byzantine={"sim-0002": ByzantineSpec(kind="equivocate")}
+            ),
+        )
+    churny = dict(joins={f"sim-{n - 1:04d}": JoinSpec(at_s=3.0)})
+    with pytest.raises(ValueError, match="heap driver"):
+        MegaFleet(
+            spec,
+            plan=FaultPlan(
+                seed=SEED,
+                byzantine={"sim-0002": ByzantineSpec(kind="sign_flip")},
+                **churny,
+            ),
+        )
+    with pytest.raises(ValueError, match="heap driver"):
+        MegaFleet(spec, plan=FaultPlan(seed=SEED, **churny), fold="median")
+    with pytest.raises(ValueError, match="heap driver"):
+        MegaFleet(
+            spec,
+            plan=FaultPlan(seed=SEED, slow_nodes={"sim-0003": 5.0}, **churny),
+        )
+    with pytest.raises(ValueError, match="heap driver"):
+        MegaFleet(spec, fold="krum-screen")
 
 
 def test_slow_nodes_apply_on_synth_specs():
@@ -360,3 +407,438 @@ def test_export_spec_matches_population():
     big = SimulatedAsyncFleet(10_001, seed=SEED, cluster_size=32)
     with pytest.raises(ValueError, match="4-digit address"):
         big.export_spec()
+
+
+# ---- the chunked engine (ISSUE 16): bit-identity, fold keys, faults ----
+
+
+def test_chunked_engine_bit_identical_to_per_event():
+    """The chunked engine's batched gather → segment-fold → predicated
+    scatter decomposition must change NOTHING: flat results are
+    bit-identical to the per-event reference scan across chunk sizes
+    that do and don't divide the event count (masked-tail rule), and the
+    hierarchical engine matches bitwise too on this geometry."""
+    spec = FleetSpec.synth(500, seed=SEED, dim=8)
+
+    def run(chunk, cluster):
+        return MegaFleet(
+            spec, cluster_size=cluster, k=8, updates_per_node=4,
+            local_lr=0.7, chunk=chunk,
+        ).run()
+
+    ref = run(1, 0)
+    for chunk in (7, 48, 256):
+        got = run(chunk, 0)
+        assert got.merges == ref.merges and got.version == ref.version
+        assert got.loss_curve == ref.loss_curve
+        np.testing.assert_array_equal(got.params["w"], ref.params["w"])
+
+    href = run(1, 32)
+    hgot = run(48, 32)
+    assert hgot.merges == href.merges
+    assert hgot.regional_merges == href.regional_merges
+    assert hgot.loss_curve == href.loss_curve
+    np.testing.assert_array_equal(hgot.params["w"], href.params["w"])
+
+
+def test_fold_key_two_word_order_at_int32_boundary():
+    """Regression for the retired product fold key ``ii*(M+1)+mm+1``:
+    past ``n·(M+1) > 2^31`` it overflowed int32 (the engine used to
+    REFUSE such populations). The two-word ``(key_hi, key_lo)`` lexsort
+    must reproduce the heap's (origin, seq) tuple order verbatim at
+    indices where the product formula wraps negative."""
+    import jax.numpy as jnp
+
+    from p2pfl_tpu.ops.fleet_kernels import fold_window
+
+    dim, M = 4, 4
+    # client indices deep in the would-overflow regime: ii*(M+1)+mm+1
+    # exceeds int32 for every row here
+    his = np.asarray(
+        [2**31 - 2, 2**30 + 5, 2**31 - 2, 2**30 + 5, 2**29], np.int64
+    )
+    los = np.asarray([3, 1, 1, 2, 4], np.int64)
+    assert ((his * (M + 1) + los) > np.iinfo(np.int32).max).all()
+    rng = np.random.default_rng(3)
+    rows = rng.normal(size=(5, dim)).astype(np.float32)
+    weights = rng.uniform(1, 2, size=5).astype(np.float32)
+    prev = np.zeros(dim, np.float32)
+
+    out = np.asarray(
+        fold_window(
+            jnp.asarray(rows), jnp.asarray(weights),
+            jnp.asarray(los.astype(np.int32)), jnp.asarray(prev), 0.7,
+            keys_hi=jnp.asarray((his - 2**31).astype(np.int32)),
+        )
+    )
+    # reference: fold in the heap's tuple order via small rank-compressed
+    # keys (tuple order is all the fold may depend on)
+    order = sorted(range(5), key=lambda j: (his[j], los[j]))
+    ranks = np.empty(5, np.int32)
+    ranks[order] = np.arange(5, dtype=np.int32)
+    ref = np.asarray(
+        fold_window(
+            jnp.asarray(rows), jnp.asarray(weights), jnp.asarray(ranks),
+            jnp.asarray(prev), 0.7,
+        )
+    )
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_megafleet_accepts_overflow_scale_key_space():
+    """The engine itself must not refuse populations whose
+    ``n × (updates+1)`` product passes int32 (the old raise at
+    megafleet.py) — key words stay per-field int32 regardless of n."""
+    spec = FleetSpec.synth(500, seed=SEED, dim=4)
+    mf = MegaFleet(spec, cluster_size=0, k=8, updates_per_node=4)
+    # simulated: the old product key for the LAST event of a 600M-client
+    # fleet would overflow; the two-word key never multiplies
+    n_huge, M = 600_000_000, 4
+    assert n_huge * (M + 1) > np.iinfo(np.int32).max
+    assert mf.run().version > 0  # and the real engine runs unchanged
+
+
+def test_byzantine_parity_1k():
+    """Deterministic corruption kinds (sign_flip / scale) at the edge
+    seam: the vectorized payload transforms must reproduce the heap's
+    byz_corrupt_update runs exactly — corruption counts and merge
+    decisions EXACT, losses at reassociation tolerance."""
+    byz = {
+        "sim-0003": ByzantineSpec(kind="sign_flip"),
+        "sim-0007": ByzantineSpec(kind="sign_flip"),
+        "sim-0011": ByzantineSpec(kind="scale", lam=25.0),
+    }
+    heap, mega = _pair(1000, 0, plan=FaultPlan(seed=SEED, byzantine=byz))
+    assert mega.byz_corrupted == heap.byz_corrupted > 0
+    assert mega.merges == heap.merges
+    _, hv, hl = _curves(heap)
+    _, mv, ml = _curves(mega)
+    assert mv == hv
+    np.testing.assert_allclose(ml, hl, rtol=0, atol=float(hl.max()) * 1e-5)
+
+
+def test_byzantine_noise_parity_1k():
+    """The noise kind draws from driver-specific streams, so payloads
+    differ — but admission never reads the payload: corruption counts
+    and merge decisions stay EXACT, and both drivers land on the same
+    fixed point (the zero-mean noise washes out of the tail)."""
+    byz = {
+        "sim-0003": ByzantineSpec(kind="noise", noise_std=5.0),
+        "sim-0007": ByzantineSpec(kind="noise", noise_std=5.0),
+    }
+    heap, mega = _pair(1000, 0, plan=FaultPlan(seed=SEED, byzantine=byz))
+    assert mega.byz_corrupted == heap.byz_corrupted > 0
+    assert mega.merges == heap.merges
+    assert [x[1] for x in mega.loss_curve] == [x[1] for x in heap.loss_curve]
+    assert (
+        abs(mega.final_loss() - heap.final_loss())
+        <= 5e-2 * max(heap.final_loss(), 1e-9)
+    )
+
+
+def test_byzantine_hier_aggregate_seam():
+    """An ATTACKER ELECTED REGIONAL corrupts its regional→root aggregate
+    sends (the heap routes those through the same byz_corrupt_update
+    seam); honest self-offers stay honest. Counts exact, tail within the
+    hier tolerance."""
+    byz = {
+        "sim-0000": ByzantineSpec(kind="sign_flip"),  # elected regional
+        "sim-0030": ByzantineSpec(kind="sign_flip"),
+        "sim-0055": ByzantineSpec(kind="sign_flip"),
+    }
+    heap, mega = _pair(200, 25, plan=FaultPlan(seed=SEED, byzantine=byz))
+    assert mega.byz_corrupted == heap.byz_corrupted > 0
+    assert mega.merges == heap.merges
+    assert (
+        abs(mega.final_loss() - heap.final_loss())
+        <= 1e-2 * max(heap.final_loss(), 1e-9)
+    )
+
+
+def test_robust_folds_parity_and_defense_1k():
+    """The window fold swapped to buffered_robust_merge's trimmed-mean /
+    median under a 10% scale-attacker population: parity with the heap
+    (which flushes through Settings.ASYNC_ROBUST_AGG) stays at
+    reassociation tolerance, and median actually DEFENDS — its final
+    loss beats fedavg's under the same attack."""
+    from p2pfl_tpu.settings import Settings
+
+    byz = {
+        f"sim-{i:04d}": ByzantineSpec(kind="scale", lam=50.0)
+        for i in range(0, 1000, 10)
+    }
+    plan = FaultPlan(seed=SEED, byzantine=byz)
+    finals = {}
+    try:
+        for fold in ("fedavg", "trimmed-mean", "median"):
+            Settings.ASYNC_ROBUST_AGG = fold
+            heap, mega = _pair(1000, 0, plan=plan)
+            assert mega.merges == heap.merges
+            _, hv, hl = _curves(heap)
+            _, mv, ml = _curves(mega)
+            assert mv == hv
+            np.testing.assert_allclose(
+                ml, hl, rtol=0, atol=float(hl.max()) * 1e-5
+            )
+            finals[fold] = mega.final_loss()
+    finally:
+        Settings.ASYNC_ROBUST_AGG = "fedavg"
+    assert finals["median"] < finals["fedavg"]
+    assert finals["trimmed-mean"] < finals["fedavg"]
+
+
+def test_duplicates_are_counted_noops_1k():
+    """default.duplicate injects replayed (origin, seq) triples; the
+    version vector dedups every one, so a duplicate plan must be
+    RESULT-INVARIANT in both drivers while the injection counters
+    record the chaos actually exercised."""
+    plan = FaultPlan(seed=SEED, default=EdgeFault(duplicate=0.3))
+    h0, m0 = _pair(1000, 0)
+    h1, m1 = _pair(1000, 0, plan=plan)
+    assert h1.duplicates_injected > 0 and m1.duplicates_injected > 0
+    assert h1.merges == h0.merges and m1.merges == m0.merges
+    assert h1.loss_curve == h0.loss_curve
+    assert m1.loss_curve == m0.loss_curve
+    np.testing.assert_array_equal(m1.params["w"], m0.params["w"])
+
+
+def test_duplicates_hit_the_aggregate_seam():
+    """Hierarchical: the regional→root hop runs the same duplicate
+    verdicts (per-(regional, up_seq) grid) — counted, still no-ops."""
+    plan = FaultPlan(seed=SEED, default=EdgeFault(duplicate=0.5))
+    h0, m0 = _pair(300, 16)
+    h1, m1 = _pair(300, 16, plan=plan)
+    assert h1.duplicates_injected > 0 and m1.duplicates_injected > 0
+    assert m1.merges == m0.merges and m1.loss_curve == m0.loss_curve
+    assert h1.merges == h0.merges and h1.loss_curve == h0.loss_curve
+
+
+def _churn_pair(n, cluster, plan, extra, dim=16, **kw):
+    fleet = SimulatedAsyncFleet(
+        n, seed=SEED, cluster_size=cluster, updates_per_node=4,
+        local_lr=0.7, plan=plan, dim=dim, **kw,
+    )
+    spec = FleetSpec.from_sim(fleet, extra=extra)  # BEFORE run: joiners pend
+    return fleet.run(), MegaFleet(
+        spec, cluster_size=cluster, updates_per_node=4, local_lr=0.7,
+        plan=plan, **kw,
+    ).run()
+
+
+def test_churn_parity_1k():
+    """joins/leaves as time-indexed liveness with TierRouter re-derived
+    at every membership boundary: joined/left rosters EXACT, failovers
+    EXACT, merge count and version sequence EXACT on this geometry
+    (non-regional leavers), loss tail inside the churn tolerance
+    (documented divergences: joiner bootstrap adoption, in-flight loss
+    at a leaver)."""
+    n = 1000
+    joins = {
+        f"sim-{i:04d}": JoinSpec(at_s=2.0 + 0.1 * (i - n))
+        for i in range(n, n + 8)
+    }
+    leaves = {
+        "sim-0005": LeaveSpec(at_s=2.5, graceful=True),
+        "sim-0033": LeaveSpec(at_s=3.0, graceful=False),
+    }
+    plan = FaultPlan(seed=SEED, joins=joins, leaves=leaves)
+    heap, mega = _churn_pair(n, 32, plan, extra=8)
+    assert mega.joined == heap.joined
+    assert mega.left == heap.left
+    assert mega.failovers == heap.failovers
+    assert mega.merges == heap.merges
+    assert [x[1] for x in mega.loss_curve] == [x[1] for x in heap.loss_curve]
+    assert (
+        abs(mega.final_loss() - heap.final_loss())
+        <= 5e-2 * max(heap.final_loss(), 1e-9)
+    )
+
+
+def test_churn_root_failover_parity():
+    """The global root leaving gracefully: both drivers re-elect (ONE
+    failover) and mint the same number of globals. The heap additionally
+    hands the in-flight global buffer to the successor — a documented
+    divergence in the merge COUNTER, not the version sequence."""
+    plan = FaultPlan(
+        seed=SEED, leaves={"sim-0000": LeaveSpec(at_s=2.2, graceful=True)}
+    )
+    heap, mega = _churn_pair(200, 25, plan, extra=0, k=4, dim=8)
+    assert mega.failovers == heap.failovers == 1
+    assert mega.left == heap.left == ["sim-0000"]
+    assert mega.version == heap.version
+    assert (
+        abs(mega.final_loss() - heap.final_loss())
+        <= 0.2 * max(heap.final_loss(), 1e-9)
+    )
+
+
+def test_churn_flat_parity():
+    """Flat topology churn (joiners stream into the single buffer):
+    merges and version sequence EXACT."""
+    n = 300
+    joins = {
+        f"sim-{i:04d}": JoinSpec(at_s=1.5 + 0.2 * (i - n))
+        for i in range(n, n + 5)
+    }
+    plan = FaultPlan(seed=SEED, joins=joins)
+    heap, mega = _churn_pair(n, 0, plan, extra=5)
+    assert mega.joined == heap.joined
+    assert mega.merges == heap.merges
+    assert [x[1] for x in mega.loss_curve] == [x[1] for x in heap.loss_curve]
+
+
+# ---- the vmapped real-gradient learner (GradTask) ----
+
+
+def test_grad_train_one_matches_jax_learner_epoch():
+    """fk.make_grad_fns' train_one IS JaxLearner's epoch math: the same
+    scan of SGD steps train_epoch compiles (optax.sgd + apply_updates on
+    a Dense stack), here on the flat parameter layout. Bit-close on the
+    same seeded batches."""
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    from p2pfl_tpu.learning.learner import sgd, train_epoch
+    from p2pfl_tpu.ops import fleet_kernels as fk
+
+    din, nout, bs, steps, lr = 6, 3, 4, 3, 0.5
+
+    class _Lin(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(nout)(x)
+
+    gen_batch, train_one, _ = fk.make_grad_fns(
+        "linear", din, nout, 0, bs, steps, lr, data_seed=5
+    )
+    task = GradTask(kind="linear", d_in=din, n_out=nout, batch=bs,
+                    steps=steps, data_seed=5)
+    mu, tw, tb, _, _ = task.arrays(1)
+    xs, ys = gen_batch(0, 1, jnp.asarray(mu[0]), jnp.asarray(tw), jnp.asarray(tb))
+
+    rng = np.random.default_rng(11)
+    w0 = rng.normal(size=(din, nout)).astype(np.float32)
+    b0 = rng.normal(size=nout).astype(np.float32)
+    flat0 = jnp.asarray(np.concatenate([w0.ravel(), b0]))
+    out_flat = np.asarray(train_one(flat0, xs, ys))
+
+    module = _Lin()
+    params = {"Dense_0": {"kernel": jnp.asarray(w0), "bias": jnp.asarray(b0)}}
+    tx = sgd(lr)
+    params, _, _ = train_epoch(params, tx.init(params), xs, ys, module, tx)
+    ref = np.concatenate([
+        np.asarray(params["Dense_0"]["kernel"]).ravel(),
+        np.asarray(params["Dense_0"]["bias"]),
+    ])
+    np.testing.assert_allclose(out_flat, ref, atol=1e-6)
+
+
+def test_grad_task_single_client_chunked_trajectory():
+    """One client, K=1, server_lr=1, α=0: every mint IS the client's
+    next local round, so the chunked engine's G trajectory must follow
+    the sequential train_one chain on the same counter-keyed batches
+    (1-based round == the fold key's key_lo)."""
+    import jax.numpy as jnp
+
+    from p2pfl_tpu.ops import fleet_kernels as fk
+
+    task = GradTask(kind="linear", d_in=6, n_out=3, batch=4, steps=3,
+                    data_seed=5)
+    spec = FleetSpec.synth(1, seed=3, dim=task.param_dim())
+    res = MegaFleet(
+        spec, cluster_size=0, k=1, updates_per_node=4, alpha=0.0,
+        server_lr=1.0, task=task, link_delay=0.0, chunk=48,
+    ).run()
+    assert res.version == 4
+
+    gen_batch, train_one, _ = fk.make_grad_fns(
+        "linear", 6, 3, 0, 4, 3, 0.5, data_seed=5
+    )
+    mu, tw, tb, _, _ = task.arrays(1)
+    p = jnp.zeros(task.param_dim(), jnp.float32)
+    for m in range(1, 5):
+        xs, ys = gen_batch(0, m, jnp.asarray(mu[0]), jnp.asarray(tw), jnp.asarray(tb))
+        p = train_one(p, xs, ys)
+    np.testing.assert_allclose(res.params["w"], np.asarray(p), atol=1e-6)
+
+
+def test_grad_task_mlp_runs_and_learns():
+    """The mlp task kind wires through the same engine: eval-set CE
+    falls from init on a small fleet."""
+    task = GradTask(kind="mlp", d_in=6, n_out=3, hidden=5, batch=4,
+                    steps=2, data_seed=9)
+    spec = FleetSpec.synth(40, seed=3, dim=task.param_dim())
+    res = MegaFleet(
+        spec, cluster_size=0, k=4, updates_per_node=4, task=task,
+        local_lr=0.7,
+    ).run()
+    losses = [x[2] for x in res.loss_curve]
+    assert len(losses) == res.version
+    assert losses[-1] < losses[0]
+
+
+def test_grad_task_heap_parity_1k():
+    """The 1k heap-parity pin for the gradient grid: the heap driver
+    runs a vectorized-twin train_fn (same make_grad_fns kernels, 1-based
+    per-node round counters matching key_lo) and the chunked engine must
+    reproduce its merge decisions exactly with params at float
+    tolerance."""
+    from collections import defaultdict
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from p2pfl_tpu.ops import fleet_kernels as fk
+
+    task = GradTask(kind="linear", d_in=6, n_out=3, batch=4, steps=2,
+                    data_seed=5)
+    pd = task.param_dim()
+    gen_batch, train_one, _ = fk.make_grad_fns(
+        "linear", 6, 3, 0, 4, 2, 0.7, data_seed=5
+    )
+    t1j = jax.jit(train_one)
+    mu, tw, tb, xe, ye = task.arrays(1000)
+    muj, twj, tbj = jnp.asarray(mu), jnp.asarray(tw), jnp.asarray(tb)
+    counters: dict = defaultdict(int)
+
+    def train_fn(idx, params, rng):
+        counters[idx] += 1
+        xs, ys = gen_batch(idx, counters[idx], muj[idx], twj, tbj)
+        return {"w": np.asarray(t1j(jnp.asarray(params["w"]), xs, ys))}
+
+    def loss_fn(params):
+        lg = fk.grad_logits("linear", 6, 3, 0, jnp.asarray(params["w"]),
+                            jnp.asarray(xe))
+        return float(
+            optax.softmax_cross_entropy_with_integer_labels(
+                lg, jnp.asarray(ye)
+            ).mean()
+        )
+
+    fleet = SimulatedAsyncFleet(
+        1000, seed=SEED, cluster_size=0, updates_per_node=4, k=8,
+        local_lr=0.7, dim=pd, train_fn=train_fn, loss_fn=loss_fn,
+        init_params={"w": np.zeros(pd, np.float32)},
+    )
+    spec = FleetSpec.from_sim(fleet, allow_custom=True)
+    heap = fleet.run()
+    mega = MegaFleet(
+        spec, cluster_size=0, k=8, updates_per_node=4, local_lr=0.7,
+        task=task,
+    ).run()
+    assert mega.merges == heap.merges
+    _, hv, hl = _curves(heap)
+    _, mv, ml = _curves(mega)
+    assert mv == hv
+    np.testing.assert_allclose(ml, hl, rtol=0, atol=float(max(hl.max(), 1e-9)) * 1e-4)
+    np.testing.assert_allclose(
+        np.asarray(mega.params["w"]), np.asarray(heap.params["w"]), atol=1e-5
+    )
+
+
+def test_grad_task_dim_mismatch_raises():
+    task = GradTask(kind="linear", d_in=6, n_out=3)
+    spec = FleetSpec.synth(10, seed=3, dim=4)
+    with pytest.raises(ValueError, match="param"):
+        MegaFleet(spec, task=task)
