@@ -71,6 +71,11 @@ def _jit_donation(call: ast.AST, module_strs: Dict[str, Set[str]]) -> Optional[D
     is_jit = func in _JIT_NAMES
     if func in _PARTIAL_NAMES and call.args:
         is_jit = dotted_name(call.args[0]) in _JIT_NAMES
+    if not is_jit and isinstance(call.func, ast.Call):
+        # partial(jax.jit, donate_argnums=…)(shard_map(body, …)): the
+        # donation lives on the INNER partial call — the sharded-engine
+        # wrapping shape
+        return _jit_donation(call.func, module_strs)
     if not is_jit:
         return None
     positions: Set[int] = set()

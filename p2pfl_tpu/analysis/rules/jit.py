@@ -57,10 +57,18 @@ def _is_jit_decorator(dec: ast.AST) -> bool:
         return True
     if isinstance(dec, ast.Call):
         func = dotted_name(dec.func)
-        if func in _JIT_NAMES:
+        last = func.rsplit(".", 1)[-1] if func else None
+        if func in _JIT_NAMES or last in _KERNEL_WRAPPER_LASTS:
             return True
-        if func in _PARTIAL_NAMES and dec.args and dotted_name(dec.args[0]) in _JIT_NAMES:
-            return True
+        if func in _PARTIAL_NAMES and dec.args:
+            inner = dotted_name(dec.args[0])
+            if inner in _JIT_NAMES:
+                return True
+            # @partial(shard_map, mesh=…) / @partial(shard_map_compat, …):
+            # the decorated def IS the per-shard device program
+            inner_last = inner.rsplit(".", 1)[-1] if inner else None
+            if inner_last in _KERNEL_WRAPPER_LASTS:
+                return True
     return False
 
 

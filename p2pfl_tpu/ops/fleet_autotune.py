@@ -1,0 +1,179 @@
+"""Megafleet chunk-size autotuner: measure-once, replay-from-cache.
+
+The chunked fleet engine's throughput is a function of its chunk size,
+and the sweet spot is DEVICE-dependent (ROADMAP open item 1): XLA:CPU
+wants chunks big enough to amortize per-op dispatch, TPU gather/scatter
+wants a different balance, and the sharded engine shifts the optimum
+again (per-shard lanes shrink with the shard count while the replicated
+admission scan does not). Hand-picking one number per platform does not
+survive a fleet that runs on all of them.
+
+This module is the :mod:`~p2pfl_tpu.ops.autotune` pattern applied to
+that knob — the same three-layer resolution, the same cache discipline:
+
+1. **Pinned** (:func:`pin_fleet_chunk`) — explicit session-only
+   override; never persisted (a pin is an experiment, not a
+   measurement).
+2. **In-process cache** — winners measured this process, plus anything
+   loaded from disk.
+3. **On-disk cache** — JSON at ``Settings.FLEET_TUNE_CACHE`` (default
+   ``$P2PFL_FLEET_TUNE_CACHE`` or ``~/.cache/p2pfl_tpu/
+   fleet_tune.json``), loaded once per process. Entries are keyed on
+   **device kind** + **shard count** + a caller workload tag
+   (task/dim/topology/K/population scale), so a cache written on one
+   platform or mesh never mis-tunes another.
+
+Cache entry format (one JSON object per key)::
+
+    {"<kind>|shards=P|<extra>": {"chunk": 256,
+                                 "timings": {"64": 0.41, ...}}}
+
+``timings`` records every candidate's measured seconds — kept so a
+bench or a human can audit WHY the winner won; only ``chunk`` is read
+back. :func:`autotune_fleet_chunk` is the only function that runs
+programs (the caller supplies the ``measure`` closure — typically one
+warmed engine run over a bounded event prefix); everything else is a
+pure lookup safe at trace time.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+from typing import Callable, Dict, Optional, Sequence
+
+#: chunk sizes swept by default — spans the dispatch-amortization knee
+#: on CPU and stays under the per-chunk admission scan's compile blowup
+DEFAULT_CANDIDATES = (64, 128, 256, 512)
+
+# in-process winner cache: key (see _key) -> {"chunk": int, "timings": {...}}
+_MEM_CACHE: Dict[str, dict] = {}
+# explicit pins: session-only, win over everything, NEVER persisted
+_PINNED: Dict[str, dict] = {}
+_DISK_LOADED: set = set()  # cache paths already merged into _MEM_CACHE
+
+
+def device_kind() -> str:
+    """The tuning-cache platform key: TPU device kind, else backend name
+    (same rule as :func:`p2pfl_tpu.ops.autotune.device_kind`)."""
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        if dev.platform == "tpu":
+            return dev.device_kind
+        return dev.platform
+    except Exception:  # pragma: no cover — no backend at all
+        return "cpu"
+
+
+def _key(kind: str, n_shards: int, extra: str) -> str:
+    return f"{kind}|shards={int(n_shards)}|{extra}"
+
+
+def cache_path() -> Path:
+    from p2pfl_tpu.settings import Settings
+
+    p = getattr(Settings, "FLEET_TUNE_CACHE", "") or os.environ.get(
+        "P2PFL_FLEET_TUNE_CACHE", ""
+    )
+    if p:
+        return Path(p).expanduser()
+    return Path.home() / ".cache" / "p2pfl_tpu" / "fleet_tune.json"
+
+
+def _load_disk(path: Path) -> None:
+    tag = str(path)
+    if tag in _DISK_LOADED:
+        return
+    _DISK_LOADED.add(tag)
+    try:
+        raw = json.loads(path.read_text())
+    except (OSError, ValueError):
+        return
+    for key, entry in raw.items():
+        if isinstance(entry, dict) and isinstance(entry.get("chunk"), int):
+            _MEM_CACHE.setdefault(key, entry)
+        # unknown/garbage entry: skipped, measurement still applies
+
+
+def _save_disk(path: Path) -> None:
+    try:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(json.dumps(dict(sorted(_MEM_CACHE.items())), indent=2,
+                                   sort_keys=True))
+    except OSError:  # read-only home etc. — tuning still works in-process
+        pass
+
+
+def clear_memory_cache() -> None:
+    """Drop in-process tuning state (tests; disk cache files are kept)."""
+    _MEM_CACHE.clear()
+    _PINNED.clear()
+    _DISK_LOADED.clear()
+
+
+def pin_fleet_chunk(
+    chunk: int, *, n_shards: int = 1, extra: str = "", kind: Optional[str] = None
+) -> None:
+    """Pin an explicit chunk size for a workload key — wins over tuned.
+    Session-only: pins are never written to the on-disk tuning cache."""
+    _PINNED[_key(kind or device_kind(), n_shards, extra)] = {"chunk": int(chunk)}
+
+
+def get_fleet_chunk(
+    *, n_shards: int = 1, extra: str = "", kind: Optional[str] = None
+) -> Optional[int]:
+    """Trace-safe lookup: pinned → tuned (memory → disk) → ``None``
+    (the caller falls back to measuring, or to the Settings default)."""
+    key = _key(kind or device_kind(), n_shards, extra)
+    got = _PINNED.get(key) or _MEM_CACHE.get(key)
+    if got is not None:
+        return int(got["chunk"])
+    _load_disk(cache_path())
+    got = _MEM_CACHE.get(key)
+    if got is not None:
+        return int(got["chunk"])
+    return None
+
+
+def autotune_fleet_chunk(
+    measure: Callable[[int], float],
+    candidates: Sequence[int] = DEFAULT_CANDIDATES,
+    *,
+    n_shards: int = 1,
+    extra: str = "",
+    kind: Optional[str] = None,
+    cache: bool = True,
+    force: bool = False,
+) -> int:
+    """Resolve the chunk size for one workload key, measuring at most
+    once per cache lifetime. ``measure(chunk) -> seconds`` is supplied
+    by the caller (MegaFleet times a warmed engine run over a bounded
+    event prefix) and is only invoked on a cache miss or ``force=True``
+    — so a pinned or previously tuned key replays deterministically
+    with NO engine runs. NOT trace-safe on the miss path."""
+    kind = kind or device_kind()
+    key = _key(kind, n_shards, extra)
+    if cache and not force:
+        got = _PINNED.get(key) or _MEM_CACHE.get(key)
+        if got is None:
+            _load_disk(cache_path())
+            got = _MEM_CACHE.get(key)
+        if got is not None:
+            return int(got["chunk"])
+
+    timings = {int(c): float(measure(int(c))) for c in candidates}
+    best = min(timings, key=timings.get)
+    if cache:
+        _MEM_CACHE[key] = {
+            "chunk": int(best),
+            "timings": {str(c): t for c, t in sorted(timings.items())},
+        }
+        # merge existing on-disk entries before writing (a force=True
+        # tune skips the read path above; _load_disk's setdefault keeps
+        # the fresh winner over the stale disk copy)
+        _load_disk(cache_path())
+        _save_disk(cache_path())
+    return int(best)

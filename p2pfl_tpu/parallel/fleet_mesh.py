@@ -1,0 +1,69 @@
+"""Clients-axis mesh for the sharded megafleet engine.
+
+The megafleet engine's only fleet-scale state is the per-client
+parameter matrix ``w [N, dim+1]`` — everything else (global model
+history, windows, counters) is version-count-sized. The sharded engine
+(:func:`p2pfl_tpu.ops.fleet_kernels.run_fleet_program_sharded`)
+therefore uses the simplest possible layout: a 1-D mesh over
+``Settings.MESH_CLIENTS_AXIS`` with ``w`` block-sharded by client id
+and the small state replicated on every device.
+
+Ownership is the static block rule shared by the host layout code and
+the device program:
+
+- ``shard_capacity(n, p)`` → ``ncap = ceil(n / p)`` rows per shard;
+- client ``i`` lives on shard ``i // ncap`` at local row ``i % ncap``;
+- each shard carries ONE extra local row (``ncap``, the trash row) that
+  masked scatters route dead lanes to, mirroring the chunked engine's
+  global trash row.
+
+Like :func:`~p2pfl_tpu.parallel.mesh.federation_mesh`, a request that
+cannot be satisfied raises loudly instead of silently shrinking.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from p2pfl_tpu.settings import Settings
+
+
+def shard_capacity(n_clients: int, n_shards: int) -> int:
+    """Client rows OWNED per shard (excluding its trash row):
+    ``ceil(n_clients / n_shards)``. The last shard may own fewer real
+    clients; its surplus rows are padding that no event ever addresses.
+    """
+    if n_clients < 1 or n_shards < 1:
+        raise ValueError(
+            f"n_clients={n_clients}, n_shards={n_shards} must both be >= 1"
+        )
+    return -(-n_clients // n_shards)
+
+
+def fleet_clients_mesh(
+    n_shards: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the 1-D ``(clients,)`` mesh of the sharded fleet engine.
+
+    ``n_shards`` defaults to every available device. Asking for more
+    shards than devices raises (the engine cannot oversubscribe — each
+    shard is one device's program); asking for fewer takes the FIRST
+    ``n_shards`` devices, which is deliberate and loud in the docstring
+    rather than an error: the bench sweeps 1/2/4/8 shards on one host.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices) if n_shards is None else int(n_shards)
+    if n < 1:
+        raise ValueError(f"n_shards={n} must be >= 1")
+    if n > len(devices):
+        raise ValueError(
+            f"n_shards={n} exceeds the {len(devices)} available devices; "
+            "on CPU hosts set XLA_FLAGS=--xla_force_host_platform_device_count"
+            f"={n} before importing jax to split the host"
+        )
+    return Mesh(np.array(devices[:n]), (Settings.MESH_CLIENTS_AXIS,))

@@ -105,6 +105,28 @@ def test_jit_staleness_teeth():
     assert run_fixture("jit_staleness_good.py", JitStalenessRule) == []
 
 
+def test_jit_staleness_sees_through_shard_map():
+    # shard_map bodies are device programs: decorator form AND
+    # jit(shard_map(body, …)) call form must both be traced through
+    bad = run_fixture("jit_shard_map_bad.py", JitStalenessRule)
+    assert rules_of(bad) == ["jit-staleness"]
+    msgs = "\n".join(f.message for f in bad)
+    assert "Settings.FEDBUFF_ALPHA" in msgs  # @partial(shard_map, …) form
+    assert "CHUNK_OVERRIDE" in msgs  # mutable global in the call form
+    assert "np.asarray" in msgs  # host sync in the shard body
+    assert {f.context for f in bad} == {"shard_body", "body"}
+    assert run_fixture("jit_shard_map_good.py", JitStalenessRule) == []
+
+
+def test_donation_reuse_sees_through_shard_map():
+    # partial(jax.jit, donate_argnums=…)(shard_map(…)): the donation is
+    # declared on the inner partial call — the sharded-engine wrapping
+    bad = run_fixture("donation_shard_map_bad.py", DonationReuseRule)
+    assert rules_of(bad) == ["donation-reuse"]
+    assert any("self.w" in f.message and "fleet_step" in f.message for f in bad)
+    assert run_fixture("donation_shard_map_good.py", DonationReuseRule) == []
+
+
 def test_wire_header_compat_teeth():
     bad = analyze([str(FIXTURES / "wire_bad")], [WireHeaderCompatRule])
     assert rules_of(bad) == ["wire-header-compat"]
