@@ -1,9 +1,10 @@
-"""no-host-gather: the ICI weights-plane modules never touch the host.
+"""no-host-gather: the shard weights-plane modules never touch the host.
 
 Incident class being prevented (rather than remembered): the shard-native
-weights plane (``communication/ici.py`` + ``parallel/ici_plane.py``)
-exists for exactly one promise — model diffusion with ZERO payload bytes
-crossing device→host. The promise is fragile in a way prose cannot
+weights planes (``communication/ici.py`` + ``parallel/ici_plane.py``, and
+their cross-process twins ``communication/dcn.py`` +
+``parallel/dcn_plane.py``) exist for exactly one promise — model
+diffusion with ZERO payload bytes crossing device→host. The promise is fragile in a way prose cannot
 defend: one innocent ``np.asarray(leaf)`` for a shape check, one
 ``.tobytes()`` for a digest, one ``jax.device_get`` in a debug branch,
 and the plane silently becomes a slower byte path while every counter
@@ -37,8 +38,10 @@ from p2pfl_tpu.analysis.engine import Rule, SourceModule, dotted_name, node_pos
 from p2pfl_tpu.analysis.findings import Finding
 
 #: the weights-plane modules, recognized by basename (teeth fixtures can
-#: replicate the shape in a scanned directory, like the wire codec set)
-ICI_BASENAMES = ("ici.py", "ici_plane.py")
+#: replicate the shape in a scanned directory, like the wire codec set) —
+#: the DCN plane carries the same zero-host-bytes contract across the
+#: process boundary, so it lives in the same scope
+ICI_BASENAMES = ("ici.py", "ici_plane.py", "dcn.py", "dcn_plane.py")
 
 _HOST_CALLS = {
     "jax.device_get",

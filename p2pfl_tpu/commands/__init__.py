@@ -19,6 +19,15 @@ from p2pfl_tpu.commands.control import (
     SecAggShareCommand,
     VoteTrainSetCommand,
 )
+from p2pfl_tpu.commands.dcn import (
+    DCN_COMMANDS,
+    DcnAbortCommand,
+    DcnAcceptCommand,
+    DcnDoneCommand,
+    DcnNackCommand,
+    DcnOfferCommand,
+    DcnReadyCommand,
+)
 from p2pfl_tpu.commands.federation import (
     AsyncDoneCommand,
     AsyncJoinCommand,
@@ -45,6 +54,13 @@ __all__ = [
     "AsyncUpdateCommand",
     "AsyncViewCommand",
     "Command",
+    "DCN_COMMANDS",
+    "DcnAbortCommand",
+    "DcnAcceptCommand",
+    "DcnDoneCommand",
+    "DcnNackCommand",
+    "DcnOfferCommand",
+    "DcnReadyCommand",
     "HeartbeatCommand",
     "StartLearningCommand",
     "StopLearningCommand",

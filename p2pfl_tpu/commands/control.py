@@ -353,7 +353,12 @@ class SecAggRevealCommand(Command):
         except ValueError:
             logger.error(st.addr, f"Malformed secagg_reveal values from {source}")
             return
-        if not 0 <= x <= max(2 * len(st.train_set), 1024) or not 0 <= y < secagg.SHAMIR_PRIME:
+        if x < 0 or not 0 <= y < secagg.SHAMIR_PRIME:
+            # no fixed upper cap on x: the exact assigned-index check below
+            # is the real gate, and any constant cap (the old
+            # ``max(2*len(train_set), 1024)``) silently dropped legitimate
+            # early shares in federations larger than the constant while
+            # the local train set hadn't latched yet
             logger.error(st.addr, f"Out-of-range secagg_reveal from {source} — rejected")
             return
         if x == 0 and (source != owner or y >= (1 << 256)):
@@ -362,7 +367,28 @@ class SecAggRevealCommand(Command):
             # to_bytes(32) mid-finalize on every node)
             logger.error(st.addr, f"Invalid direct secagg_reveal from {source} — rejected")
             return
+        if st.round is None or round not in (st.round - 1, st.round, st.round + 1):
+            # one round AHEAD is legitimate: reveals are latched send-once,
+            # and a fast peer already finalizing round r+1 broadcasts its
+            # direct reveal while we are still resolving round r — dropping
+            # it would permanently starve OUR r+1 finalize. st.round None
+            # (idle) accepts nothing: fabricated round numbers would
+            # otherwise grow secagg_share_reveals without bound (same
+            # rationale as SecAggShareCommand's window)
+            return
         if x >= 1:
+            if round > st.round:
+                # the share is for a round whose train set THIS node has
+                # not latched yet — judging it against the current round's
+                # set would reject legitimate early arrivals (and latch
+                # nothing, since reveals are send-once). Stash it;
+                # promote_early_reveals re-validates at consume time, once
+                # the set for that round is the live one. Bounded: the
+                # round window above pins ``round``, and one slot per
+                # (round, owner, source) triple.
+                if len(st.secagg_early_reveals) < 4 * max(len(st.train_set), 64) ** 2:
+                    st.secagg_early_reveals.setdefault((round, owner, source), (x, y))
+                return
             # Shamir-share reveals: only train-set members have standing,
             # and each holder's share index is DETERMINED by the sorted
             # holder list (TrainStage zips sorted(peers) with x = 1..n) —
@@ -381,16 +407,46 @@ class SecAggRevealCommand(Command):
                     "assigned share index — rejected (forgery or stale train set)",
                 )
                 return
-        if st.round is None or round not in (st.round - 1, st.round, st.round + 1):
-            # one round AHEAD is legitimate: reveals are latched send-once,
-            # and a fast peer already finalizing round r+1 broadcasts its
-            # direct reveal while we are still resolving round r — dropping
-            # it would permanently starve OUR r+1 finalize. st.round None
-            # (idle) accepts nothing: fabricated round numbers would
-            # otherwise grow secagg_share_reveals without bound (same
-            # rationale as SecAggShareCommand's window)
-            return
         st.secagg_share_reveals.setdefault((round, owner, source), (x, y))
+
+
+def promote_early_reveals(state: "NodeState") -> None:
+    """Re-validate stashed ahead-of-round share reveals against the now-
+    latched train set and promote the legitimate ones.
+
+    :class:`SecAggRevealCommand` cannot judge a share for round ``r+1``
+    while the node is still in round ``r`` — the holder list (and with it
+    every assigned share index) is only determined once ``r+1``'s train
+    set latches. Early arrivals are stashed instead; the finalize routine
+    (``stages/learning_stages.py``) calls this right before reading
+    ``secagg_share_reveals``, so by then ``state.train_set`` IS the set the
+    shares were cut against and the same standing + exact-index checks
+    apply. Entries for rounds already passed are pruned.
+    """
+    st = state
+    if st.round is None or not st.secagg_early_reveals:
+        return
+    train = set(st.train_set)
+    for key in list(st.secagg_early_reveals):
+        r, owner, source = key
+        if r < st.round:
+            del st.secagg_early_reveals[key]
+            continue
+        if r > st.round:
+            continue  # still early — keep waiting
+        x, y = st.secagg_early_reveals.pop(key)
+        if source not in train or owner not in train or source == owner:
+            logger.debug(st.addr, f"early secagg_reveal from {source} without standing — dropped")
+            continue
+        holders = sorted(m for m in st.train_set if m != owner)
+        if source not in holders or x != holders.index(source) + 1:
+            logger.error(
+                st.addr,
+                f"early secagg_reveal from {source} with index {x} != its "
+                "assigned share index — rejected (forgery or stale train set)",
+            )
+            continue
+        st.secagg_share_reveals.setdefault(key, (x, y))
 
 
 class VoteTrainSetCommand(Command):

@@ -1,0 +1,119 @@
+"""DCN rendezvous verbs — the control-plane half of the DCN weights plane.
+
+Six thin handlers (``dcn_offer``/``dcn_accept``/``dcn_nack``/``dcn_ready``/
+``dcn_done``/``dcn_abort``) that parse one JSON metadata argument and hand
+it to the process-global :class:`~p2pfl_tpu.communication.dcn.DcnPlane`.
+These are ordinary byte-plane control messages (direct, ``ttl=1``) — they
+carry rendezvous METADATA only, never weights; the model payload itself
+crosses as device arrays over the XLA collective the plane co-dispatches.
+Unknown or stale transfer ids are ignored by the plane (rendezvous verbs
+can outlive the transfer they describe — a late nack/abort for an already
+finished transfer is normal, not an error).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import TYPE_CHECKING
+
+from p2pfl_tpu.commands.command import Command
+from p2pfl_tpu.management.logger import logger
+
+if TYPE_CHECKING:
+    from p2pfl_tpu.node import Node
+
+
+class _DcnVerbCommand(Command):
+    """Shared plumbing: parse ``args[0]`` as JSON, dispatch to the plane."""
+
+    #: name of the DcnPlane handler method, set by subclasses
+    _handler = ""
+
+    def __init__(self, node: "Node") -> None:
+        self._node = node
+
+    def execute(self, source: str, round: int, *args, **kwargs) -> None:  # noqa: A002
+        if not args:
+            logger.error(self._node.addr, f"Malformed {self.get_name()} from {source}: no metadata")
+            return
+        try:
+            meta = json.loads(args[0])
+        except (ValueError, TypeError):
+            logger.error(self._node.addr, f"Malformed {self.get_name()} from {source}: bad JSON")
+            return
+        if not isinstance(meta, dict) or "tid" not in meta:
+            logger.error(self._node.addr, f"Malformed {self.get_name()} from {source}: no tid")
+            return
+        from p2pfl_tpu.communication.dcn import DcnPlane
+
+        getattr(DcnPlane.instance(), self._handler)(self._node, source, meta)
+
+
+class DcnOfferCommand(_DcnVerbCommand):
+    """Sender proposes a transfer: leaf/codec metadata + its mesh ids."""
+
+    _handler = "on_offer"
+
+    @staticmethod
+    def get_name() -> str:
+        return "dcn_offer"
+
+
+class DcnAcceptCommand(_DcnVerbCommand):
+    """Receiver agreed: its mesh ids + the pair-monotone sequence number."""
+
+    _handler = "on_accept"
+
+    @staticmethod
+    def get_name() -> str:
+        return "dcn_accept"
+
+
+class DcnNackCommand(_DcnVerbCommand):
+    """Receiver refused the offer — sender falls back to the byte path."""
+
+    _handler = "on_nack"
+
+    @staticmethod
+    def get_name() -> str:
+        return "dcn_nack"
+
+
+class DcnReadyCommand(_DcnVerbCommand):
+    """Peer holds its dispatch lock and is about to enter the collective."""
+
+    _handler = "on_ready"
+
+    @staticmethod
+    def get_name() -> str:
+        return "dcn_ready"
+
+
+class DcnDoneCommand(_DcnVerbCommand):
+    """Receiver finished decode + delivery; ``ok`` is the final verdict."""
+
+    _handler = "on_done"
+
+    @staticmethod
+    def get_name() -> str:
+        return "dcn_done"
+
+
+class DcnAbortCommand(_DcnVerbCommand):
+    """Either side tore the rendezvous down (timeout, teardown, error)."""
+
+    _handler = "on_abort"
+
+    @staticmethod
+    def get_name() -> str:
+        return "dcn_abort"
+
+
+DCN_COMMANDS = (
+    DcnOfferCommand,
+    DcnAcceptCommand,
+    DcnNackCommand,
+    DcnReadyCommand,
+    DcnDoneCommand,
+    DcnAbortCommand,
+)

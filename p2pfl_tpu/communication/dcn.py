@@ -1,0 +1,991 @@
+"""The DCN weights plane: cross-process model diffusion, device-native.
+
+``Settings.WEIGHTS_PLANE = "dcn"`` completes the transport hierarchy —
+intra-slice ICI (:mod:`p2pfl_tpu.communication.ici`, co-resident nodes in
+one process) → cross-host DCN (this module, nodes in *different*
+processes of one ``jax.distributed`` world) → WAN gRPC bytes (everything
+else). Model payloads between same-world cross-process peers move as
+device arrays over an XLA cross-host collective
+(:mod:`p2pfl_tpu.parallel.dcn_plane` — the ici_plane pair-mesh exchange
+generalized to process-spanning pairs), composed with the shard-resident
+top-k/int8 codec: encode on the sender's devices, transfer over the
+interconnect, decode against the receiver's anchor. Pickled numpy never
+rides gRPC between these peers.
+
+What deliberately does NOT change (the ici.py contract, verbatim):
+
+- **The control plane.** Votes, beats, TTL floods, membership keep riding
+  the byte transport — including this plane's OWN rendezvous verbs
+  (``dcn_offer``/``dcn_accept``/``dcn_nack``/``dcn_ready``/``dcn_done``/
+  ``dcn_abort``): small direct ``ttl=1`` control messages that carry only
+  JSON metadata, never weights.
+- **The ``_do_send`` seam.** ``try_dcn_send`` runs INSIDE the transport's
+  ``_send_to_neighbor`` (after the ICI attempt), and every rendezvous
+  verb goes out through ``proto._do_send`` — so FaultPlan verdicts,
+  breaker feeds, retries and telemetry spans wrap a DCN transfer exactly
+  as they wrap a byte send. A dropped verb surfaces as a rendezvous
+  timeout and a loud per-edge byte fallback, never a hang.
+- **Failure semantics.** Ineligible peers (not in the world directory,
+  same process, mismatched topology, anchor from another round) fall back
+  LOUDLY to the byte path for that edge only (``dcn_fallback_bytes``
+  metric, one log line per (peer, reason)); a dead peer fails the send so
+  breakers/eviction see their usual signals.
+
+Rendezvous & ordering — why there is a protocol at all: a cross-process
+collective must be co-dispatched by BOTH processes, in the SAME order on
+each (multi-controller SPMD). Discovery rides the distributed runtime's
+KV store (``dcn/nodes/<addr>`` → process placement, published on
+``Node.start``); per transfer, the sender offers (leaf metadata, mesh
+ids, codec specs), the receiver accepts (its mesh ids + a pair-monotone
+``seq`` assigned by the pair's master — the lower ``process_index``), and
+per-pair executor threads on both sides run transfers in ``seq`` order
+behind one process-global dispatch lock, with a ready handshake before
+each dispatch. Any disorder (an abort racing a queue, cross-pair lock
+inversion at ≥3 processes) degrades to a ready-timeout → abort → byte
+fallback, counted and logged — never a deadlock, never silent.
+
+This module is inside the ``no-host-gather`` analyzer scope
+(:mod:`p2pfl_tpu.analysis`): no ``np.asarray``/``jax.device_get``/
+``.tobytes()`` may appear here — weights stay device-resident; only JSON
+scalars ride the control verbs.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import json
+import threading
+import time
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from p2pfl_tpu.communication.ici import (
+    ShardPlaneRegistry,
+    _named_dict,
+    _restore_named,
+)
+from p2pfl_tpu.communication.message import Message, WeightsEnvelope
+from p2pfl_tpu.learning.weights import ModelUpdate, named_leaves
+from p2pfl_tpu.management.logger import logger
+from p2pfl_tpu.management.telemetry import telemetry
+from p2pfl_tpu.parallel.dcn_plane import (
+    dcn_transfer,
+    mesh_from_ids,
+    mesh_wire_meta,
+    process_local,
+    spec_from_wire,
+    spec_to_wire,
+)
+from p2pfl_tpu.parallel.distributed import kv_client, world_active
+from p2pfl_tpu.parallel.ici_plane import (
+    SliceInfo,
+    replicate_on_slice,
+    slice_info_of,
+    tree_device_bytes,
+)
+
+Pytree = Any
+
+#: KV-store key prefix of the world directory
+_DIR_PREFIX = "dcn/nodes/"
+
+#: the six rendezvous verbs (control-plane commands, commands/dcn.py)
+DCN_VERBS = (
+    "dcn_offer", "dcn_accept", "dcn_nack", "dcn_ready", "dcn_done", "dcn_abort",
+)
+
+# ---- process-wide accounting (bench/tests read these) ----
+
+_stats_lock = threading.Lock()
+_stats = {
+    "dcn_sends": 0,       # payloads delivered over the DCN plane (sender side)
+    "dcn_recvs": 0,       # payloads delivered over the DCN plane (receiver side)
+    "bytes_moved": 0,     # device bytes that crossed the interconnect
+    "fallback_bytes": 0,  # sends that fell back to the byte path
+    "nacks": 0,           # offers this process refused (receiver side)
+    "aborts": 0,          # rendezvous aborted after an accept (either side)
+    #: receiver-side re-layouts (device_put within the receiver's slice)
+    #: after a transfer — sender layout differed from the receiver's
+    #: placement; still device-to-device, never host
+    "conform_copies": 0,
+}
+
+
+def dcn_stats() -> dict:
+    with _stats_lock:
+        return dict(_stats)
+
+
+def reset_dcn_stats() -> None:
+    with _stats_lock:
+        for k in _stats:
+            _stats[k] = 0
+
+
+def _count(key: str, n: int = 1) -> None:
+    with _stats_lock:
+        _stats[key] += n
+
+
+def _fallback(src: str, nei: str, reason: str) -> None:
+    """Per-edge loud degradation to the byte path (never aborts)."""
+    _count("fallback_bytes")
+    logger.log_comm_metric(src, "dcn_fallback_bytes")
+    if ShardPlaneRegistry.warn_once(src, nei, "dcn:" + reason):
+        logger.info(
+            src,
+            f"DCN weights plane ineligible for {nei} ({reason}) — "
+            "falling back to the byte path for this peer",
+        )
+    telemetry.event(
+        src, "dcn_fallback", kind="gossip", attrs={"peer": nei, "reason": reason}
+    )
+
+
+# ---- world directory (KV-store backed peer discovery) ----
+
+
+class WorldDirectory:
+    """``node address → process placement`` via the runtime's KV store.
+
+    Nodes publish themselves on ``Node.start`` (withdraw on stop); lookups
+    read the whole ``dcn/nodes/`` directory once per
+    ``Settings.DCN_DIR_TTL_S`` and serve from the snapshot in between —
+    the directory is membership metadata, not a hot path, and
+    ``key_value_dir_get`` is the only non-blocking read this jax exposes.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._cache: dict[str, dict] = {}
+        self._stamp: Optional[float] = None
+
+    def publish(self, addr: str) -> None:
+        client = kv_client()
+        if client is None or not world_active():
+            return
+        info = {"pi": int(jax.process_index())}
+        try:
+            # set is not an upsert on every jaxlib: clear any stale entry
+            # from a restarted node first (delete of a missing key raises
+            # — ignored)
+            try:
+                client.key_value_delete(_DIR_PREFIX + addr)
+            except Exception:  # noqa: BLE001
+                pass
+            client.key_value_set(_DIR_PREFIX + addr, json.dumps(info))
+        except Exception as exc:  # noqa: BLE001 — directory is best-effort
+            logger.debug(addr, f"DCN directory publish failed: {exc!r}")
+        self.invalidate()
+
+    def withdraw(self, addr: str) -> None:
+        client = kv_client()
+        if client is None:
+            return
+        try:
+            client.key_value_delete(_DIR_PREFIX + addr)
+        except Exception:  # noqa: BLE001 — already absent
+            pass
+        self.invalidate()
+
+    def invalidate(self) -> None:
+        with self._lock:
+            self._stamp = None
+
+    def lookup(self, addr: str) -> Optional[dict]:
+        from p2pfl_tpu.settings import Settings
+
+        now = time.monotonic()
+        with self._lock:
+            if self._stamp is not None and now - self._stamp <= Settings.DCN_DIR_TTL_S:
+                return self._cache.get(addr)
+        client = kv_client()
+        if client is None:
+            return None
+        cache: dict[str, dict] = {}
+        try:
+            for key, val in client.key_value_dir_get(_DIR_PREFIX):
+                name = key[len(_DIR_PREFIX):] if key.startswith(_DIR_PREFIX) else key
+                try:
+                    cache[name] = json.loads(val)
+                except (ValueError, TypeError):
+                    continue
+        except Exception as exc:  # noqa: BLE001 — coordinator mid-teardown
+            logger.debug("dcn", f"DCN directory read failed: {exc!r}")
+            return None
+        with self._lock:
+            self._cache = cache
+            self._stamp = now
+            return self._cache.get(addr)
+
+
+# ---- transfer state ----
+
+
+class _Transfer:
+    """One in-flight cross-process transfer (either side)."""
+
+    def __init__(self, tid: str, role: str, peer_pi: int) -> None:
+        self.tid = tid
+        self.role = role  # "send" | "recv"
+        self.peer_pi = peer_pi
+        self.seq: Optional[int] = None
+        self.proto = None           # the local node's protocol (verb channel)
+        self.peer_addr: str = ""
+        self.meta: dict = {}        # the offer (both sides)
+        self.accept_meta: dict = {}
+        self.enqueued = False
+        # sender side
+        self.env = None
+        self.src_info: Optional[SliceInfo] = None
+        self.transfer_tree: Optional[dict] = None
+        self.specs: tuple = ()
+        self.dst_mesh = None
+        self.moved_bytes = 0
+        self.mode = "none"
+        # receiver side
+        self.node = None
+        self.template = None
+        self.src_mesh = None
+        self.dst_info: Optional[SliceInfo] = None
+        self.filler: Optional[dict] = None
+        # rendezvous events
+        self.accepted = threading.Event()
+        self.peer_ready = threading.Event()
+        self.finished = threading.Event()
+        self.outcome: Optional[str] = None  # "ok" | "failed" | "fallback"
+        self.reason = ""
+
+
+# ---- the plane ----
+
+
+class DcnPlane:
+    """Process-global DCN rendezvous state: transfers, per-peer-process
+    executors, the pair-monotone sequence counters and the dispatch-order
+    lock. One instance per process (all local nodes share it — collective
+    dispatch order is a PROCESS property, not a node property)."""
+
+    _instance: Optional["DcnPlane"] = None
+    _ilock = threading.Lock()
+
+    @classmethod
+    def instance(cls) -> "DcnPlane":
+        with cls._ilock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    @classmethod
+    def reset(cls) -> None:
+        """Tests: drop all state and stop executor threads."""
+        with cls._ilock:
+            inst = cls._instance
+            cls._instance = None
+        if inst is not None:
+            with inst._lock:
+                inst._stop = True
+                for cv in inst._cvs.values():
+                    cv.notify_all()
+                transfers = list(inst._transfers.values())
+            for t in transfers:
+                inst._finish(t, "fallback", "plane_reset")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._transfers: dict[str, _Transfer] = {}
+        self._heaps: dict[int, list] = {}
+        self._cvs: dict[int, threading.Condition] = {}
+        self._seqs: dict[int, int] = {}
+        self._tids = itertools.count(1)
+        self._stop = False
+        #: collective dispatch order is process-global: ONE cross-process
+        #: exchange in flight per process at a time
+        self._dispatch_lock = threading.Lock()
+        self._filler_lock = threading.Lock()
+        self._fillers: dict = {}
+        self.directory = WorldDirectory()
+
+    # ---- verb plumbing ----
+
+    @staticmethod
+    def _verb_msg(proto, cmd: str, payload: dict, round: int = -1) -> Message:
+        return Message(
+            proto.get_address(),
+            cmd,
+            (json.dumps(payload),),
+            round,
+            ttl=1,  # direct rendezvous, never flooded
+            trace_ctx=telemetry.current_ctx(),
+            xp=getattr(proto, "experiment_xid", None),
+        )
+
+    def _send_verb(self, proto, nei: str, cmd: str, payload: dict, round: int = -1) -> bool:
+        """One rendezvous verb through the ``_do_send`` seam (spans +
+        fault injector apply; no gossip retry — the rendezvous has its own
+        timeout/abort machinery)."""
+        try:
+            return bool(
+                proto._do_send(
+                    nei, self._verb_msg(proto, cmd, payload, round), create_connection=True
+                )
+            )
+        except Exception as exc:  # noqa: BLE001 — a failed verb is a failed verb
+            logger.debug(proto.get_address(), f"DCN verb {cmd} to {nei} failed: {exc!r}")
+            return False
+
+    # ---- sequencing / executors ----
+
+    def _next_seq_locked(self, peer_pi: int) -> int:
+        self._seqs[peer_pi] = self._seqs.get(peer_pi, 0) + 1
+        return self._seqs[peer_pi]
+
+    def _get(self, tid: str) -> Optional[_Transfer]:
+        with self._lock:
+            return self._transfers.get(tid)
+
+    def _enqueue(self, t: _Transfer) -> None:
+        with self._lock:
+            if self._stop or t.enqueued or t.seq is None:
+                return
+            t.enqueued = True
+            if t.peer_pi not in self._heaps:
+                self._heaps[t.peer_pi] = []
+                self._cvs[t.peer_pi] = threading.Condition(self._lock)
+                threading.Thread(
+                    target=self._run_executor,
+                    args=(t.peer_pi,),
+                    name=f"dcn-exec-p{t.peer_pi}",
+                    daemon=True,
+                ).start()
+            heapq.heappush(self._heaps[t.peer_pi], (t.seq, t.tid))
+            self._cvs[t.peer_pi].notify_all()
+
+    def _run_executor(self, peer_pi: int) -> None:
+        while True:
+            with self._lock:
+                cv = self._cvs[peer_pi]
+                while not self._stop and not self._heaps[peer_pi]:
+                    cv.wait(timeout=0.5)
+                if self._stop:
+                    return
+                _seq, tid = heapq.heappop(self._heaps[peer_pi])
+                t = self._transfers.get(tid)
+            if t is None:
+                continue  # finished/aborted while queued
+            try:
+                self._execute(t)
+            except Exception as exc:  # noqa: BLE001 — executor must survive
+                logger.error("dcn", f"DCN executor error on {tid}: {exc!r}")
+                self._abort(t, f"executor_error:{exc!r}", outcome="failed", notify=True)
+
+    # ---- lifecycle hooks (Node.start/stop) ----
+
+    def publish_node(self, addr: str) -> None:
+        self.directory.publish(addr)
+
+    def withdraw_node(self, addr: str) -> None:
+        self.directory.withdraw(addr)
+
+    # ---- finish / abort ----
+
+    def _finish(self, t: _Transfer, outcome: str, reason: str = "") -> bool:
+        with self._lock:
+            first = t.outcome is None
+            if first:
+                t.outcome = outcome
+                t.reason = reason
+            self._transfers.pop(t.tid, None)
+        t.accepted.set()
+        t.finished.set()
+        return first
+
+    def _abort(
+        self, t: _Transfer, reason: str, outcome: str = "fallback", notify: bool = False
+    ) -> None:
+        if self._finish(t, outcome, reason):
+            _count("aborts")
+            if notify and t.proto is not None and t.peer_addr:
+                self._send_verb(
+                    t.proto, t.peer_addr, "dcn_abort", {"tid": t.tid, "reason": reason}
+                )
+
+    # ---- sender side ----
+
+    def begin_send(
+        self, proto, nei: str, env, built: dict, src_info: SliceInfo, src_ep, peer_pi: int
+    ) -> Optional[_Transfer]:
+        update = env.update
+        my_pi = int(jax.process_index())
+        tid = f"{proto.get_address()}#{next(self._tids)}"
+        t = _Transfer(tid, "send", peer_pi)
+        t.proto = proto
+        t.peer_addr = nei
+        t.env = env
+        t.src_info = src_info
+        t.transfer_tree = built["transfer"]
+        t.specs = built["specs"]
+        t.moved_bytes = built["moved"]
+        t.mode = built["mode"]
+        with self._lock:
+            if my_pi < peer_pi:
+                t.seq = self._next_seq_locked(peer_pi)
+            self._transfers[tid] = t
+        sp = src_ep.handshake(t.mode)
+        offer = {
+            "tid": tid,
+            "seq": t.seq,
+            "pi": my_pi,
+            "src": proto.get_address(),
+            "dst": nei,
+            "cmd": env.cmd,
+            "round": env.round,
+            "msg_id": env.msg_id,
+            "tc": list(env.trace_ctx) if env.trace_ctx else None,
+            "xp": env.xp or update.xp,
+            "contributors": list(update.contributors),
+            "num_samples": update.num_samples,
+            "vv": list(update.version) if update.version else None,
+            "sp": [list(sp[0]), sp[1], sp[2]] if sp else None,
+            "anchor_tag": update.anchor_tag,
+            "mode": t.mode,
+            "mesh": mesh_wire_meta(src_info),
+            "model": built["model_meta"],
+            "leaves": built["leaves_meta"],
+            "tk_spec": [list(e) for e in built["tk_spec"]],
+            "dense_spec": [list(e) for e in built["dense_spec"]],
+        }
+        t.meta = offer
+        if not self._send_verb(proto, nei, "dcn_offer", offer, round=env.round):
+            # peer unreachable: clean up and let the byte path fail the
+            # send, so breakers/eviction see their usual signals
+            with self._lock:
+                self._transfers.pop(tid, None)
+            return None
+        return t
+
+    def await_send(self, t: _Transfer, proto, nei: str) -> Optional[bool]:
+        from p2pfl_tpu.settings import Settings
+
+        src = proto.get_address()
+        if not t.accepted.wait(Settings.DCN_ACCEPT_TIMEOUT_S):
+            self._abort(t, "accept_timeout", notify=True)
+        if not t.finished.wait(Settings.DCN_DONE_TIMEOUT_S):
+            # the collective may already have fired: falling back to bytes
+            # here could double-deliver, so a done-timeout is a FAILED
+            # send (the gossiper's normal retry machinery takes over)
+            self._abort(t, "done_timeout", outcome="failed", notify=True)
+        if t.outcome == "ok":
+            _count("dcn_sends")
+            _count("bytes_moved", t.moved_bytes)
+            logger.log_comm_metric(src, "dcn_send_shard")
+            logger.log_comm_metric(src, "dcn_bytes_moved", t.moved_bytes)
+            telemetry.event(
+                src,
+                "dcn_transfer",
+                kind="gossip",
+                attrs={
+                    "peer": nei, "codec": t.mode, "bytes": t.moved_bytes, "seq": t.seq,
+                },
+            )
+            return True
+        if t.outcome == "failed":
+            logger.error(src, f"DCN transfer to {nei} failed ({t.reason})")
+            return False
+        _fallback(src, nei, t.reason or "aborted")
+        return None
+
+    def on_accept(self, node, source: str, meta: dict) -> None:
+        t = self._get(str(meta.get("tid")))
+        if t is None or t.role != "send":
+            # stale accept (we already aborted): tell the peer to unqueue
+            self._send_verb(
+                node.protocol, source, "dcn_abort",
+                {"tid": str(meta.get("tid")), "reason": "unknown_tid"},
+            )
+            return
+        with self._lock:
+            if t.enqueued:
+                return  # duplicate accept
+            if t.seq is None:
+                try:
+                    t.seq = int(meta["seq"])
+                except (KeyError, TypeError, ValueError):
+                    pass
+            t.accept_meta = meta
+        if t.seq is None:
+            self._abort(t, "accept_without_seq", notify=True)
+            return
+        t.accepted.set()
+        self._enqueue(t)
+
+    def on_nack(self, node, source: str, meta: dict) -> None:
+        t = self._get(str(meta.get("tid")))
+        if t is not None:
+            self._finish(t, "fallback", str(meta.get("reason", "nacked")))
+
+    def on_done(self, node, source: str, meta: dict) -> None:
+        t = self._get(str(meta.get("tid")))
+        if t is not None:
+            ok = bool(meta.get("ok"))
+            self._finish(t, "ok" if ok else "failed", "" if ok else "peer_deliver_failed")
+
+    def on_ready(self, node, source: str, meta: dict) -> None:
+        t = self._get(str(meta.get("tid")))
+        if t is not None:
+            t.peer_ready.set()
+
+    def on_abort(self, node, source: str, meta: dict) -> None:
+        t = self._get(str(meta.get("tid")))
+        if t is not None:
+            reason = str(meta.get("reason", "peer_abort"))
+            self._finish(t, "fallback", f"peer_abort:{reason}")
+
+    # ---- receiver side ----
+
+    def on_offer(self, node, source: str, meta: dict) -> None:
+        from p2pfl_tpu.settings import Settings
+
+        proto = node.protocol
+        tid = str(meta.get("tid"))
+
+        def nack(reason: str) -> None:
+            _count("nacks")
+            logger.log_comm_metric(proto.get_address(), "dcn_nack")
+            self._send_verb(proto, source, "dcn_nack", {"tid": tid, "reason": reason})
+
+        if Settings.WEIGHTS_PLANE != "dcn":
+            nack("plane_off")
+            return
+        if not world_active():
+            nack("no_distributed_world")
+            return
+        if not getattr(node, "_running", False) or node.learner is None:
+            nack("peer_not_ready")
+            return
+        try:
+            template = node.learner.get_parameters()
+        except Exception:  # noqa: BLE001 — learner mid-teardown
+            nack("peer_not_ready")
+            return
+        tmpl_named = _named_dict(template)
+        model_meta = {
+            str(k): (tuple(shape), str(dt)) for k, shape, dt in meta.get("model", [])
+        }
+        mine = {
+            k: (tuple(leaf.shape), str(leaf.dtype)) for k, leaf in tmpl_named.items()
+        }
+        if model_meta != mine:
+            nack("architecture_mismatch")
+            return
+        dst_info = slice_info_of(template)
+        if dst_info is None:
+            nack("params_not_device_resident")
+            return
+        if not process_local(dst_info):
+            nack("slice_spans_processes")
+            return
+        mesh_meta = meta.get("mesh") or {}
+        if (
+            list(dst_info.mesh.devices.shape) != list(mesh_meta.get("shape", []))
+            or list(dst_info.mesh.axis_names) != list(mesh_meta.get("axes", []))
+        ):
+            nack("slice_topology_mismatch")
+            return
+        src_mesh = mesh_from_ids(
+            mesh_meta["ids"], mesh_meta["shape"], mesh_meta["axes"]
+        )
+        if src_mesh is None:
+            nack("unknown_devices")
+            return
+        my_pi = int(jax.process_index())
+        if any(d.process_index == my_pi for d in src_mesh.devices.flat):
+            nack("same_process")
+            return
+        mode = str(meta.get("mode", "none"))
+        if mode in ("int8", "topk8") and meta.get("tk_spec"):
+            dst_anchor = getattr(node.learner, "_wire_anchor", None)
+            dst_tag = getattr(node.learner, "_wire_anchor_tag", None)
+            if dst_anchor is None or dst_tag != meta.get("anchor_tag"):
+                nack("anchor_round_mismatch")
+                return
+        peer_pi = int(meta.get("pi", -1))
+        t = _Transfer(tid, "recv", peer_pi)
+        t.proto = proto
+        t.peer_addr = source
+        t.meta = meta
+        t.mode = mode
+        t.node = node
+        t.template = template
+        t.src_mesh = src_mesh
+        t.dst_info = dst_info
+        with self._lock:
+            if tid in self._transfers:
+                return  # duplicate offer
+            if my_pi < peer_pi:
+                t.seq = self._next_seq_locked(peer_pi)
+            else:
+                try:
+                    t.seq = int(meta["seq"])
+                except (KeyError, TypeError, ValueError):
+                    t.seq = None
+            if t.seq is not None:
+                self._transfers[tid] = t
+        if t.seq is None:
+            nack("offer_without_seq")
+            return
+        accept = {"tid": tid, "seq": t.seq, "mesh": mesh_wire_meta(dst_info)}
+        if not self._send_verb(proto, source, "dcn_accept", accept):
+            self._finish(t, "fallback", "accept_send_failed")
+            return
+        self._enqueue(t)
+
+    def _filler_buf(self, key: str, shape: tuple, dtype: str, mesh, spec):
+        """Zero filler resident on this side's slice under the transfer
+        spec — cached per (name, shape, dtype, devices, spec)."""
+        ck = (
+            key, shape, dtype, tuple(d.id for d in mesh.devices.flat),
+            tuple(spec_to_wire_key(spec)),
+        )
+        with self._filler_lock:
+            buf = self._fillers.get(ck)
+        if buf is not None:
+            return buf
+        buf = jax.device_put(jnp.zeros(shape, dtype), NamedSharding(mesh, spec))
+        with self._filler_lock:
+            self._fillers[ck] = buf
+        return buf
+
+    # ---- the executor body (both roles) ----
+
+    def _prepare(self, t: _Transfer) -> None:
+        if t.role == "send":
+            mesh_meta = t.accept_meta.get("mesh") or {}
+            dst_mesh = mesh_from_ids(
+                mesh_meta.get("ids", []),
+                mesh_meta.get("shape", []),
+                mesh_meta.get("axes", []),
+            )
+            if dst_mesh is None:
+                raise RuntimeError("peer devices unknown to this world")
+            if (
+                dst_mesh.devices.shape != t.src_info.mesh.devices.shape
+                or dst_mesh.axis_names != t.src_info.mesh.axis_names
+            ):
+                raise RuntimeError("peer slice topology mismatch")
+            t.dst_mesh = dst_mesh
+        else:
+            filler = {}
+            for key, shape, dtype, specw in t.meta["leaves"]:
+                spec = spec_from_wire(specw)
+                filler[str(key)] = self._filler_buf(
+                    str(key), tuple(shape), str(dtype), t.dst_info.mesh, spec
+                )
+            t.filler = filler
+            t.specs = tuple(
+                spec_from_wire(specw)
+                for _key, _shape, _dt, specw in sorted(
+                    t.meta["leaves"], key=lambda e: str(e[0])
+                )
+            )
+
+    def _execute(self, t: _Transfer) -> None:
+        from p2pfl_tpu.settings import Settings
+
+        if t.finished.is_set():
+            return
+        try:
+            self._prepare(t)
+        except Exception as exc:  # noqa: BLE001 — bad metadata, not a bug
+            self._abort(t, f"prepare_failed:{exc!r}", notify=True)
+            return
+        if not self._dispatch_lock.acquire(timeout=Settings.DCN_READY_TIMEOUT_S):
+            self._abort(t, "dispatch_lock_timeout", notify=True)
+            return
+        landed = None
+        err: Optional[Exception] = None
+        try:
+            if t.finished.is_set():
+                return
+            self._send_verb(t.proto, t.peer_addr, "dcn_ready", {"tid": t.tid})
+            if not t.peer_ready.wait(Settings.DCN_READY_TIMEOUT_S):
+                self._abort(t, "ready_timeout", notify=True)
+                return
+            if t.finished.is_set():
+                return  # aborted during the handshake
+            if t.role == "send":
+                dcn_transfer(
+                    t.transfer_tree, t.src_info.mesh, t.dst_mesh, t.specs, "send"
+                )
+            else:
+                landed = dcn_transfer(
+                    t.filler, t.src_mesh, t.dst_info.mesh, t.specs, "recv"
+                )
+        except Exception as exc:  # noqa: BLE001 — a failed exchange is a failed send
+            err = exc
+        finally:
+            self._dispatch_lock.release()
+        if err is not None:
+            logger.error(
+                t.proto.get_address(), f"DCN exchange {t.tid} failed: {err!r}"
+            )
+            self._abort(t, f"exchange_failed:{err!r}", outcome="failed", notify=True)
+            return
+        if t.role == "send":
+            return  # completion arrives as dcn_done
+        # decode + delivery run OFF the executor thread: a command handler
+        # reached through handle_weights may itself start a DCN send to the
+        # same peer, and THAT dispatch needs this executor free (only
+        # collective DISPATCH is order-constrained, not delivery)
+        threading.Thread(
+            target=self._deliver_and_done,
+            args=(t, landed),
+            name=f"dcn-deliver-{t.tid}",
+            daemon=True,
+        ).start()
+
+    def _deliver_and_done(self, t: _Transfer, landed: dict) -> None:
+        ok = self._deliver(t, landed)
+        self._send_verb(
+            t.proto, t.peer_addr, "dcn_done", {"tid": t.tid, "ok": bool(ok)}
+        )
+        self._finish(t, "ok" if ok else "failed", "" if ok else "deliver_failed")
+
+    def _deliver(self, t: _Transfer, landed: dict) -> bool:
+        node = t.node
+        meta = t.meta
+        try:
+            tmpl_named = _named_dict(t.template)
+            tk_spec = tuple(tuple(e) for e in meta.get("tk_spec", []))
+            dense_spec = tuple(tuple(e) for e in meta.get("dense_spec", []))
+            if tk_spec or dense_spec:
+                from p2pfl_tpu.ops.compression import decode_shard_device
+
+                payload = {k[2:]: v for k, v in landed.items() if k.startswith("c/")}
+                anchor_named = None
+                if tk_spec:
+                    anchor_named = _named_dict(
+                        getattr(node.learner, "_wire_anchor", None)
+                    )
+                out_named = decode_shard_device(
+                    payload, tk_spec, dense_spec, anchor_named, tmpl_named
+                )
+                for k, v in landed.items():
+                    if k.startswith("r/"):
+                        out_named[k[2:]] = v
+            else:
+                out_named = {k[2:]: v for k, v in landed.items()}
+            restored = _restore_named(t.template, out_named)
+            # decoded/landed layouts are the SENDER's: normalize onto the
+            # receiver's own placement (device_put within the receiver's
+            # slice, counted as conform, never host)
+            from p2pfl_tpu.ops.tree import tree_align_copy_count, tree_align_devices
+
+            before = tree_align_copy_count()
+            restored = tree_align_devices(restored, t.template)
+            moved_leaves = tree_align_copy_count() - before
+            if moved_leaves:
+                _count("conform_copies", moved_leaves)
+            sp = meta.get("sp")
+            delivered = ModelUpdate(
+                restored,
+                [str(c) for c in meta.get("contributors", [])],
+                int(meta.get("num_samples", 1)),
+                version=tuple(meta["vv"]) if meta.get("vv") else None,
+                xp=meta.get("xp"),
+                sp=(tuple(sp[0]), sp[1], sp[2]) if sp else None,
+            )
+            # the receiver re-encodes relays/diffusions against ITS OWN
+            # anchor, exactly like the byte path's materialize()
+            delivered.anchor = getattr(node.learner, "_wire_anchor", None)
+            delivered.anchor_tag = getattr(node.learner, "_wire_anchor_tag", None)
+            tc = meta.get("tc")
+            denv = WeightsEnvelope(
+                str(meta.get("src", t.peer_addr)),
+                int(meta.get("round", -1)),
+                str(meta.get("cmd", "add_model")),
+                delivered,
+                str(meta.get("msg_id", "")),
+                trace_ctx=(tc[0], tc[1]) if tc else None,
+                xp=meta.get("xp"),
+            )
+            result = node.protocol.handle_weights(denv)
+        except Exception as exc:  # noqa: BLE001 — delivery must not kill the executor
+            logger.error(
+                t.proto.get_address(),
+                f"DCN delivery from {t.peer_addr} failed: {exc!r}",
+            )
+            return False
+        _count("dcn_recvs")
+        logger.log_comm_metric(node.addr, "dcn_recv_shard")
+        telemetry.event(
+            node.addr,
+            "dcn_transfer_recv",
+            kind="gossip",
+            attrs={"peer": t.peer_addr, "codec": t.mode, "seq": t.seq},
+        )
+        return bool(result.ok)
+
+
+def spec_to_wire_key(spec) -> tuple:
+    """Hashable form of a PartitionSpec for cache keys."""
+    return tuple(tuple(e) if isinstance(e, (list, tuple)) else e for e in spec)
+
+
+# ---- sender-side payload build (the codec leg) ----
+
+
+def _build_payload(update: ModelUpdate, src_info: SliceInfo, mode: str) -> dict:
+    """Encode-once: the transfer tree (codec buffers + raw passthrough),
+    per-key specs and all wire metadata. Mirrors ``ici._move_codec``'s
+    encode half, caching under a ``"dcn"``-prefixed key and claiming the
+    cross-plane error-feedback fold through the SAME
+    ``PayloadCache.ef_fold_once`` ownership protocol."""
+    from p2pfl_tpu.settings import Settings
+
+    src_params = update.params
+    named = _named_dict(src_params)
+    spec_keys = [k for k, _leaf in named_leaves(src_params)[1]]
+    spec_by_key = dict(zip(spec_keys, src_info.specs))
+    model_meta = [
+        [k, list(named[k].shape), str(named[k].dtype)] for k in sorted(named)
+    ]
+    tk_spec: tuple = ()
+    dense_spec: tuple = ()
+    if mode in ("int8", "topk8"):
+        from p2pfl_tpu.ops.compression import build_topk_plan, encode_shard_device
+
+        anchor_named = (
+            _named_dict(update.anchor) if update.anchor is not None else None
+        )
+        topk_frac = Settings.TOPK_FRACTION if mode == "topk8" else 0.0
+        topk_plan = build_topk_plan(named, anchor_named, topk_frac)
+        with update._encode_lock:
+            cache = update.payload_cache
+            use_cache = Settings.GOSSIP_PAYLOAD_CACHE
+            key = None
+            cached = None
+            if use_cache and cache is not None and update.cache_version is not None:
+                key = (
+                    "dcn",
+                    update.cache_version,
+                    update.cache_round,
+                    mode,
+                    update.anchor_tag,
+                    update.ef_residual is not None,
+                )
+                cached = cache.get(key)
+            elif use_cache:
+                cached = getattr(update, "_dcn_payload", None)
+            if cached is not None:
+                tk_spec, dense_spec, payload = cached
+            else:
+                residual = update.ef_residual
+                if (
+                    residual is not None
+                    and cache is not None
+                    and update.cache_version is not None
+                ):
+                    # cross-plane fold ownership — ONE key builder, shared
+                    # with the byte and ICI encoders (ModelUpdate.ef_fold_key)
+                    if not cache.ef_fold_once(update.ef_fold_key(mode)):
+                        residual = None
+                tk_spec, dense_spec, payload = encode_shard_device(
+                    named,
+                    anchor_named,
+                    topk_plan,
+                    residual,
+                    # optimization_barrier under the SPMD partitioner is a
+                    # single-device-only workaround (see _encode_jit)
+                    barrier=len(src_info.device_ids) == 1,
+                )
+                payload = replicate_on_slice(payload, src_info)
+                if key is not None:
+                    cache.put(key, (tk_spec, dense_spec, payload))
+                elif use_cache:
+                    update._dcn_payload = (tk_spec, dense_spec, payload)
+        coded = {k for k, _s, _b in tk_spec} | {k for k, _s in dense_spec}
+        raw_keys = [k for k in sorted(named) if k not in coded]
+        transfer = {f"c/{k}": v for k, v in payload.items()}
+        spec_of = {f"c/{k}": P() for k in payload}
+        for k in raw_keys:
+            transfer[f"r/{k}"] = named[k]
+            spec_of[f"r/{k}"] = spec_by_key[k]
+    else:
+        transfer = {f"r/{k}": named[k] for k in named}
+        spec_of = {f"r/{k}": spec_by_key[k] for k in named}
+    ordered = sorted(transfer)
+    specs = tuple(spec_of[k] for k in ordered)
+    leaves_meta = [
+        [k, list(transfer[k].shape), str(transfer[k].dtype), spec_to_wire(spec_of[k])]
+        for k in ordered
+    ]
+    return {
+        "mode": mode,
+        "transfer": transfer,
+        "specs": specs,
+        "moved": tree_device_bytes(transfer),
+        "model_meta": model_meta,
+        "leaves_meta": leaves_meta,
+        "tk_spec": tk_spec,
+        "dense_spec": dense_spec,
+    }
+
+
+# ---- the transport hook ----
+
+
+def try_dcn_send(proto, nei: str, env) -> Optional[bool]:
+    """Attempt a DCN cross-process delivery for one outgoing envelope.
+
+    Returns ``True``/``False`` when the plane handled the send (the byte
+    path must NOT run), or ``None`` when this edge is not DCN-eligible
+    and the caller proceeds down its byte path. Called from inside
+    ``_send_to_neighbor`` AFTER the ICI attempt, so the per-edge ladder
+    is: ICI (co-resident) → DCN (same world, different process) → bytes.
+    """
+    from p2pfl_tpu.settings import Settings
+
+    if Settings.WEIGHTS_PLANE != "dcn" or not isinstance(env, WeightsEnvelope):
+        return None
+    update = env.update
+    if update.params is None:
+        return None  # pre-encoded frame (relay) — bytes it is
+    src = proto.get_address()
+    if not world_active():
+        _fallback(src, nei, "no_distributed_world")
+        return None
+    plane = DcnPlane.instance()
+    peer = plane.directory.lookup(nei)
+    if peer is None:
+        _fallback(src, nei, "peer_not_in_world_directory")
+        return None
+    peer_pi = int(peer.get("pi", -1))
+    if peer_pi == int(jax.process_index()):
+        # same process: the ICI plane's territory — it already ran (and
+        # counted any fallback of its own); stay silent here
+        return None
+    src_ep = ShardPlaneRegistry.get(src)
+    if src_ep is None:
+        _fallback(src, nei, "sender_not_on_shard_plane")
+        return None
+    src_info = slice_info_of(update.params)
+    if src_info is None:
+        _fallback(src, nei, "params_not_device_resident")
+        return None
+    if not process_local(src_info):
+        _fallback(src, nei, "slice_spans_processes")
+        return None
+    try:
+        built = _build_payload(update, src_info, Settings.WIRE_COMPRESSION)
+    except Exception as exc:  # noqa: BLE001 — a failed encode is a failed send
+        logger.error(src, f"DCN encode for {nei} failed: {exc!r}")
+        return False
+    t = plane.begin_send(proto, nei, env, built, src_info, src_ep, peer_pi)
+    if t is None:
+        return None  # offer undeliverable — byte path fails the send
+    return plane.await_send(t, proto, nei)

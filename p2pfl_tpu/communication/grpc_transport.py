@@ -293,10 +293,18 @@ class GrpcProtocol(CommunicationProtocol):
                 # the socket; sits inside the transport send so the fault
                 # injector/spans at the _do_send seam wrap it unchanged.
                 # Cross-process peers are never on the shard registry and
-                # fall through to the wire below.
+                # fall through to the DCN plane (same jax.distributed
+                # world, different process: device arrays over the
+                # cross-host collective — communication/dcn.py) and only
+                # then to the wire below. Per-edge ladder: ICI → DCN →
+                # bytes.
+                from p2pfl_tpu.communication.dcn import try_dcn_send
                 from p2pfl_tpu.communication.ici import try_shard_send
 
                 handled = try_shard_send(self, nei, env)
+                if handled is not None:
+                    return handled
+                handled = try_dcn_send(self, nei, env)
                 if handled is not None:
                     return handled
                 payload = _enc_weights(env)

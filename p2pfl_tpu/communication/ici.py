@@ -218,7 +218,7 @@ def stamp_handshake(addr: str, update: ModelUpdate) -> None:
     """
     from p2pfl_tpu.settings import Settings
 
-    if Settings.WEIGHTS_PLANE != "ici" or update.sp is not None:
+    if Settings.WEIGHTS_PLANE not in ("ici", "dcn") or update.sp is not None:
         return
     ep = ShardPlaneRegistry.get(addr)
     if ep is None:
@@ -424,7 +424,9 @@ def try_shard_send(proto, nei: str, env) -> Optional[bool]:
     """
     from p2pfl_tpu.settings import Settings, ici_backend
 
-    if Settings.WEIGHTS_PLANE != "ici" or not isinstance(env, WeightsEnvelope):
+    if Settings.WEIGHTS_PLANE not in ("ici", "dcn") or not isinstance(
+        env, WeightsEnvelope
+    ):
         return None
     update = env.update
     if update.params is None:
@@ -433,6 +435,11 @@ def try_shard_send(proto, nei: str, env) -> Optional[bool]:
     src_ep = ShardPlaneRegistry.get(src)
     dst_ep = ShardPlaneRegistry.get(nei)
     if src_ep is None or dst_ep is None:
+        if Settings.WEIGHTS_PLANE == "dcn":
+            # cross-process peers are never on this process's registry —
+            # the DCN plane runs next in the ladder and does its own
+            # (loud) eligibility accounting; stay silent here
+            return None
         _fallback(src, nei, "peer_not_on_shard_plane")
         return None
     dst_node = dst_ep.node()

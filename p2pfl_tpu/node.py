@@ -186,6 +186,14 @@ class Node:
             AsyncLeaveCommand(self),
         ):
             self.protocol.add_command(cmd)
+        # DCN rendezvous verbs (communication/dcn.py): control-plane half
+        # of the cross-process weights plane — registered unconditionally
+        # (the plane gates on Settings.WEIGHTS_PLANE + world state per
+        # offer, same idiom as the ICI registration below)
+        from p2pfl_tpu.commands.dcn import DCN_COMMANDS
+
+        for cmd_cls in DCN_COMMANDS:
+            self.protocol.add_command(cmd_cls(self))
 
     # ---- lifecycle (reference node.py:204-241) ----
 
@@ -205,6 +213,13 @@ class Node:
             from p2pfl_tpu.communication.ici import IciEndpoint, ShardPlaneRegistry
 
             ShardPlaneRegistry.register(self.addr, IciEndpoint(self))
+            # world-directory presence (communication/dcn.py): same-world
+            # peers in OTHER processes discover this node's placement via
+            # the distributed runtime's KV store; no-op outside a
+            # multi-process jax.distributed world
+            from p2pfl_tpu.communication.dcn import DcnPlane
+
+            DcnPlane.instance().publish_node(self.addr)
         self._running = True
         if wait:
             self.protocol.wait_for_termination()
@@ -216,6 +231,9 @@ class Node:
         from p2pfl_tpu.communication.ici import ShardPlaneRegistry
 
         ShardPlaneRegistry.unregister(self.addr)
+        from p2pfl_tpu.communication.dcn import DcnPlane
+
+        DcnPlane.instance().withdraw_node(self.addr)
         self._stop_learning()
         self.protocol.stop()
         logger.unregister_node(self.addr)

@@ -71,6 +71,11 @@ class NodeState:
         # (round, owner, revealer) -> revealed (x, y); x == 0 means the
         # owner's DIRECT seed disclosure (y is b^r itself)
         self.secagg_share_reveals: Dict[tuple, tuple] = {}
+        # ahead-of-round share reveals (round > st.round at arrival): the
+        # holder list for that round hasn't latched, so standing/index
+        # can't be judged yet — promote_early_reveals (commands/control.py)
+        # re-validates these once the set latches
+        self.secagg_early_reveals: Dict[tuple, tuple] = {}
         # (round, owner) reveals THIS node already broadcast — send once
         self.secagg_reveal_sent: set = set()
         # (round, addr) members treated as DROPPED this round (own missing
@@ -161,6 +166,7 @@ class NodeState:
         self.secagg_self_seed = {}
         self.secagg_shares_held = {}
         self.secagg_share_reveals = {}
+        self.secagg_early_reveals = {}
         self.secagg_reveal_sent = set()
         self.secagg_round_dropped = set()
         with self.status_merge_lock:

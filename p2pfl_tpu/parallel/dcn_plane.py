@@ -1,0 +1,176 @@
+"""Cross-process shard transfer glue for the DCN weights plane.
+
+The process-spanning generalization of :mod:`p2pfl_tpu.parallel.ici_plane`:
+move a pytree that lives on one node's device slice in THIS process onto
+the matching devices of a peer node's slice in ANOTHER process of the same
+``jax.distributed`` world — device ``p`` of the source slice copies its
+block to device ``p`` of the destination slice over the cross-host
+interconnect (DCN on a pod; gloo on the CPU world CI runs), never through
+host pickling.
+
+Mechanics — the ici_plane pair-mesh idiom under multi-controller SPMD:
+
+1. Both processes independently build the SAME ``(2, *slice_shape)`` pair
+   mesh from the global device list (``jax.devices()`` spans the world;
+   the rendezvous protocol in ``communication/dcn.py`` carried the peer's
+   device ids). Row 0 is the sender's slice, row 1 the receiver's.
+2. Each process wraps its OWN row's shards into the pair-global arrays —
+   ``make_array_from_single_device_arrays`` accepts exactly the
+   addressable shards, which per process is one row: the sender
+   contributes the payload blocks, the receiver zero filler blocks.
+3. Both processes co-dispatch ONE jitted ``shard_map`` exchange program
+   (the ici_plane 2-cycle ``ppermute`` — same program cache, same
+   backends) over the pair mesh. XLA runs it as a cross-process
+   computation; the blocks swap rows over the wire.
+4. The receiver re-wraps its row of the output under its own shardings
+   (metadata assembly) — the delivered tree is already placed where its
+   jits expect it. The sender's row holds the discarded filler.
+
+Each side must dispatch transfers in the SAME order — that sequencing
+(per-pair monotone seq + ready handshake) is ``communication/dcn.py``'s
+job; this module is the pure device-plane primitive.
+
+This module is inside the ``no-host-gather`` analyzer scope
+(:mod:`p2pfl_tpu.analysis`): no ``np.asarray``/``jax.device_get``/
+``.tobytes()`` may appear here — the zero-host-bytes contract is enforced
+statically, not by prose.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from p2pfl_tpu.parallel.ici_plane import PAIR_AXIS, SliceInfo, _exchange_program
+
+Pytree = Any
+
+
+def devices_by_id() -> dict:
+    """Global (world-spanning) device id → device object map."""
+    return {d.id: d for d in jax.devices()}
+
+
+def mesh_from_ids(
+    ids: Sequence[int], shape: Sequence[int], axis_names: Sequence[str]
+) -> Optional[Mesh]:
+    """Rebuild a peer slice's mesh from wire metadata (flat C-order ids).
+
+    Returns ``None`` when an id is not in this world's device list — the
+    caller then nacks the transfer instead of crashing.
+    """
+    by_id = devices_by_id()
+    flat = np.empty((len(ids),), dtype=object)
+    for i, did in enumerate(ids):
+        dev = by_id.get(int(did))
+        if dev is None:
+            return None
+        flat[i] = dev
+    return Mesh(flat.reshape(tuple(shape)), tuple(axis_names))
+
+
+def mesh_wire_meta(info: SliceInfo) -> dict:
+    """A slice mesh as JSON-ready metadata: flat C-order ids + shape +
+    axis names (the offer/accept's topology fields)."""
+    return {
+        "ids": [int(d.id) for d in info.mesh.devices.flat],
+        "shape": list(info.mesh.devices.shape),
+        "axes": list(info.mesh.axis_names),
+    }
+
+
+def process_local(info: SliceInfo) -> bool:
+    """True when every device of the slice belongs to THIS process — the
+    DCN plane's precondition on both endpoints (each side contributes
+    exactly one row of the pair mesh)."""
+    pi = jax.process_index()
+    return all(d.process_index == pi for d in info.mesh.devices.flat)
+
+
+def spec_to_wire(spec) -> list:
+    """A ``PartitionSpec`` as JSON (tuples become lists)."""
+    return [list(e) if isinstance(e, tuple) else e for e in spec]
+
+
+def spec_from_wire(wire) -> P:
+    """Inverse of :func:`spec_to_wire`."""
+    return P(*[tuple(e) if isinstance(e, list) else e for e in wire])
+
+
+def _pair_global_local(leaf_local, gsharding: NamedSharding, gshape: tuple):
+    """Wrap ONE process's row of a pair-global from its local shards.
+
+    The cross-process variant of ici_plane's ``_pair_global``: here only
+    this side's row is addressable, and
+    ``addressable_devices_indices_map`` lists exactly those devices —
+    metadata assembly, no transfer, no host.
+    """
+    dmap = {
+        s.device: s.data.reshape((1,) + s.data.shape)
+        for s in leaf_local.addressable_shards
+    }
+    arrs = [dmap[d] for d in gsharding.addressable_devices_indices_map(gshape)]
+    return jax.make_array_from_single_device_arrays(gshape, gsharding, arrs)
+
+
+def _dst_view_local(out_leaf, dst_sharding: NamedSharding, shape: tuple):
+    """The receiver's row of an exchanged pair-global re-wrapped under its
+    own sharding (this process only addresses its own row, so no device
+    filter is needed)."""
+    omap = {
+        s.device: s.data.reshape(s.data.shape[1:])
+        for s in out_leaf.addressable_shards
+    }
+    arrs = [omap[d] for d in dst_sharding.addressable_devices_indices_map(shape)]
+    return jax.make_array_from_single_device_arrays(shape, dst_sharding, arrs)
+
+
+def dcn_transfer(
+    local_tree: Pytree,
+    src_mesh: Mesh,
+    dst_mesh: Mesh,
+    specs: tuple,
+    role: str,
+    backend: str = "ppermute",
+) -> Optional[Pytree]:
+    """Run one side of a cross-process pair exchange.
+
+    ``local_tree`` is this process's contribution: the payload (sender) or
+    structurally-identical zero filler already resident on the destination
+    slice (receiver). ``src_mesh``/``dst_mesh`` are the two slices' meshes
+    — one local, one rebuilt from wire ids by :func:`mesh_from_ids` — and
+    MUST be identical on both processes (same device order), as must
+    ``specs`` (one per leaf, sorted-key order fixed by the offer). Both
+    processes co-dispatch the same cached exchange program; the call
+    blocks until the collective completes (the caller holds the process's
+    dispatch-order lock across it). Returns the received tree placed
+    under ``dst_mesh`` shardings for ``role="recv"``, ``None`` for
+    ``role="send"``.
+    """
+    leaves = jax.tree.leaves(local_tree)
+    treedef = jax.tree.structure(local_tree)
+    pair_devices = np.stack([src_mesh.devices, dst_mesh.devices])
+    pair_mesh = Mesh(pair_devices, (PAIR_AXIS, *src_mesh.axis_names))
+    gspecs = tuple(P(PAIR_AXIS, *spec) for spec in specs)
+    pair_globals = tuple(
+        _pair_global_local(
+            leaf, NamedSharding(pair_mesh, gs), (2,) + tuple(leaf.shape)
+        )
+        for leaf, gs in zip(leaves, gspecs)
+    )
+    prog = _exchange_program(pair_mesh, gspecs, backend)
+    outs = prog(*pair_globals)
+    # dispatch-order safety: the next collective on this process must not
+    # start until this one has completed on the wire (readiness only —
+    # no values cross to the host)
+    jax.block_until_ready(outs)
+    if role == "send":
+        return None
+    new_leaves = [
+        _dst_view_local(o, NamedSharding(dst_mesh, spec), tuple(x.shape))
+        for o, spec, x in zip(outs, specs, leaves)
+    ]
+    return jax.tree.unflatten(treedef, new_leaves)

@@ -24,6 +24,27 @@ from p2pfl_tpu.management.logger import logger
 _initialized = False
 
 
+def _enable_cpu_collectives() -> None:
+    """Opt the CPU backend into cross-process collectives (gloo).
+
+    jaxlib's CPU client defaults its collectives implementation to
+    ``"none"`` — any cross-process computation then dies with
+    "Multiprocess computations aren't implemented on the CPU backend".
+    Flipping the config to gloo BEFORE the backend is created makes the
+    same shard_map/ppermute programs the TPU DCN path runs work across
+    real CPU processes (the tier the multi-process tests and the DCN
+    weights plane exercise in CI). The env-var spelling
+    (``JAX_CPU_COLLECTIVES_IMPLEMENTATION``) is NOT honored by this
+    jaxlib — only the config update is, which is why this lives in code.
+    Harmless on TPU (it only configures the auxiliary CPU client), and a
+    jaxlib built without gloo simply keeps its default.
+    """
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception as exc:  # noqa: BLE001 — absent option/implementation
+        logger.debug("distributed", f"cpu collectives stay default: {exc!r}")
+
+
 def init_multihost(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -42,6 +63,7 @@ def init_multihost(
     process_id = process_id if process_id is not None else _env_int("JAX_PROCESS_ID")
 
     if not _initialized and (coordinator_address or _on_tpu_pod()):
+        _enable_cpu_collectives()
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
@@ -70,3 +92,39 @@ def _on_tpu_pod() -> bool:
     return bool(os.environ.get("TPU_WORKER_HOSTNAMES")) and bool(
         os.environ.get("TPU_WORKER_ID")
     )
+
+
+# ---- world introspection (the DCN weights plane's eligibility seam) ----
+
+
+def kv_client():
+    """The distributed runtime's key-value store client, or ``None``.
+
+    The coordinator-backed KV store (``DistributedRuntimeClient``) is how
+    same-world processes publish/discover each other without any extra
+    service: ``key_value_set`` / ``key_value_dir_get`` / ``key_value_delete``
+    are the surface the DCN world directory (``communication/dcn.py``)
+    uses. ``None`` outside a ``jax.distributed`` world.
+    """
+    try:
+        from jax._src.distributed import global_state
+
+        return getattr(global_state, "client", None)
+    except Exception:  # noqa: BLE001 — private seam moved; treat as no world
+        return None
+
+
+def world_active() -> bool:
+    """True inside a formed multi-process ``jax.distributed`` world.
+
+    Checks the runtime client rather than this module's ``_initialized``
+    flag so a world formed by a direct ``jax.distributed.initialize`` call
+    (not through :func:`init_multihost`) still counts. A single-process
+    "world" returns False — there is no cross-process edge to serve.
+    """
+    if kv_client() is None:
+        return False
+    try:
+        return jax.process_count() > 1
+    except Exception:  # noqa: BLE001 — backend mid-teardown
+        return False

@@ -220,7 +220,13 @@ class Settings:
     # byte transport; per-peer ineligibility (unregistered peer,
     # different process, mismatched slice topology) falls back loudly to
     # the byte path for that peer only (``ici_fallback_bytes`` metric),
-    # never aborts the round.
+    # never aborts the round. "dcn" is the superset plane: co-resident
+    # peers still ride ICI, and peers in a DIFFERENT process of the same
+    # ``jax.distributed`` world move model payloads as device arrays over
+    # XLA's cross-host collectives (communication/dcn.py +
+    # parallel/dcn_plane.py) — never pickled numpy over gRPC — with the
+    # same per-edge loud byte fallback (``dcn_fallback_bytes``) for
+    # everything else. Per-edge ladder under "dcn": ICI → DCN → bytes.
     WEIGHTS_PLANE: str = "bytes"
     # Shard-transfer backend for the ICI plane: "pallas" is the TPU
     # remote-DMA kernel (parallel/ici_plane.py — each device RDMAs its
@@ -231,6 +237,23 @@ class Settings:
     # ppermute elsewhere. Both move the same shards — backend choice can
     # never change what the receiver decodes.
     ICI_BACKEND: str = "auto"
+    # --- DCN weights-plane rendezvous (communication/dcn.py) ---
+    # World-directory snapshot TTL: peer-address → process-placement
+    # lookups read the distributed runtime's KV store at most once per
+    # this many seconds and serve from the snapshot in between.
+    DCN_DIR_TTL_S: float = 2.0
+    # How long a sender waits for the receiver's accept/nack before
+    # aborting the rendezvous and falling back to the byte path.
+    DCN_ACCEPT_TIMEOUT_S: float = 5.0
+    # How long either side waits for the peer's ready (and for this
+    # process's dispatch-order lock) before aborting — the bound that
+    # turns any rendezvous disorder into a loud fallback, never a hang.
+    DCN_READY_TIMEOUT_S: float = 10.0
+    # How long a sender waits for the receiver's decode+delivery verdict
+    # AFTER the collective fired. Expiry FAILS the send (gossip retry
+    # machinery takes over) instead of falling back — the payload may
+    # already have landed, and a byte resend could double-deliver.
+    DCN_DONE_TIMEOUT_S: float = 60.0
 
     # --- async bounded-staleness federation (p2pfl_tpu/federation/) ---
     # Which control plane drives the learning thread: "sync" is the round
@@ -583,6 +606,12 @@ def set_test_settings() -> None:
     Settings.TELEMETRY_BEAT_SPANS = False
     Settings.WEIGHTS_PLANE = "bytes"
     Settings.ICI_BACKEND = "auto"
+    # tight DCN rendezvous bounds: a multi-process test that degrades to
+    # the byte path should do so in seconds, not minutes
+    Settings.DCN_DIR_TTL_S = 0.5
+    Settings.DCN_ACCEPT_TIMEOUT_S = 2.0
+    Settings.DCN_READY_TIMEOUT_S = 4.0
+    Settings.DCN_DONE_TIMEOUT_S = 20.0
     Settings.FEDERATION_MODE = "sync"
     Settings.ASYNC_ROBUST_AGG = "fedavg"
     Settings.ASYNC_TRIM = 1

@@ -940,6 +940,13 @@ class GossipModelStage(Stage):
 
         def resolve_seeds():
             """(seeds or None, owners still unresolved)."""
+            # shares that arrived for THIS round while the node was still in
+            # the previous one were stashed un-judged (the holder list
+            # hadn't latched); the train set is live now, so re-validate and
+            # promote them before reading the reveal table
+            from p2pfl_tpu.commands.control import promote_early_reveals
+
+            promote_early_reveals(state)
             seeds: dict[str, int] = {}
             unresolved: list[str] = []
             for i in contributors:

@@ -97,10 +97,11 @@ def probe(tree):
     leaves = jax.tree.leaves(tree)
     fp = sum(jnp.sum(jnp.abs(x.astype(jnp.float32))) for x in leaves)
     # diffusion check: both node slots hold the identical aggregate
-    slot_diff = max(
+    # (jnp.max over a stacked vector — Python max() can't compare tracers)
+    slot_diff = jnp.max(jnp.stack([
         jnp.max(jnp.abs(x[0].astype(jnp.float32) - x[1].astype(jnp.float32)))
         for x in leaves
-    )
+    ]))
     return fp, slot_diff
 
 fp, slot_diff = probe(fed.params)
@@ -110,7 +111,8 @@ assert np.isfinite(loss), loss
 
 # equal models on BOTH processes: every process sees the same replicated
 # fingerprint, and the allgathered per-process readings agree exactly
-got = process_allgather(jnp.float32(fp))
+# (host float first — allgather of an already-global array is identity)
+got = process_allgather(jnp.float32(float(fp)))
 assert got.shape == (2,) and float(got[0]) == float(got[1]), got
 print(f"OK round process {pid}: loss {loss:.4f} fingerprint {float(fp):.6f}")
 """
@@ -238,9 +240,12 @@ def _run_two_process_workers(tmp_path, worker_src, ok_marker, timeout=240):
     if all("BACKEND-NO-MULTIPROC" in out for out in outs):
         # the runtime FORMED (both workers initialized, saw 2 procs and the
         # global device view — asserted in-worker) but this jaxlib's CPU
-        # backend cannot run cross-process computations; the collective
-        # halves of these witnesses need a capable backend (TPU pod, or a
-        # CPU jaxlib with multiprocess collectives)
+        # backend cannot run cross-process computations. Since
+        # init_multihost switched the CPU world onto gloo collectives
+        # (parallel/distributed.py _enable_cpu_collectives — the DCN
+        # plane's CI substrate, test_dcn_plane.py), this branch is
+        # vestigial on the shipped toolchain: it only fires on jaxlib
+        # builds without a gloo/mpi CPU collectives implementation
         pytest.skip("jaxlib CPU backend lacks multiprocess computations")
     for pid, out in enumerate(outs):
         assert f"{ok_marker} {pid}" in out, out[-2000:]

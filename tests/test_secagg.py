@@ -993,3 +993,75 @@ def test_share_for_next_round_accepted_before_train_set_latches():
     cmd.execute("owner", 2, "exp", "a", "1", "00", "me", "4", ct, "z", "3", "00")
     assert (2, "owner") not in st.secagg_shares_held
 
+
+def test_reveal_index_uncapped_for_large_federations():
+    """ISSUE 18 satellite: the reveal x-range gate is the exact
+    assigned-index rule, not a fixed ``max(2·|train_set|, 1024)`` cap — a
+    >1024-member federation's high share indices must be stored."""
+    from p2pfl_tpu.commands.control import SecAggRevealCommand
+    from p2pfl_tpu.node_state import NodeState
+
+    st = NodeState("me")
+    st.round = 1
+    st.experiment_name = "exp"
+    members = sorted(f"n{i:04d}" for i in range(1500))
+    st.train_set = list(members)
+    owner = members[0]
+    holders = sorted(m for m in members if m != owner)
+    source = holders[1300]
+    cmd = SecAggRevealCommand(st)
+    cmd.execute(source, 1, "exp", owner, "1301", "ff")
+    assert st.secagg_share_reveals.get((1, owner, source)) == (1301, 0xFF)
+    # a wrong index is still rejected — the exact check is the real gate
+    wrong = holders[10]
+    cmd.execute(wrong, 1, "exp", owner, "99", "ff")
+    assert (1, owner, wrong) not in st.secagg_share_reveals
+
+
+def test_early_reveal_stashed_then_promoted_once_set_latches():
+    """ISSUE 18 satellite: a share reveal for round r+1 arriving while this
+    node is still in round r cannot be judged (the r+1 holder list hasn't
+    latched) — it must be stashed and re-validated at consume time, not
+    dropped against the stale round-r membership."""
+    from p2pfl_tpu.commands.control import SecAggRevealCommand, promote_early_reveals
+    from p2pfl_tpu.node_state import NodeState
+
+    st = NodeState("me")
+    st.round = 1
+    st.experiment_name = "exp"
+    st.train_set = ["me", "x"]  # round-1 set: next round's members absent
+    cmd = SecAggRevealCommand(st)
+    # legitimate round-2 share from a not-yet-member: stashed, not judged
+    cmd.execute("b", 2, "exp", "a", "1", "aa")
+    assert (2, "a", "b") not in st.secagg_share_reveals
+    assert st.secagg_early_reveals.get((2, "a", "b")) == (1, 0xAA)
+    # a forged future index is stashed too — it can only be judged later
+    cmd.execute("c", 2, "exp", "a", "7", "bb")
+    # round 2 latches: holders for owner "a" are [b, c, me] → b's index is 1
+    st.round = 2
+    st.train_set = ["a", "b", "c", "me"]
+    promote_early_reveals(st)
+    assert st.secagg_share_reveals.get((2, "a", "b")) == (1, 0xAA)
+    # the index-7 stash fails the exact assigned-index check at promote time
+    assert (2, "a", "c") not in st.secagg_share_reveals
+    assert not st.secagg_early_reveals  # consumed: promoted or dropped
+
+
+def test_stale_early_reveals_pruned():
+    """Early stashes whose round has already passed are pruned, never
+    promoted — the stash cannot grow without bound across rounds."""
+    from p2pfl_tpu.commands.control import SecAggRevealCommand, promote_early_reveals
+    from p2pfl_tpu.node_state import NodeState
+
+    st = NodeState("me")
+    st.round = 1
+    st.experiment_name = "exp"
+    st.train_set = ["me", "x"]
+    cmd = SecAggRevealCommand(st)
+    cmd.execute("b", 2, "exp", "a", "1", "aa")
+    assert st.secagg_early_reveals
+    st.round = 3
+    st.train_set = ["a", "b", "me"]
+    promote_early_reveals(st)
+    assert not st.secagg_early_reveals
+    assert (2, "a", "b") not in st.secagg_share_reveals
