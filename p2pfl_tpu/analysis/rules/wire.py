@@ -235,18 +235,22 @@ class WireHeaderCompatRule(Rule):
             if not calls:
                 continue  # no byte-path re-wrap in this transport: pass-by-
                 # reference carries every attribute automatically
-            if not any(kw.arg == kwarg for call in calls for kw in call.keywords):
-                out.append(
-                    self._finding(
-                        mod,
-                        calls[0],
-                        f"memory byte path rebuilds {ctor} without copying "
-                        f"'{kwarg}' — the optional '{h.key}' header would be "
-                        "dropped in simulation but kept on the network "
-                        "transports",
-                        ctor,
+            # EVERY re-wrap site must copy the kwarg — the unary path and the
+            # streaming pump each rebuild the update, and a key dropped from
+            # either one diverges simulations only on that path's sends
+            for call in calls:
+                if not any(kw.arg == kwarg for kw in call.keywords):
+                    out.append(
+                        self._finding(
+                            mod,
+                            call,
+                            f"memory byte path rebuilds {ctor} without copying "
+                            f"'{kwarg}' — the optional '{h.key}' header would "
+                            "be dropped in simulation but kept on the network "
+                            "transports",
+                            ctor,
+                        )
                     )
-                )
         return out
 
     def _check_proto(self, mod: SourceModule, h) -> List[Finding]:

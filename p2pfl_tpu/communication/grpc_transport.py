@@ -51,6 +51,9 @@ _SERVICE = "/p2pfl.NodeServices/"
 #: so generated stubs use /node.NodeServices/* (reference node_pb2_grpc.py:44)
 _SERVICE_REF = "/node.NodeServices/"
 _METHODS = ("handshake", "disconnect", "send_message", "send_weights")
+#: client-streaming RPCs (chunked weights transfers) — routed through
+#: ``grpc.stream_unary_rpc_method_handler`` instead of unary_unary
+_STREAM_METHODS = ("send_weights_stream",)
 
 
 # ---- envelope codec ----
@@ -97,10 +100,14 @@ def decode_message(data: bytes) -> Message:
     )
 
 
-def encode_weights(env: WeightsEnvelope) -> bytes:
+def encode_weights(env: WeightsEnvelope, payload: Optional[bytes] = None) -> bytes:
     # update.encode() is served by the encode-once payload cache while the
     # sender's model version is unchanged (learning/weights.py) — only this
-    # small envelope header is built per send
+    # small envelope header is built per send. ``payload`` overrides the
+    # update's encoded bytes: the streaming path passes b"" to build the
+    # payload-free header frame that precedes the P2TC chunks (the header
+    # must carry every optional wire key, so it is built HERE — the one
+    # function the wire-header-compat rule audits for guarded stores).
     d = {
         "src": env.source,
         "round": env.round,
@@ -128,7 +135,8 @@ def encode_weights(env: WeightsEnvelope) -> bytes:
         # for the ICI weights plane (communication/ici.py)
         d["sp"] = [list(env.update.sp[0]), env.update.sp[1], env.update.sp[2]]
     header = json.dumps(d).encode()
-    return b"".join((len(header).to_bytes(4, "little"), header, env.update.encode()))
+    body = env.update.encode() if payload is None else payload
+    return b"".join((len(header).to_bytes(4, "little"), header, body))
 
 
 def _sp_header(d: dict):
@@ -164,6 +172,25 @@ def _reply_ok(data: bytes) -> bool:
         return bool(json.loads(data.decode()).get("ok"))
     except Exception:  # noqa: BLE001
         return False
+
+
+def _reply_error(data: bytes) -> str:
+    try:
+        return str(json.loads(data.decode()).get("error") or "")
+    except Exception:  # noqa: BLE001
+        return ""
+
+
+def _channel_options() -> list:
+    """Message-size options for every channel AND the server: gRPC's 4 MB
+    default silently caps unary weights payloads (RESOURCE_EXHAUSTED) far
+    below real model sizes — raise both directions to
+    ``Settings.GRPC_MAX_MESSAGE_MB``."""
+    max_len = int(Settings.GRPC_MAX_MESSAGE_MB) * 1024 * 1024
+    return [
+        ("grpc.max_send_message_length", max_len),
+        ("grpc.max_receive_message_length", max_len),
+    ]
 
 
 # ---- wire-format dispatch (envelope default; protobuf = reference interop) ----
@@ -204,7 +231,7 @@ class GrpcNeighbors(Neighbors):
         # encode before opening the channel: a misconfigured WIRE_FORMAT
         # (protobuf runtime absent) must raise without leaking a channel
         payload = _enc_handshake(self.self_addr) if handshake else b""
-        channel = grpc.insecure_channel(addr)
+        channel = grpc.insecure_channel(addr, options=_channel_options())
         if handshake:
             try:
                 caller = channel.unary_unary(_svc() + "handshake")
@@ -252,7 +279,13 @@ class GrpcProtocol(CommunicationProtocol):
         self.wire_stats: dict[str, int] = {
             "weights_bytes": 0, "weights_msgs": 0,
             "control_bytes": 0, "control_msgs": 0,
+            # streaming byte plane: successful chunked transfers, chunks
+            # shipped, and loud stream→unary fallbacks (peer rejected)
+            "stream_sends": 0, "stream_chunks": 0, "stream_fallback_unary": 0,
         }
+        #: peers that rejected streaming — the loud fallback logs ONCE per
+        #: peer, then keeps falling back silently (PR-18 fallback taxonomy)
+        self._stream_fallback_noted: set[str] = set()
 
     # ---- server ----
 
@@ -260,7 +293,14 @@ class GrpcProtocol(CommunicationProtocol):
         return GrpcNeighbors(self._address)
 
     def _server_start(self) -> None:
-        server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+        # executor size is a knob (reference hardcodes 4, grpc_server.py:62):
+        # a high-fan-in aggregator would serialize receives behind too few
+        # handler threads, and a streamed transfer occupies one for its
+        # whole duration
+        server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=Settings.GRPC_SERVER_WORKERS),
+            options=_channel_options(),
+        )
         server.add_generic_rpc_handlers((_Handler(self),))
         bound = server.add_insecure_port(self._address)
         if bound == 0:
@@ -282,7 +322,8 @@ class GrpcProtocol(CommunicationProtocol):
         if channel is None:
             if not create_connection:
                 return False
-            adhoc = grpc.insecure_channel(nei)  # reference grpc_client.py:142-144
+            # reference grpc_client.py:142-144
+            adhoc = grpc.insecure_channel(nei, options=_channel_options())
             channel = adhoc
         try:
             kind = "weights" if isinstance(env, WeightsEnvelope) else "control"
@@ -307,6 +348,12 @@ class GrpcProtocol(CommunicationProtocol):
                 handled = try_dcn_send(self, nei, env)
                 if handled is not None:
                     return handled
+                # streaming byte plane: large payloads go as a chunked
+                # client stream (encode/wire/decode overlap, bounded
+                # memory); None ⇒ ineligible or peer rejected → unary below
+                handled = self._try_stream_send(channel, nei, env)
+                if handled is not None:
+                    return handled
                 payload = _enc_weights(env)
                 resp = channel.unary_unary(_svc() + "send_weights")(
                     payload, timeout=Settings.GRPC_TIMEOUT
@@ -325,6 +372,85 @@ class GrpcProtocol(CommunicationProtocol):
         finally:
             if adhoc is not None:
                 adhoc.close()
+
+    def _try_stream_send(self, channel, nei: str, env) -> Optional[bool]:
+        """Chunked weights send. Returns None when the transfer should fall
+        through to the unary path (small payload, protobuf interop, peer
+        rejects streaming) — a real mid-stream failure returns False: the
+        whole stream is ONE failed send at the ``_do_send`` seam, so the
+        breaker, retry scheduling and FaultPlan verdicts see it exactly
+        like a failed unary transfer."""
+        if _pbuf() or not Settings.WIRE_STREAM_ENABLED:
+            return None  # the reference's protobuf schema has no stream RPC
+        with self._lock:
+            if nei in self._stream_fallback_noted:
+                return None  # peer already said no — don't re-probe each send
+        from p2pfl_tpu.learning.weights import estimate_payload_bytes
+
+        est = estimate_payload_bytes(env.update)
+        if est is None or est < Settings.WIRE_STREAM_THRESHOLD * 1024 * 1024:
+            return None
+        try:
+            # lazy producer: the encode pipeline (or cache hit) runs here,
+            # the per-chunk framing+CRC runs as gRPC's sender thread pulls
+            # frames — overlapping with the wire and the receiver's decode
+            chunk_iter = env.update.iter_chunks()
+        except Exception as exc:  # noqa: BLE001 — encode trouble ⇒ let unary try
+            logger.error(self._address, f"stream encode failed, trying unary: {exc!r}")
+            return None
+        sent = {"chunks": 0, "bytes": 0}
+
+        def _frames():
+            # payload-free header frame first: carries every optional wire
+            # key (tc/vv/xp/sp) exactly like a unary envelope, then P2TC
+            head = encode_weights(env, payload=b"")
+            sent["bytes"] += len(head)
+            yield head
+            for c in chunk_iter:
+                sent["chunks"] += 1
+                sent["bytes"] += len(c)
+                yield c
+
+        try:
+            resp = channel.stream_unary(_svc() + "send_weights_stream")(
+                _frames(), timeout=Settings.GRPC_TIMEOUT
+            )
+        except grpc.RpcError as exc:
+            if exc.code() == grpc.StatusCode.UNIMPLEMENTED:
+                # pre-streaming peer: its generic handler has no such route
+                self._note_stream_fallback(nei, "UNIMPLEMENTED")
+                return None
+            return False  # mid-stream death/timeout — one failed send
+        if not _reply_ok(resp):
+            if _reply_error(resp) == "stream-unsupported":
+                # peer runs with WIRE_STREAM_ENABLED off — fall back loudly
+                self._note_stream_fallback(nei, "stream-unsupported")
+                return None
+            return False  # receiver aborted (CRC, decode, dispatch error)
+        with self._lock:
+            self.wire_stats["weights_bytes"] += sent["bytes"]
+            self.wire_stats["weights_msgs"] += 1
+            self.wire_stats["stream_sends"] += 1
+            self.wire_stats["stream_chunks"] += sent["chunks"]
+        logger.log_comm_metric(self._address, "stream_send")
+        logger.log_comm_metric(self._address, "stream_chunks_sent", sent["chunks"])
+        return True
+
+    def _note_stream_fallback(self, nei: str, why: str) -> None:
+        with self._lock:
+            self.wire_stats["stream_fallback_unary"] += 1
+            first = nei not in self._stream_fallback_noted
+            self._stream_fallback_noted.add(nei)
+        logger.log_comm_metric(self._address, "stream_fallback_unary")
+        if first:
+            # loud once per peer, silent after — same taxonomy as the ICI/DCN
+            # plane fallbacks: a fleet quietly degrading to unary is a
+            # misconfiguration someone should see
+            logger.info(
+                self._address,
+                f"Peer {nei} rejects streaming ({why}) — falling back to "
+                "unary send_weights for this and future transfers",
+            )
 
     # ---- server-side entry points ----
 
@@ -397,6 +523,28 @@ class GrpcProtocol(CommunicationProtocol):
         res = self.handle_weights(env)
         return self._reply_as(pbuf, res.ok, res.error or "")
 
+    def rpc_send_weights_stream(self, request_iterator, context) -> bytes:
+        """Client-streaming weights receive: header frame, then P2TC chunks.
+
+        The first message is a payload-free envelope (same codec as unary —
+        every optional wire key rides it); the rest are self-delimiting
+        chunks fed straight into the shared
+        :meth:`CommunicationProtocol.handle_weights_stream`, which decodes
+        leaves as their bytes complete. Only the native envelope format
+        streams — protobuf interop peers never dial this method."""
+        it = iter(request_iterator)
+        try:
+            first = next(it)
+        except StopIteration:
+            return _reply(False, "empty stream")
+        try:
+            env = decode_weights(first)
+        except Exception as exc:  # noqa: BLE001 — malformed header frame
+            logger.error(self._address, f"Malformed stream header frame: {exc}")
+            return _reply(False, "malformed weights payload")
+        res = self.handle_weights_stream(env, it)
+        return _reply(res.ok, res.error or "")
+
 
 class _Handler(grpc.GenericRpcHandler):
     def __init__(self, protocol: GrpcProtocol) -> None:
@@ -408,8 +556,16 @@ class _Handler(grpc.GenericRpcHandler):
             for svc in (_SERVICE, _SERVICE_REF)
             for m in _METHODS
         }
+        self._stream_routes = {
+            svc + m: getattr(protocol, f"rpc_{m}")
+            for svc in (_SERVICE, _SERVICE_REF)
+            for m in _STREAM_METHODS
+        }
 
     def service(self, call_details):
+        fn = self._stream_routes.get(call_details.method)
+        if fn is not None:
+            return grpc.stream_unary_rpc_method_handler(fn)
         fn = self._routes.get(call_details.method)
         if fn is None:
             return None

@@ -137,8 +137,20 @@ class InMemoryProtocol(CommunicationProtocol):
                     # Every optional header in wire_headers.py must ride
                     # this re-wrap (enforced by wire-header-compat) or
                     # simulations diverge from the network transports.
-                    from p2pfl_tpu.learning.weights import ModelUpdate
+                    # Large payloads take the streaming pipeline (bounded
+                    # producer/consumer queue) exactly like the gRPC plane.
+                    from p2pfl_tpu.learning.weights import (
+                        ModelUpdate,
+                        estimate_payload_bytes,
+                    )
 
+                    est = estimate_payload_bytes(env.update)
+                    if (
+                        Settings.WIRE_STREAM_ENABLED
+                        and est is not None
+                        and est >= Settings.WIRE_STREAM_THRESHOLD * 1024 * 1024
+                    ):
+                        return self._stream_to_peer(peer, env)
                     wire = ModelUpdate(
                         params=None,
                         contributors=list(env.update.contributors),
@@ -158,6 +170,72 @@ class InMemoryProtocol(CommunicationProtocol):
         except Exception:  # noqa: BLE001 — peer died mid-call
             return False
         return False
+
+    def _stream_to_peer(self, peer: "InMemoryProtocol", env: WeightsEnvelope) -> bool:
+        """Streaming byte path without sockets: a producer thread pumps the
+        chunk list through a BOUNDED queue (``Settings.WIRE_STREAM_WINDOW``
+        frames) while the receiver's incremental decoder drains it — at most
+        window × chunk payload bytes are in flight, and the receiver decodes
+        chunk i while the producer is queuing chunk i+1. Any receiver-side
+        abort surfaces as this ONE send returning False, same as gRPC."""
+        import queue
+
+        from p2pfl_tpu.learning.weights import ModelUpdate
+        from p2pfl_tpu.settings import Settings
+
+        try:
+            # lazy framing: the producer thread below pulls frames as the
+            # queue drains, so at most window × chunk bytes are framed and
+            # in flight at once (the encode/cache work happens here)
+            chunks = env.update.iter_chunks()
+        except Exception:  # noqa: BLE001 — encode trouble = failed send
+            return False
+        wire = ModelUpdate(
+            params=None,
+            contributors=list(env.update.contributors),
+            num_samples=env.update.num_samples,
+            encoded=None,
+            version=env.update.version,
+            xp=env.update.xp,
+            sp=env.update.sp,
+        )
+        wire_env = WeightsEnvelope(
+            env.source, env.round, env.cmd, wire, env.msg_id,
+            trace_ctx=env.trace_ctx, xp=env.xp,
+        )
+        q: "queue.Queue" = queue.Queue(maxsize=max(1, Settings.WIRE_STREAM_WINDOW))
+        abort = threading.Event()  # set when the receiver stops draining
+
+        def _put(item) -> bool:
+            while not abort.is_set():
+                try:
+                    q.put(item, timeout=0.05)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def _produce() -> None:
+            for c in chunks:
+                if not _put(c):
+                    return
+            _put(None)
+
+        producer = threading.Thread(target=_produce, daemon=True, name="stream-pump")
+        producer.start()
+
+        def _drain():
+            while True:
+                c = q.get()
+                if c is None:
+                    return
+                yield c
+
+        try:
+            return peer.handle_weights_stream(wire_env, _drain()).ok
+        finally:
+            abort.set()
+            producer.join(timeout=5)
 
     # ---- server-side entry points (called by peers) ----
 

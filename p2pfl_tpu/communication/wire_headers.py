@@ -16,7 +16,13 @@ flight recorder first put ``tc`` on the wire:
 - **never in the protobuf interop schema**: the reference's proto schema
   (``proto_wire.py``) predates these keys and must stay byte-compatible
   with real reference nodes — optional keys ride only the native JSON
-  envelope.
+  envelope;
+- **streamed transfers inherit for free**: the streaming byte plane's
+  first frame is a payload-free envelope built by the SAME
+  ``encode_weights`` (``grpc_transport.py`` passes ``payload=b""``), so
+  every key declared here rides a chunked ``send_weights_stream``
+  transfer without any per-key plumbing — a new optional key needs no
+  streaming-specific work.
 
 Declaring a key here is what makes the contract enforceable: the
 ``wire-header-compat`` analyzer rule (:mod:`p2pfl_tpu.analysis`)

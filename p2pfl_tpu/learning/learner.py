@@ -185,7 +185,13 @@ class NodeLearner(ABC):
             return update
         anchor = getattr(self, "_wire_anchor", None)
         tag = getattr(self, "_wire_anchor_tag", None)
-        flat = decode_params(update.encoded, anchor=anchor, anchor_tag=tag)
+        # a streamed transfer's leaves were decoded (and possibly
+        # device_put) as their chunks arrived — the unary frame never
+        # existed on this side, so prefer the eager result over re-decoding
+        if update.decoded_flat is not None:
+            flat = update.decoded_flat
+        else:
+            flat = decode_params(update.encoded, anchor=anchor, anchor_tag=tag)
         params = restore_like(self.get_parameters(), flat)
         out = ModelUpdate(params, update.contributors, update.num_samples)
         # relays re-encode fresh aggregates against the same shared anchor

@@ -148,7 +148,11 @@ class PersonalizedLearner(JaxLearner):
             return update
         anchor = getattr(self, "_wire_anchor", None)
         tag = getattr(self, "_wire_anchor_tag", None)
-        flat = decode_params(update.encoded, anchor=anchor, anchor_tag=tag)
+        # streamed transfers arrive leaf-decoded (see JaxLearner.materialize)
+        if update.decoded_flat is not None:
+            flat = update.decoded_flat
+        else:
+            flat = decode_params(update.encoded, anchor=anchor, anchor_tag=tag)
         body_template = self._body_tree(self.params)
         if set(flat) == set(_flatten_named(self.params)):
             # a FULL-model payload (e.g. the init model from a

@@ -67,6 +67,12 @@ _wire_stats = {
     "d2h_bytes": 0,
     "host_encodes": 0,
     "device_encodes": 0,
+    "stream_encodes": 0,
+    # high-water mark (max, not sum) of any StreamDecoder's buffered
+    # payload bytes — the MEASURED receiver-side bounded-memory claim:
+    # stays ~O(chunk + largest leaf) for dense streams no matter how big
+    # the model is (bench_gossip `stream` row asserts it)
+    "stream_peak_scratch_bytes": 0,
 }
 
 
@@ -103,7 +109,11 @@ class PayloadCache:
     def __init__(self, owner: str = "") -> None:
         self.owner = owner
         self._lock = threading.Lock()
-        self._entries: "dict[tuple, bytes]" = {}
+        # payloads keyed by content: unary entries hold the framed bytes,
+        # chunk-aware entries (keys prefixed "chunks") hold the tuple of
+        # stream chunk frames — encode-once/send-many fans the SAME cached
+        # chunk list out to K peers without re-framing
+        self._entries: "dict[tuple, object]" = {}
         # error-feedback fold ownership per payload content (see
         # ef_fold_once) — separate from _entries so markers can never
         # evict cached payloads
@@ -111,7 +121,7 @@ class PayloadCache:
         self.hits = 0
         self.misses = 0
 
-    def get(self, key: tuple) -> Optional[bytes]:
+    def get(self, key: tuple) -> Optional[Any]:
         from p2pfl_tpu.management.logger import logger
 
         with self._lock:
@@ -125,7 +135,15 @@ class PayloadCache:
         )
         return cached
 
-    def put(self, key: tuple, payload: bytes) -> None:
+    def peek(self, key: tuple) -> Optional[Any]:
+        """`get` without hit/miss accounting — for CROSS-flavor probes
+        (a unary encode checking for a cached chunk list and vice versa):
+        the probe must not inflate the encode_cache_miss metric that the
+        encode-once contract tests pin to exactly one per content."""
+        with self._lock:
+            return self._entries.get(key)
+
+    def put(self, key: tuple, payload: Any) -> None:
         with self._lock:
             self._entries[key] = payload
             while len(self._entries) > self.MAX_ENTRIES:
@@ -214,6 +232,16 @@ def _validate_residual(residual: Optional[dict], eligible_sizes: dict) -> None:
             del residual[key]
 
 
+def _as_u8(arr: np.ndarray) -> memoryview:
+    """Zero-copy uint8 memoryview over a contiguous array's bytes.
+
+    ``reshape(-1).view(np.uint8)`` reinterprets rather than copies, so the
+    returned view keeps ``arr``'s buffer alive — the framing/chunking
+    writers downstream make the ONE copy into the outgoing frame (the old
+    per-leaf ``.tobytes()`` made a second, payload-sized one)."""
+    return memoryview(np.ascontiguousarray(arr).reshape(-1).view(np.uint8))
+
+
 def _encode_host(
     named: dict,
     compression: Optional[str],
@@ -228,7 +256,9 @@ def _encode_host(
     in :func:`encode_params`) is the single source of which tensors are
     delta-coded and at what k. Returns ``(plans, d2h_bytes)`` exactly like
     :func:`p2pfl_tpu.ops.compression.encode_device`; the byte layout per
-    tensor is the format contract both producers implement.
+    tensor is the format contract both producers implement. Buffers are
+    zero-copy :func:`_as_u8` views — the frame/chunk writer makes the only
+    payload copy.
     """
     from p2pfl_tpu import native
 
@@ -259,24 +289,28 @@ def _encode_host(
                 residual[key] = delta - sent
             # two pieces, no concat copy: CRC chains across them and the
             # framing loop below writes them back to back
-            bufs = (idx.tobytes(), q.tobytes())
+            bufs = (_as_u8(idx), _as_u8(q))
             entry["enc"] = "tk8"
             entry["scale"] = scale
             entry["nnz"] = int(k)
         elif compression in ("int8", "topk8") and arr.dtype.kind == "f":
             q, scale = native.quantize(np.asarray(arr, dtype=np.float32))
-            bufs = (q.tobytes(),)
+            bufs = (_as_u8(q),)
             entry["enc"] = "i8"
             entry["scale"] = scale
         else:
-            bufs = (np.ascontiguousarray(arr).tobytes(),)
+            bufs = (_as_u8(arr),)
         plans.append((entry, bufs))
     return plans, d2h
 
 
-def _frame(plans: list, anchor_tag: Optional[str]) -> bytes:
-    """Assemble per-tensor plans into the framed payload (shared by both
-    producers — one frame layout, one decoder)."""
+def _frame_parts(plans: list, anchor_tag: Optional[str]) -> tuple[bytes, list]:
+    """``(prefix, buffers)`` of the framed payload: ``prefix`` is the
+    unary frame's ``magic + header-length + JSON header`` and ``buffers``
+    the per-tensor byte views in entry order. Shared by the unary framer
+    and the chunker — concatenating ``prefix`` with every buffer IS the
+    unary payload, which is what makes chunk streams byte-compatible with
+    unary frames by construction."""
     from p2pfl_tpu import native
 
     entries = []
@@ -292,18 +326,188 @@ def _frame(plans: list, anchor_tag: Optional[str]) -> bytes:
     if any(e.get("enc") == "tk8" for e in entries):
         head["anchor_tag"] = anchor_tag if anchor_tag is not None else ""
     header = json.dumps(head).encode("utf-8")
-    # single preallocated frame: sizes are all known here, so the payload is
-    # written exactly once instead of growing a bytearray per tensor
-    total = 8 + len(header) + sum(len(b) for b in buffers)
+    prefix = bytearray(8 + len(header))
+    prefix[0:4] = _MAGIC
+    struct.pack_into("<I", prefix, 4, len(header))
+    prefix[8:] = header
+    return bytes(prefix), buffers
+
+
+def _frame(plans: list, anchor_tag: Optional[str]) -> bytes:
+    """Assemble per-tensor plans into the framed payload (shared by both
+    producers — one frame layout, one decoder)."""
+    prefix, buffers = _frame_parts(plans, anchor_tag)
+    # single preallocated frame: sizes are all known here and the plans
+    # hold zero-copy views, so the payload bytes are written exactly once
+    total = len(prefix) + sum(len(b) for b in buffers)
     out = bytearray(total)
-    out[0:4] = _MAGIC
-    struct.pack_into("<I", out, 4, len(header))
-    off = 8
-    out[off : off + len(header)] = header
-    off += len(header)
+    out[0 : len(prefix)] = prefix
+    off = len(prefix)
     for b in buffers:
         out[off : off + len(b)] = b
         off += len(b)
+    return bytes(out)
+
+
+# ---- chunk stream framing (the streaming byte plane) ----
+
+_CHUNK_MAGIC = b"P2TC"  # p2pfl-tpu chunk
+#: chunk types: the header chunk carries the unary frame's prefix (magic +
+#: header length + JSON header), data chunks carry consecutive payload
+#: slabs, the end chunk closes the stream with the expected chunk count
+CHUNK_HEADER, CHUNK_DATA, CHUNK_END = 0, 1, 2
+_CHUNK_OVERHEAD = 17  # magic(4) + type(1) + seq(4) + body length(4) + crc32c(4)
+_MIN_CHUNK_BYTES = 64 * 1024
+
+
+def _chunk_bytes_setting() -> int:
+    from p2pfl_tpu.settings import Settings
+
+    return max(int(Settings.WIRE_CHUNK_MB * 1024 * 1024), _MIN_CHUNK_BYTES)
+
+
+def _chunk(ctype: int, seq: int, body) -> bytes:
+    from p2pfl_tpu import native
+
+    n = len(body)
+    out = bytearray(_CHUNK_OVERHEAD + n)
+    out[0:4] = _CHUNK_MAGIC
+    out[4] = ctype
+    struct.pack_into("<III", out, 5, seq, n, native.crc32c(body, 0))
+    out[_CHUNK_OVERHEAD:] = body
+    return bytes(out)
+
+
+def parse_stream_chunk(frame) -> tuple[int, int, memoryview, int]:
+    """``(type, seq, body, body_crc)`` of one self-delimiting stream chunk.
+
+    Every chunk is independently verifiable: magic, framed body length and
+    a per-chunk CRC32C — a corrupted chunk is rejected the moment it
+    arrives instead of poisoning a whole reassembled payload. Raises
+    :class:`DecodingParamsError` on any violation. The verified body CRC
+    is returned so the decoder can fold it into the running whole-payload
+    CRC via :func:`native.crc32c_combine` without a second byte pass.
+    """
+    from p2pfl_tpu import native
+
+    mv = memoryview(frame)
+    if len(mv) < _CHUNK_OVERHEAD or bytes(mv[:4]) != _CHUNK_MAGIC:
+        raise DecodingParamsError("bad chunk magic — not a p2pfl_tpu stream chunk")
+    ctype = mv[4]
+    seq, n, crc = struct.unpack_from("<III", mv, 5)
+    body = mv[_CHUNK_OVERHEAD:]
+    if len(body) != n:
+        raise DecodingParamsError(f"chunk {seq}: body {len(body)} bytes != framed {n}")
+    if native.crc32c(body, 0) != crc:
+        raise DecodingParamsError(f"chunk {seq}: CRC mismatch — corrupted in flight")
+    if ctype not in (CHUNK_HEADER, CHUNK_DATA, CHUNK_END):
+        raise DecodingParamsError(f"chunk {seq}: unknown chunk type {ctype}")
+    return ctype, seq, body, crc
+
+
+def _gen_chunks(prefix: bytes, buffers, chunk_bytes: int):
+    """Yield ``(prefix, buffers)`` framed as stream chunks, one at a time.
+
+    Invariant (tested): the header + data chunk bodies concatenate to
+    exactly ``prefix + b"".join(buffers)`` — the unary payload. Cuts are
+    leaf-aligned whenever the next buffer fits in a fresh slab (the
+    receiver then completes whole leaves per chunk); buffers larger than a
+    slab are split across chunks.
+
+    A generator so the transport can pull frames as the wire drains them:
+    the per-chunk copy + CRC pass overlaps with the send of earlier
+    chunks and the receiver's incremental decode, instead of running as a
+    serial prefix before the first byte moves.
+    """
+    yield _chunk(CHUNK_HEADER, 0, prefix)
+    seq = 1
+    pending: list = []
+    pending_n = 0
+
+    def _flush() -> bytes:
+        nonlocal pending, pending_n, seq
+        # write the pieces straight into the framed chunk (one copy per
+        # byte — no intermediate body buffer) and CRC the assembled slab
+        from p2pfl_tpu import native
+
+        frame = bytearray(_CHUNK_OVERHEAD + pending_n)
+        frame[0:4] = _CHUNK_MAGIC
+        frame[4] = CHUNK_DATA
+        off = _CHUNK_OVERHEAD
+        for piece in pending:
+            frame[off : off + len(piece)] = piece
+            off += len(piece)
+        crc = native.crc32c(memoryview(frame)[_CHUNK_OVERHEAD:], 0)
+        struct.pack_into("<III", frame, 5, seq, pending_n, crc)
+        seq += 1
+        pending, pending_n = [], 0
+        return bytes(frame)
+
+    for b in buffers:
+        mv = b if isinstance(b, memoryview) else memoryview(b)
+        # leaf-aligned cut: close the open slab rather than straddle a
+        # leaf boundary when the whole leaf fits in the next slab
+        if pending_n and pending_n + len(mv) > chunk_bytes and len(mv) <= chunk_bytes:
+            yield _flush()
+        while len(mv) > 0:
+            take = min(len(mv), chunk_bytes - pending_n)
+            pending.append(mv[:take])
+            pending_n += take
+            mv = mv[take:]
+            if pending_n >= chunk_bytes:
+                yield _flush()
+    if pending_n:
+        yield _flush()
+    yield _chunk(CHUNK_END, seq, json.dumps({"n": seq}).encode("utf-8"))
+
+
+def _assemble_chunks(prefix: bytes, buffers: list, chunk_bytes: int) -> list[bytes]:
+    return list(_gen_chunks(prefix, buffers, chunk_bytes))
+
+
+def iter_chunked_payload(payload: bytes, chunk_bytes: Optional[int] = None):
+    """Lazily cut an already-framed unary payload into stream chunks.
+
+    The cache fan-out path: when the encode-once cache already holds the
+    unary bytes, streaming to K peers re-frames those bytes (leaf-aligned
+    via the header's entry sizes) instead of re-running the encode
+    pipeline. Frame validation happens eagerly (before the first yield)
+    so a malformed payload raises at call time, not mid-stream."""
+    if chunk_bytes is None:
+        chunk_bytes = _chunk_bytes_setting()
+    mv = memoryview(payload)
+    if bytes(mv[:4]) != _MAGIC:
+        raise DecodingParamsError("bad magic — not a p2pfl_tpu weights payload")
+    (hlen,) = struct.unpack("<I", mv[4:8])
+    header = json.loads(bytes(mv[8 : 8 + hlen]).decode("utf-8"))
+    prefix = bytes(mv[: 8 + hlen])
+    buffers = []
+    off = 8 + hlen
+    for e in header["t"]:
+        n = int(e["n"])
+        if off + n > len(payload):
+            raise DecodingParamsError(f"truncated payload at {e['k']}")
+        buffers.append(mv[off : off + n])
+        off += n
+    if off != len(payload):
+        raise DecodingParamsError("payload longer than its header declares")
+    return _gen_chunks(prefix, buffers, chunk_bytes)
+
+
+def chunk_encoded_payload(payload: bytes, chunk_bytes: Optional[int] = None) -> list[bytes]:
+    """Materialized :func:`iter_chunked_payload` (the cache stores lists)."""
+    return list(iter_chunked_payload(payload, chunk_bytes))
+
+
+def payload_from_chunks(chunks) -> bytes:
+    """Rebuild the unary frame from a P2TC chunk list (the inverse of
+    :func:`chunk_encoded_payload` — header + data bodies concatenate to
+    exactly the unary payload)."""
+    out = bytearray()
+    for frame in chunks:
+        ctype, _, body, _ = parse_stream_chunk(frame)
+        if ctype != CHUNK_END:
+            out += body
     return bytes(out)
 
 
@@ -353,6 +557,51 @@ def encode_params(
     ``logger.get_comm_metrics``; process-wide totals are always kept
     (:func:`wire_stats`).
     """
+    plans, named, d2h, producer = _encode_plans(tree, compression, anchor, residual)
+    payload = _frame(plans, anchor_tag)
+    _account_encode(named, len(payload), d2h, producer, owner)
+    return payload
+
+
+def encode_params_chunked(
+    tree: Pytree,
+    compression: Optional[str] = None,
+    anchor: Optional[Pytree] = None,
+    anchor_tag: Optional[str] = None,
+    residual: Optional[dict] = None,
+    owner: Optional[str] = None,
+    chunk_bytes: Optional[int] = None,
+) -> list[bytes]:
+    """:func:`encode_params`, emitted as a list of stream chunk frames.
+
+    Same pipeline, same producers, same accounting — but the unary frame
+    is never materialized: the per-tensor buffer views are cut straight
+    into ``~Settings.WIRE_CHUNK_MB`` slabs (leaf-aligned where possible,
+    per-chunk CRC32C, header chunk first, end chunk last), so the sender
+    holds one copy of the payload as chunks instead of chunks + frame.
+    The chunk bodies concatenate to exactly the unary payload —
+    :class:`StreamDecoder` and :func:`decode_params` share one decoder
+    core over identical bytes.
+    """
+    if chunk_bytes is None:
+        chunk_bytes = _chunk_bytes_setting()
+    plans, named, d2h, producer = _encode_plans(tree, compression, anchor, residual)
+    prefix, buffers = _frame_parts(plans, anchor_tag)
+    chunks = _assemble_chunks(prefix, buffers, chunk_bytes)
+    payload_len = len(prefix) + sum(len(b) for b in buffers)
+    _account_encode(named, payload_len, d2h, producer, owner, streamed=True)
+    return chunks
+
+
+def _encode_plans(
+    tree: Pytree,
+    compression: Optional[str],
+    anchor: Optional[Pytree],
+    residual: Optional[dict],
+) -> tuple[list, dict, int, str]:
+    """The shared encode pipeline behind both the unary and the chunked
+    entry points: producer selection + per-tensor plans. Returns
+    ``(plans, named, d2h_bytes, producer)``."""
     from p2pfl_tpu.settings import Settings
 
     global _encode_calls
@@ -399,22 +648,71 @@ def encode_params(
     else:
         plans, d2h = _encode_host(named, compression, anchor_named, topk_plan, residual)
         producer = "host"
-    payload = _frame(plans, anchor_tag)
+    return plans, named, d2h, producer
+
+
+def _account_encode(
+    named: dict,
+    payload_len: int,
+    d2h: int,
+    producer: str,
+    owner: Optional[str],
+    streamed: bool = False,
+) -> None:
+    from p2pfl_tpu.ops.compression import leaf_size as _size
 
     raw_bytes = sum(_size(leaf) * np.dtype(leaf.dtype).itemsize for leaf in named.values())
     with _encode_lock:
         _wire_stats["raw_bytes"] += raw_bytes
-        _wire_stats["payload_bytes"] += len(payload)
+        _wire_stats["payload_bytes"] += payload_len
         _wire_stats["d2h_bytes"] += d2h
         _wire_stats[f"{producer}_encodes"] += 1
+        if streamed:
+            _wire_stats["stream_encodes"] += 1
     if owner:
         from p2pfl_tpu.management.logger import logger
 
         logger.log_comm_metric(owner, "wire_raw_bytes", raw_bytes)
-        logger.log_comm_metric(owner, "wire_payload_bytes", len(payload))
+        logger.log_comm_metric(owner, "wire_payload_bytes", payload_len)
         logger.log_comm_metric(owner, "wire_d2h_bytes", d2h)
         logger.log_comm_metric(owner, f"wire_encode_{producer}")
-    return payload
+
+
+def _leaf_meta(e: dict) -> tuple[np.dtype, int]:
+    """Validate one header entry and return ``(dtype, element_count)``.
+
+    Shared by the unary decoder and the streaming decoder so both enforce
+    the same header/byte-length consistency rules (one decoder core).
+    """
+    dtype = _resolve_dtype(e["dtype"])
+    count = int(np.prod(e["shape"], dtype=np.int64)) if e["shape"] else 1
+    if e.get("enc") == "tk8":
+        expect = int(e["nnz"]) * 5  # uint32 index + int8 value per coordinate
+    elif e.get("enc") == "i8":
+        expect = count
+    else:
+        expect = count * dtype.itemsize
+    if e["n"] != expect:
+        raise DecodingParamsError(
+            f"inconsistent header for {e['k']}: n={e['n']} vs shape {e['shape']}"
+        )
+    return dtype, count
+
+
+def _decode_dense_leaf(e: dict, buf) -> np.ndarray:
+    """Decode one dense (raw or int8) leaf from its exact byte slice.
+
+    ``buf`` must be exactly ``e['n']`` bytes (a memoryview slice of the
+    unary frame, or a completed per-leaf buffer in the streaming decoder).
+    tk8 leaves never come through here — they need the anchor.
+    """
+    from p2pfl_tpu import native
+
+    dtype, count = _leaf_meta(e)
+    if e.get("enc") == "i8":
+        q = np.frombuffer(buf, dtype=np.int8, count=count)
+        return native.dequantize(q, float(e["scale"])).astype(dtype).reshape(e["shape"])
+    return np.frombuffer(buf, dtype=dtype, count=count).reshape(e["shape"])
 
 
 def decode_params(
@@ -476,17 +774,7 @@ def decode_params(
         off = 8 + hlen
         crc = 0
         for e in header["t"]:
-            dtype = _resolve_dtype(e["dtype"])
-            count = int(np.prod(e["shape"], dtype=np.int64)) if e["shape"] else 1
-            if e.get("enc") == "tk8":
-                nnz = int(e["nnz"])
-                expect = nnz * 5  # uint32 index + int8 value per coordinate
-            elif e.get("enc") == "i8":
-                expect = count
-            else:
-                expect = count * dtype.itemsize
-            if e["n"] != expect:
-                raise DecodingParamsError(f"inconsistent header for {e['k']}: n={e['n']} vs shape {e['shape']}")
+            dtype, count = _leaf_meta(e)
             if off + e["n"] > len(payload):
                 raise DecodingParamsError(f"truncated payload at {e['k']}")
             crc = native.crc32c(mv[off : off + e["n"]], crc)
@@ -529,13 +817,10 @@ def decode_params(
                     continue
                 dense = np.asarray(anchor_leaf, np.float32).ravel().copy()
                 dense[idx] = dense[idx] + native.dequantize(q, float(e["scale"]))
-                arr = dense.astype(dtype)
-            elif e.get("enc") == "i8":
-                q = np.frombuffer(payload, dtype=np.int8, count=count, offset=off)
-                arr = native.dequantize(q, float(e["scale"])).astype(dtype)
+                arr = dense.astype(dtype).reshape(e["shape"])
             else:
-                arr = np.frombuffer(payload, dtype=dtype, count=count, offset=off)
-            flat[e["k"]] = arr.reshape(e["shape"])
+                arr = _decode_dense_leaf(e, mv[off : off + e["n"]])
+            flat[e["k"]] = arr
             off += e["n"]
         if "crc" in header and header["crc"] != crc:
             raise DecodingParamsError(f"CRC mismatch: payload corrupted ({crc} != {header['crc']})")
@@ -548,6 +833,228 @@ def decode_params(
         raise
     except Exception as exc:  # noqa: BLE001 — any malformed payload is a decode error
         raise DecodingParamsError(str(exc)) from exc
+
+
+class StreamDecoder:
+    """Incremental decoder for a ``P2TC`` chunk stream (one model transfer).
+
+    Feed frames in order via :meth:`feed`. Dense (raw / ``i8``) leaves are
+    decoded the moment their bytes complete — optionally ``device_put`` —
+    so receiver-side peak payload memory is O(chunk + largest leaf in
+    flight) instead of O(model). Delta-coded (``tk8``) streams need the
+    receiver's round anchor, which the transport layer doesn't have, so
+    the decoder switches to REASSEMBLE mode (header carries
+    ``anchor_tag``): it accumulates the byte-identical unary frame and
+    hands it to the normal :func:`decode_params` path at materialize
+    time. tk8 payloads are ~0.25 byte/param, so reassembly stays small.
+
+    Every chunk's own CRC32C is checked by :func:`parse_stream_chunk`;
+    the header's whole-payload CRC is reconstructed by FOLDING those
+    already-verified per-chunk CRCs with :func:`native.crc32c_combine`
+    (CRC32C composes over arbitrary split points — O(1) matrix math per
+    chunk, so the payload bytes are hashed exactly once) and verified at
+    the end chunk together with the declared chunk count and the per-leaf
+    byte totals. Any violation raises :class:`DecodingParamsError` — the
+    caller drops the stream as ONE failed transfer.
+    """
+
+    def __init__(self, device_put: bool = False):
+        self._device_put = device_put
+        self._expect_seq = 0
+        self.header: Optional[dict] = None
+        self._entries: list = []
+        self._entry_idx = 0
+        self._leaf_buf: Optional[bytearray] = None
+        self._leaf_fill = 0
+        self._crc = 0
+        self._flat: dict = {}
+        self._reassemble: Optional[bytearray] = None
+        self._done = False
+        self.chunks = 0
+        self.payload_bytes = 0
+        #: high-water mark of bytes this decoder held buffered at once
+        #: (in-flight chunk frame + open leaf buffer / reassembly buffer) —
+        #: the measured half of the bounded-memory contract: for dense
+        #: streams it never scales with the model, only with
+        #: chunk size + the largest single leaf
+        self.peak_scratch_bytes = 0
+
+    @property
+    def complete(self) -> bool:
+        return self._done
+
+    @property
+    def reassembled(self) -> bool:
+        return self._reassemble is not None
+
+    def feed(self, frame) -> None:
+        ctype, seq, body, crc = parse_stream_chunk(frame)
+        if self._done:
+            raise DecodingParamsError("chunk after end-of-stream")
+        if seq != self._expect_seq:
+            raise DecodingParamsError(
+                f"out-of-order chunk: seq {seq}, expected {self._expect_seq}"
+            )
+        self._expect_seq += 1
+        self.chunks += 1
+        if ctype == CHUNK_HEADER:
+            self._start(body)
+        elif ctype == CHUNK_DATA:
+            self._data(body, crc)
+        else:  # parse_stream_chunk admits only the three known types
+            self._finish(body)
+        scratch = len(frame) + (
+            len(self._reassemble)
+            if self._reassemble is not None
+            else (len(self._leaf_buf) if self._leaf_buf is not None else 0)
+        )
+        if scratch > self.peak_scratch_bytes:
+            self.peak_scratch_bytes = scratch
+
+    def _start(self, body) -> None:
+        if self.header is not None:
+            raise DecodingParamsError("duplicate stream header chunk")
+        if bytes(body[:4]) != _MAGIC:
+            raise DecodingParamsError("bad magic in stream header chunk")
+        (hlen,) = struct.unpack("<I", body[4:8])
+        if len(body) != 8 + hlen:
+            raise DecodingParamsError("stream header chunk length mismatch")
+        header = json.loads(bytes(body[8:]).decode("utf-8"))
+        if header["v"] != _VERSION:
+            raise DecodingParamsError(f"unsupported weights version {header['v']}")
+        self.header = header
+        self._entries = header["t"]
+        for e in self._entries:
+            _leaf_meta(e)  # validate every entry before any bytes land
+        if "anchor_tag" in header or any(e.get("enc") == "tk8" for e in self._entries):
+            # delta decode needs the receiver's anchor at materialize time
+            self._reassemble = bytearray(body)
+        else:
+            self._advance_leaf()
+
+    def _advance_leaf(self) -> None:
+        # zero-size leaves (a 0-dim in the shape) carry no payload bytes —
+        # complete them eagerly rather than waiting on an empty slice
+        while self._entry_idx < len(self._entries):
+            e = self._entries[self._entry_idx]
+            if e["n"] == 0:
+                self._finish_leaf(e, b"")
+                self._entry_idx += 1
+                continue
+            self._leaf_buf = bytearray(e["n"])
+            self._leaf_fill = 0
+            return
+        self._leaf_buf = None
+
+    def _finish_leaf(self, e: dict, buf) -> None:
+        arr = _decode_dense_leaf(e, buf)
+        if self._device_put:
+            arr = jax.device_put(arr)
+        self._flat[e["k"]] = arr
+
+    def _data(self, body, crc: int) -> None:
+        if self.header is None:
+            raise DecodingParamsError("data chunk before stream header")
+        from p2pfl_tpu import native
+
+        # fold the chunk's already-verified CRC into the running whole-
+        # payload CRC — O(1) matrix math, not a second pass over the bytes
+        self._crc = native.crc32c_combine(self._crc, crc, len(body))
+        self.payload_bytes += len(body)
+        if self._reassemble is not None:
+            self._reassemble += body
+            return
+        off, n = 0, len(body)
+        while off < n:
+            if self._leaf_buf is None:
+                raise DecodingParamsError("payload bytes past the last leaf")
+            e = self._entries[self._entry_idx]
+            take = min(n - off, e["n"] - self._leaf_fill)
+            self._leaf_buf[self._leaf_fill : self._leaf_fill + take] = body[off : off + take]
+            self._leaf_fill += take
+            off += take
+            if self._leaf_fill == e["n"]:
+                self._finish_leaf(e, self._leaf_buf)
+                self._entry_idx += 1
+                self._advance_leaf()
+
+    def _finish(self, body) -> None:
+        if self.header is None:
+            raise DecodingParamsError("end chunk before stream header")
+        try:
+            declared = json.loads(bytes(body).decode("utf-8"))["n"]
+        except (ValueError, KeyError, UnicodeDecodeError) as exc:
+            raise DecodingParamsError(f"malformed end chunk: {exc}") from exc
+        if declared != self._expect_seq - 1:
+            raise DecodingParamsError(
+                f"chunk count mismatch: end declares {declared}, saw {self._expect_seq - 1}"
+            )
+        expect_bytes = sum(int(e["n"]) for e in self._entries)
+        if self.payload_bytes != expect_bytes:
+            raise DecodingParamsError(
+                f"stream truncated: {self.payload_bytes} payload bytes, "
+                f"header declares {expect_bytes}"
+            )
+        if "crc" in self.header and self.header["crc"] != self._crc:
+            raise DecodingParamsError(
+                f"CRC mismatch: stream corrupted ({self._crc} != {self.header['crc']})"
+            )
+        self._done = True
+        with _encode_lock:
+            if self.peak_scratch_bytes > _wire_stats["stream_peak_scratch_bytes"]:
+                _wire_stats["stream_peak_scratch_bytes"] = self.peak_scratch_bytes
+
+    def result_flat(self) -> dict:
+        """The leaf-decoded ``{path: array}`` dict (dense streams only)."""
+        if not self._done:
+            raise DecodingParamsError("stream incomplete")
+        if self._reassemble is not None:
+            raise DecodingParamsError(
+                "delta-coded stream has no eager flat result — use result_payload()"
+            )
+        return self._flat
+
+    def result_payload(self) -> bytes:
+        """The byte-identical unary frame (REASSEMBLE mode only)."""
+        if not self._done:
+            raise DecodingParamsError("stream incomplete")
+        if self._reassemble is None:
+            raise DecodingParamsError(
+                "dense stream was leaf-decoded on arrival — use result_flat()"
+            )
+        return bytes(self._reassemble)
+
+
+def estimate_payload_bytes(update) -> Optional[int]:
+    """Cheap estimate of an update's encoded payload size, WITHOUT encoding.
+
+    Transports use this to pick unary vs streaming before paying for the
+    encode. Exact when the payload bytes already exist; otherwise derived
+    from raw leaf sizes scaled by the wire-compression mode (``int8``
+    ships one byte per element; ``topk8`` ~0.33 byte/element at its 1/16
+    density ceiling, call it /12 to stay conservative). Returns ``None``
+    when nothing is known (no params, no bytes) — treat as "small".
+    """
+    if update.encoded is not None:
+        return len(update.encoded)
+    if update.params is None:
+        return None
+    from p2pfl_tpu.settings import Settings
+
+    raw = 0
+    for _, leaf in named_leaves(update.params)[1]:
+        shape = np.shape(leaf)
+        count = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        itemsize = np.dtype(leaf.dtype).itemsize if hasattr(leaf, "dtype") else 8
+        raw += count * itemsize
+    comp = Settings.WIRE_COMPRESSION
+    if comp == "int8":
+        est = raw // 4
+    elif comp == "topk8" and update.anchor is not None:
+        est = raw // 12
+    else:
+        est = raw
+    return est + 4096  # header slack
 
 
 def _resolve_dtype(name: str) -> np.dtype:
@@ -594,6 +1101,13 @@ class ModelUpdate:
     contributors: list[str] = field(default_factory=list)
     num_samples: int = 1
     encoded: Optional[bytes] = None  # populated lazily for byte transports
+    #: receiver-side streaming decode result (``{path: array}``, possibly
+    #: already device-resident): set by the transport's
+    #: :class:`StreamDecoder` when a dense stream was leaf-decoded on
+    #: arrival — the unary frame never existed on this side, so
+    #: ``materialize`` consumes this instead of decoding ``encoded``.
+    #: Never serialized.
+    decoded_flat: Optional[dict] = None
     #: True when this "aggregate" is really the round-start global kept by
     #: a failed secagg recovery (a no-op round) — receivers of a diffusion
     #: must never mistake it for the round's authoritative aggregate, so
@@ -720,6 +1234,14 @@ class ModelUpdate:
             if cached is not None:
                 self.encoded = cached
                 return cached
+            chunked = cache.peek(("chunks", *key, _chunk_bytes_setting()))
+            if chunked is not None:
+                # a streamed send already encoded this content — the chunk
+                # bodies concatenate to the byte-identical unary frame, so
+                # rebuild it instead of re-running the encode pipeline
+                self.encoded = payload_from_chunks(chunked)
+                cache.put(key, self.encoded)
+                return self.encoded
         residual = self.ef_residual
         if residual is not None and cache is not None and self.cache_version is not None:
             # cross-PLANE fold ownership: the ICI shard encode and the
@@ -740,6 +1262,131 @@ class ModelUpdate:
         if key is not None:
             cache.put(key, self.encoded)
         return self.encoded
+
+    def encode_chunks(self, chunk_bytes: Optional[int] = None) -> list:
+        """Encode as a P2TC chunk list for the streaming byte plane.
+
+        Same encode-once discipline as :meth:`encode`: the chunk list is
+        cached per content under a chunk-flavored key, already-encoded
+        unary bytes are re-sliced instead of re-encoded (and vice versa —
+        see ``_encode_locked``), and the error-feedback fold is claimed
+        through the SAME :meth:`ef_fold_key` as the unary and ICI
+        encoders, so the residual folds exactly once no matter which
+        plane encodes this content first.
+        """
+        with self._encode_lock:
+            return self._encode_chunks_locked(chunk_bytes)
+
+    def _chunk_cache_key(self, cbytes: int) -> Optional[tuple]:
+        """("chunks", <unary key fields>, chunk size), or None when this
+        update isn't cacheable — ``_encode_locked`` strips the first and
+        last elements to cross-reuse the entry from the unary flavor."""
+        from p2pfl_tpu.settings import Settings, wire_compression_device
+
+        if (
+            self.payload_cache is None
+            or self.cache_version is None
+            or not Settings.GOSSIP_PAYLOAD_CACHE
+        ):
+            return None
+        return (
+            "chunks",
+            self.cache_version,
+            self.cache_round,
+            Settings.WIRE_COMPRESSION,
+            wire_compression_device(),
+            self.anchor_tag,
+            self.ef_residual is not None,
+            cbytes,
+        )
+
+    def iter_chunks(self, chunk_bytes: Optional[int] = None):
+        """Chunk frames for ONE streamed send, framed lazily.
+
+        Same encode-once discipline as :meth:`encode_chunks`, but only the
+        encode pipeline (or cache lookup) runs before this returns — the
+        P2TC framing pass (per-chunk copy + CRC) happens as the transport
+        pulls each frame, overlapping with the wire and the receiver's
+        incremental decode instead of running as a serial prefix before
+        the first byte moves. The completed list is installed under the
+        chunk cache key at exhaustion, so fan-out sends of the same
+        content skip the pipeline AND the framing.
+        """
+        from p2pfl_tpu.settings import Settings
+
+        cbytes = chunk_bytes if chunk_bytes is not None else _chunk_bytes_setting()
+        with self._encode_lock:
+            cache = self.payload_cache
+            key = self._chunk_cache_key(cbytes)
+            if key is not None:
+                cached = cache.get(key)
+                if cached is not None:
+                    return iter(cached)
+            payload = self.encoded
+            if payload is None and key is not None:
+                payload = cache.peek(key[1:-1])
+            if payload is None:
+                # the chunk-key miss above is this content's one accounted
+                # miss — run the pipeline directly (same ef-fold ownership
+                # contract as _encode_locked / _encode_chunks_locked)
+                residual = self.ef_residual
+                if residual is not None and cache is not None and self.cache_version is not None:
+                    if not cache.ef_fold_once(self.ef_fold_key(Settings.WIRE_COMPRESSION)):
+                        residual = None
+                payload = encode_params(
+                    self.params,
+                    anchor=self.anchor,
+                    anchor_tag=self.anchor_tag,
+                    residual=residual,
+                    owner=cache.owner if cache is not None else None,
+                )
+                self.encoded = payload
+                if key is not None:
+                    cache.put(key[1:-1], payload)
+
+        def _frames():
+            collected = []
+            for frame in iter_chunked_payload(payload, cbytes):
+                collected.append(frame)
+                yield frame
+            if key is not None:
+                cache.put(key, collected)
+
+        return _frames()
+
+    def _encode_chunks_locked(self, chunk_bytes: Optional[int]) -> list:
+        from p2pfl_tpu.settings import Settings
+
+        cbytes = chunk_bytes if chunk_bytes is not None else _chunk_bytes_setting()
+        if self.encoded is not None:
+            return chunk_encoded_payload(self.encoded, cbytes)
+        cache = self.payload_cache
+        key = self._chunk_cache_key(cbytes)
+        if key is not None:
+            cached = cache.get(key)
+            if cached is not None:
+                return cached
+            unary = cache.peek(key[1:-1])
+            if unary is not None:
+                chunks = chunk_encoded_payload(unary, cbytes)
+                cache.put(key, chunks)
+                return chunks
+        residual = self.ef_residual
+        if residual is not None and cache is not None and self.cache_version is not None:
+            # cross-plane fold ownership — same contract as _encode_locked
+            if not cache.ef_fold_once(self.ef_fold_key(Settings.WIRE_COMPRESSION)):
+                residual = None
+        chunks = encode_params_chunked(
+            self.params,
+            anchor=self.anchor,
+            anchor_tag=self.anchor_tag,
+            residual=residual,
+            owner=cache.owner if cache is not None else None,
+            chunk_bytes=cbytes,
+        )
+        if key is not None:
+            cache.put(key, chunks)
+        return chunks
 
     @staticmethod
     def decode(payload: bytes, template: Pytree, contributors: list[str], num_samples: int) -> "ModelUpdate":

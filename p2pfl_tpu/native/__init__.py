@@ -172,3 +172,56 @@ def _crc32c_py(data: bytes, seed: int = 0) -> int:
     for b in data:
         c = _PY_TABLE[(c ^ b) & 0xFF] ^ (c >> 8)
     return c ^ 0xFFFFFFFF
+
+
+def _gf2_times(mat: list, vec: int) -> int:
+    s = 0
+    i = 0
+    while vec:
+        if vec & 1:
+            s ^= mat[i]
+        vec >>= 1
+        i += 1
+    return s
+
+
+def _gf2_square(mat: list) -> list:
+    return [_gf2_times(mat, mat[n]) for n in range(32)]
+
+
+#: shift operators cached per byte count — every chunk of one stream has
+#: the same body length, so a whole transfer pays the O(32·log n) matrix
+#: build at most twice (slab size + the odd-sized final chunk)
+_COMBINE_OPS: dict[int, list] = {}
+
+
+def crc32c_combine(crc1: int, crc2: int, len2: int) -> int:
+    """CRC32C of ``A + B`` from ``crc32c(A)``, ``crc32c(B)`` and ``len(B)``,
+    touching zero payload bytes (zlib's ``crc32_combine`` over the
+    Castagnoli polynomial).
+
+    The streaming decoder verifies each arriving chunk's own CRC (one
+    pass over its bytes) and folds it into the running whole-payload CRC
+    with this combine — instead of a second full pass per byte, the fold
+    is one cached 32×32 GF(2) matrix-vector product per chunk.
+    """
+    if len2 <= 0:
+        return crc1
+    op = _COMBINE_OPS.get(len2)
+    if op is None:
+        # operator for one zero BIT, squared 3× → one zero byte
+        mat = [0x82F63B78] + [1 << n for n in range(31)]
+        for _ in range(3):
+            mat = _gf2_square(mat)
+        # square-and-multiply up to len2 zero bytes
+        op = [1 << n for n in range(32)]  # identity
+        n = len2
+        while n:
+            if n & 1:
+                op = [_gf2_times(mat, col) for col in op]
+            n >>= 1
+            if n:
+                mat = _gf2_square(mat)
+        if len(_COMBINE_OPS) < 256:
+            _COMBINE_OPS[len2] = op
+    return _gf2_times(op, crc1) ^ crc2

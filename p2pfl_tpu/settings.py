@@ -206,6 +206,46 @@ class Settings:
     # re-enter the next round's delta (Seide et al. 2014).
     TOPK_ERROR_FEEDBACK: bool = True
 
+    # --- streaming byte plane (learning/weights.py + grpc_transport.py) ---
+    # Client-streaming weights sends: a payload estimated at/above
+    # WIRE_STREAM_THRESHOLD megabytes ships as a sequence of
+    # self-delimiting P2TC chunk frames over ``send_weights_stream``
+    # instead of one unary blob — encode of chunk i+1, wire transfer of
+    # chunk i and receiver-side decode of chunk i−1 overlap, and the
+    # receiver's peak payload memory is O(chunk × window) instead of
+    # O(model). Chunk bodies concatenate to EXACTLY the unary P2TW frame
+    # (one decoder core, byte-compatible at the leaf level). False
+    # disables both sending streams and accepting them (a peer with
+    # streaming off answers "stream-unsupported" and senders fall back
+    # loudly to unary for that peer — ``stream_fallback_unary`` metric).
+    # Protobuf-interop peers (WIRE_FORMAT="protobuf") never stream.
+    WIRE_STREAM_ENABLED: bool = True
+    # Stream-vs-unary cut, in MB of ESTIMATED payload (cheap metadata walk,
+    # no encode): small payloads keep the one-round-trip unary path — the
+    # pipeline only pays for itself when a payload spans many chunks.
+    WIRE_STREAM_THRESHOLD: float = 8.0
+    # Chunk slab size (MB). Cuts are leaf-aligned when leaves are smaller
+    # than a slab (so the receiver decodes whole leaves per chunk); leaves
+    # larger than a slab are split. 1–4 MB amortizes per-chunk overhead
+    # (17-byte frame + CRC32C pass) while keeping the bounded-memory
+    # window small.
+    WIRE_CHUNK_MB: float = 2.0
+    # In-flight chunk budget of the memory transport's streaming pump (a
+    # bounded queue between the producer thread and the receiving
+    # dispatch) — the backpressure window a real socket's flow control
+    # gives the gRPC path. Receiver scratch is bounded by roughly
+    # WIRE_CHUNK_MB × this window plus one leaf.
+    WIRE_STREAM_WINDOW: int = 4
+    # gRPC max send/receive message size (MB), applied to every channel
+    # AND the server. gRPC's 4 MB default silently caps unary weights
+    # payloads (RESOURCE_EXHAUSTED); raise this for big unary models —
+    # streamed chunks stay ~WIRE_CHUNK_MB regardless.
+    GRPC_MAX_MESSAGE_MB: int = 512
+    # gRPC server executor threads (was hardcoded 4): a high-fan-in
+    # aggregator otherwise serializes every inbound handler behind 4
+    # threads.
+    GRPC_SERVER_WORKERS: int = 4
+
     # --- shard-native ICI weights plane (communication/ici.py) ---
     # Which transport carries MODEL payloads between co-located nodes:
     # "bytes" is the existing behavior (the weights plane rides the same
@@ -596,6 +636,14 @@ def set_test_settings() -> None:
     # explicit (not auto): tests exercise the device-producer code paths
     # on whatever backend CI runs them on
     Settings.WIRE_COMPRESSION_DEVICE = True
+    # streaming on but the threshold far above any test model: streams
+    # engage only where a test forces the threshold down
+    Settings.WIRE_STREAM_ENABLED = True
+    Settings.WIRE_STREAM_THRESHOLD = 8.0
+    Settings.WIRE_CHUNK_MB = 2.0
+    Settings.WIRE_STREAM_WINDOW = 4
+    Settings.GRPC_MAX_MESSAGE_MB = 512
+    Settings.GRPC_SERVER_WORKERS = 4
     Settings.ROUND_FUSED = True
     Settings.CHUNK_STAGING_DEPTH = 2
     Settings.CHUNK_FUSED_REDUCE = True
