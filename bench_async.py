@@ -61,8 +61,9 @@ engine exists for: attacker fraction (5–20%) × corruption kind
 Usage: ``JAX_PLATFORMS=cpu python bench_async.py [--smoke]
 [--sections a,b,...] [--out BENCH_ASYNC.json]``
 
-``--sections`` (any of ``threaded,simulated,churn,byzantine,megafleet,
-megafleet_chunks,megafleet_robust``) runs a subset and MERGES it into
+``--sections`` (any of ``threaded,simulated,churn,restart,byzantine,
+megafleet,megafleet_chunks,megafleet_robust,megafleet_sharded``) runs a
+subset and MERGES it into
 the existing ``--out`` document, leaving the other sections' rows
 untouched — so CI can refresh one section without paying for the full
 grid.
@@ -495,6 +496,99 @@ def run_churn(n: int = 1000, updates: int = 6, smoke: bool = False) -> dict:
         "static": static,
         "churn": churn,
         "disruption_time_to_target_ratio": disruption,
+    }
+
+
+def run_restart(n: int = 1000, updates: int = 6, smoke: bool = False) -> dict:
+    """ISSUE 20: what crash-resurrection buys, as a number.
+
+    The same 1k-node hierarchical consensus fleet driven three ways —
+    static membership, 5% of nodes crashed mid-run (CrashSpec: the
+    pre-durability world, their remaining update budget forfeited), and
+    the same 5% crashed then RESURRECTED after a restart delay
+    (RestartSpec: each victim re-enters with its retained state and
+    finishes its budget) — comparing time-to-loss-target and how many of
+    the crash-forfeited merges the restart path recovers. The restart
+    drive is run twice from the same ``(seed, plan)`` and must replay
+    bit-exact (same loss curve, same restart order, identical final
+    params), the determinism contract every chaos feature carries.
+    """
+    from p2pfl_tpu.communication.faults import CrashSpec, FaultPlan, RestartSpec
+    from p2pfl_tpu.federation.simfleet import SimulatedAsyncFleet
+
+    if smoke:
+        n, updates = 100, 4
+    addrs = [f"sim-{i:04d}" for i in range(n)]
+    n_victims = max(2, n // 20)  # 5%
+    victims = addrs[3 :: max(1, n // n_victims)][:n_victims]
+    restart_plan = lambda: FaultPlan(  # noqa: E731 — plans hold run RNG state
+        seed=SEED,
+        restarts={
+            a: RestartSpec(round_no=1, resume_after_s=1.0 + 0.05 * (j % 7))
+            for j, a in enumerate(victims)
+        },
+    )
+    crash_plan = lambda: FaultPlan(  # noqa: E731
+        seed=SEED,
+        crashes={a: CrashSpec("AsyncTrainStage", round_no=1) for a in victims},
+    )
+
+    def make_fleet(plan) -> SimulatedAsyncFleet:
+        # local_lr 0.3 for the same reason as run_churn: the crash window
+        # must sit INSIDE the measured time-to-target interval
+        return SimulatedAsyncFleet(
+            n, seed=SEED, cluster_size=32, updates_per_node=updates,
+            local_lr=0.3, plan=plan,
+        )
+
+    probe = make_fleet(None)
+    dim = len(np.asarray(probe.nodes[addrs[0]].model["w"]))
+    start_loss = probe.loss_fn({"w": np.zeros(dim, np.float32)})
+    target = float(start_loss) * 0.05
+
+    def drive(plan) -> tuple:
+        fleet = make_fleet(plan)
+        fleet.target_loss = target
+        res = fleet.run()
+        versions = [v for _t, v, _l in res.loss_curve]
+        return res, {
+            "time_to_target_s": round(res.time_to_target, 3) if res.time_to_target else None,
+            "makespan_virtual_s": round(res.virtual_time, 3),
+            "global_versions": res.version,
+            "merges": res.merges,
+            "updates_sent": res.updates_sent,
+            "final_loss": round(res.final_loss(), 5),
+            "crashed": len(res.crashed),
+            "restarted": len(res.restarted),
+            "version_monotone": versions == sorted(versions) and len(set(versions)) == len(versions),
+        }
+
+    _res_static, static = drive(None)
+    _res_crash, crash = drive(crash_plan())
+    res_a, restart = drive(restart_plan())
+    res_b, _restart_b = drive(restart_plan())
+    replay_exact = bool(
+        res_a.loss_curve == res_b.loss_curve
+        and res_a.restarted == res_b.restarted
+        and np.array_equal(np.asarray(res_a.params["w"]), np.asarray(res_b.params["w"]))
+    )
+    # the headline: of the update budget a crash-only fleet forfeits,
+    # how much does crash-and-restart claw back?
+    forfeited = static["updates_sent"] - crash["updates_sent"]
+    recovered = restart["updates_sent"] - crash["updates_sent"]
+    return {
+        "n_nodes": n,
+        "updates_per_node": updates,
+        "plan": {"crash_frac": 0.05, "restart_delay_s": [1.0, 1.3], "seed": SEED},
+        "start_loss": round(float(start_loss), 5),
+        "target_loss": round(target, 5),
+        "static": static,
+        "crash_only": crash,
+        "crash_and_restart": restart,
+        "updates_forfeited_by_crash": forfeited,
+        "updates_recovered_by_restart": recovered,
+        "recovery_frac": round(recovered / forfeited, 3) if forfeited else None,
+        "restart_replay_bit_exact": replay_exact,
     }
 
 
@@ -958,7 +1052,7 @@ def run_megafleet_sharded(smoke: bool = False) -> dict:
 
 
 ALL_SECTIONS = (
-    "threaded", "simulated", "churn", "byzantine", "megafleet",
+    "threaded", "simulated", "churn", "restart", "byzantine", "megafleet",
     "megafleet_chunks", "megafleet_robust", "megafleet_sharded",
 )
 
@@ -1021,6 +1115,10 @@ def main() -> int:
     if "churn" in sections:
         log("=== churn 1k ===")
         doc["churn_1k"] = run_churn(smoke=smoke)
+
+    if "restart" in sections:
+        log("=== restart 1k ===")
+        doc["restart_1k"] = run_restart(smoke=smoke)
 
     if "byzantine" in sections:
         log("=== byzantine 1k ===")

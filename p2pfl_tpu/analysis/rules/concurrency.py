@@ -31,8 +31,11 @@ from p2pfl_tpu.analysis.engine import (
 )
 from p2pfl_tpu.analysis.findings import Finding
 
-#: transport send entry points (communication/protocol.py + gossiper) and
-#: the async plane's action runner, which fans sends out
+#: transport send entry points (communication/protocol.py + gossiper),
+#: the async plane's action runner (which fans sends out), and the node
+#: journal's snapshot commit (federation/durability.py) — blocking disk
+#: I/O with the same stall shape as a send: fsync under a context lock
+#: freezes every handler thread for the write's duration
 SEND_CALLS = frozenset(
     {
         "send",
@@ -45,6 +48,7 @@ SEND_CALLS = frozenset(
         "send_weights",
         "gossip_weights",
         "execute_actions",
+        "commit_snapshot",
     }
 )
 

@@ -106,6 +106,30 @@ class CrashSpec:
 
 
 @dataclass(frozen=True)
+class RestartSpec:
+    """Kill a node like a :class:`CrashSpec`, then RESURRECT it.
+
+    The crash half is identical (hard crash at ``stage``/``round_no``,
+    optionally ``after_s`` into the stage — no goodbyes); ``resume_after_s``
+    later the driver brings the node back **from its journal**
+    (:mod:`~p2pfl_tpu.federation.durability`): the live fleet's
+    ``resurrect_fn`` calls ``Node.resume(journal_dir)`` (or re-spawns the
+    process for a real-SIGKILL drill), the simulator schedules a
+    ``resurrect`` event on its virtual clock. Either way the node
+    re-enters through the EXISTING elastic join machinery with its
+    journaled identity — sequence counters resumed strictly past the
+    high-water, pending buffers re-armed — so kill-and-resurrect is a
+    first-class replayable chaos verdict, not a new node wearing an old
+    address.
+    """
+
+    stage: str = "AsyncTrainStage"
+    round_no: Optional[int] = 0
+    after_s: float = 0.0
+    resume_after_s: float = 1.0
+
+
+@dataclass(frozen=True)
 class ByzantineSpec:
     """A node that keeps talking and LIES: every model payload it sends is
     corrupted at the ``_do_send`` seam before it reaches the wire.
@@ -202,6 +226,7 @@ class FaultPlan:
         joins: Optional[dict[str, "JoinSpec"]] = None,
         leaves: Optional[dict[str, "LeaveSpec"]] = None,
         byzantine: Optional[dict[str, "ByzantineSpec"]] = None,
+        restarts: Optional[dict[str, "RestartSpec"]] = None,
     ) -> None:
         self.seed = seed
         self.default = default
@@ -209,6 +234,8 @@ class FaultPlan:
         self.partitions = set(partitions)
         self.slow_nodes = dict(slow_nodes or {})
         self.crashes = dict(crashes or {})
+        #: kill-and-resurrect events: addr -> RestartSpec
+        self.restarts = dict(restarts or {})
         #: churn events (elastic membership): addr -> JoinSpec / LeaveSpec
         self.joins = dict(joins or {})
         self.leaves = dict(leaves or {})
@@ -536,11 +563,37 @@ def hard_crash(node: "Node") -> None:
     node.state.status = "Idle"
 
 
-def make_stage_hook(plan: FaultPlan) -> Callable[["Node", str], None]:
-    """A ``Node.stage_hooks`` entry firing the plan's crash specs."""
+def make_stage_hook(
+    plan: FaultPlan,
+    resurrect_fn: Optional[Callable[[str], None]] = None,
+) -> Callable[["Node", str], None]:
+    """A ``Node.stage_hooks`` entry firing the plan's crash AND restart
+    specs. ``resurrect_fn(addr)`` is the live half of the restart seam —
+    called ``resume_after_s`` after the kill, on a daemon timer; only
+    the harness knows how to rebuild models/datasets and call
+    ``Node.resume``, exactly like :func:`schedule_churn`'s ``join_fn``.
+    A RestartSpec with no ``resurrect_fn`` degrades to its crash half
+    (the kill still fires; nobody comes back).
+    """
+
+    def kill(node: "Node", spec, stage_name: str, sync: bool) -> None:
+        hard_crash(node)
+        delay = getattr(spec, "resume_after_s", None)
+        if delay is not None and resurrect_fn is not None:
+            t = threading.Timer(max(delay, 0.001), _resurrect, args=(node.addr,))
+            t.daemon = True
+            t.start()
+        if sync:
+            raise FaultCrash(f"{node.addr} crashed entering {stage_name}")
+
+    def _resurrect(addr: str) -> None:
+        try:
+            resurrect_fn(addr)
+        except Exception as exc:  # noqa: BLE001 — a failed resurrection is a dead node, not a harness crash
+            logger.error(addr, f"FAULT: resurrection failed: {exc!r}")
 
     def hook(node: "Node", stage_name: str) -> None:
-        spec = plan.crashes.get(node.addr)
+        spec = plan.crashes.get(node.addr) or plan.restarts.get(node.addr)
         if spec is None or node.addr in plan._crashed:
             return
         if spec.stage != stage_name:
@@ -549,19 +602,26 @@ def make_stage_hook(plan: FaultPlan) -> Callable[["Node", str], None]:
             return
         plan._crashed.add(node.addr)
         if spec.after_s > 0:
-            t = threading.Timer(spec.after_s, hard_crash, args=(node,))
+            t = threading.Timer(spec.after_s, kill, args=(node, spec, stage_name, False))
             t.daemon = True
             t.start()
             return
-        hard_crash(node)
-        raise FaultCrash(f"{node.addr} crashed entering {stage_name}")
+        kill(node, spec, stage_name, sync=True)
 
     return hook
 
 
-def install_fault_plan(nodes: Iterable["Node"], plan: FaultPlan) -> None:
+def install_fault_plan(
+    nodes: Iterable["Node"],
+    plan: FaultPlan,
+    resurrect_fn: Optional[Callable[[str], None]] = None,
+) -> None:
     """Wire a plan into an in-process federation (or any node set)."""
-    hook = make_stage_hook(plan) if plan.crashes else None
+    hook = (
+        make_stage_hook(plan, resurrect_fn)
+        if (plan.crashes or plan.restarts)
+        else None
+    )
     for node in nodes:
         node.protocol.fault_injector = FaultInjector(plan, node.addr)
         if hook is not None:

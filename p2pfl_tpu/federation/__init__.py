@@ -36,11 +36,16 @@ the speed of the **median** instead:
 - :mod:`~p2pfl_tpu.federation.defense` — Byzantine defense-in-depth:
   the per-contribution admission screen, the per-origin suspicion EWMA
   and the quarantine hook into the existing eviction path (robust merge
-  kernels live in ``ops/aggregation``).
+  kernels live in ``ops/aggregation``);
+- :mod:`~p2pfl_tpu.federation.durability` — crash-resurrection: the
+  crash-consistent :class:`NodeJournal` (atomic frame + manifest + CRC
+  snapshots of everything a node needs to come back as itself) behind
+  ``Node.enable_journal`` / ``Node.resume``.
 """
 
 from p2pfl_tpu.federation.buffer import BufferedAggregator
 from p2pfl_tpu.federation.defense import ByzantineDefense
+from p2pfl_tpu.federation.durability import JournalSnapshot, NodeJournal, SeqCounter
 from p2pfl_tpu.federation.megafleet import FleetSpec, MegaFleet, MegaFleetResult
 from p2pfl_tpu.federation.routing import BufferPlan, TierRouter, VersionHighWater
 from p2pfl_tpu.federation.simfleet import FleetResult, SimulatedAsyncFleet
@@ -56,7 +61,10 @@ __all__ = [
     "FleetResult",
     "FleetSpec",
     "HierarchicalTopology",
+    "JournalSnapshot",
     "MegaFleet",
+    "NodeJournal",
+    "SeqCounter",
     "MegaFleetResult",
     "SimulatedAsyncFleet",
     "TierRouter",

@@ -258,6 +258,69 @@ class BufferedAggregator:
             result = self._maybe_flush_locked()
         return self._finish_flush(result)
 
+    # ---- durability (federation/durability.py) ----
+
+    def journal_state(self, tier: str):
+        """Copy this tier's journalable state under the lock — version,
+        version-vector marks, and every pending contribution with its
+        ORIGINAL version triple (so a resurrection that lands in a
+        different role can successor-forward them verbatim)."""
+        from p2pfl_tpu.federation.durability import BufferJournal
+
+        with self._lock:
+            pending = [
+                (
+                    v.origin,
+                    v.seq,
+                    v.base_version,
+                    list(u.contributors),
+                    int(u.num_samples),
+                    u.params,
+                )
+                for v, u, _w, _t in sorted(
+                    self._pending, key=lambda e: (e[0].origin, e[0].seq)
+                )
+            ]
+            return BufferJournal(
+                tier=tier,
+                version=self._version,
+                vv=self._vv.snapshot(),
+                pending=pending,
+            )
+
+    def restore_journal(
+        self, version: int, vv: dict, updates: List[ModelUpdate]
+    ) -> Optional[FlushResult]:
+        """Re-arm this tier from a journal: merge the version-vector
+        marks (so a network re-delivery of a pre-crash in-flight update
+        dedups instead of double-merging), lift the version floor, and
+        re-buffer the journaled pending contributions. The entries
+        bypass :meth:`offer`'s dedup — the restored marks already
+        include them (they were observed at original admission) — but
+        staleness is re-checked against the restored version: age that
+        accrued while the node was dead may push an entry past the
+        bound, which drops it exactly as it would have been dropped
+        live. May complete a buffer of K — the flush result is returned
+        for propagation, exactly like :meth:`set_k`."""
+        with self._lock:
+            for origin, seq in vv.items():
+                self._vv.observe(origin, seq)
+            if version > self._version:
+                self._version = version
+            for upd in updates:
+                ver = as_version(upd.version)
+                if ver is None:
+                    continue
+                tau = max(self._version - ver.base_version, 0)
+                if tau > self.max_staleness:
+                    logger.log_comm_metric(self.node_name, "async_stale_drop")
+                    continue
+                weight = float(upd.num_samples) * staleness_weight(tau, self.alpha)
+                self._pending.append((ver, upd, weight, tau))
+                logger.log_comm_metric(self.node_name, "async_update_buffered")
+            result = self._maybe_flush_locked()
+        return self._finish_flush(result)
+
     def take_pending(self) -> List[ModelUpdate]:
         """Drain buffered-but-unflushed contributions without merging —
         the buffer-migration hook for elastic membership.

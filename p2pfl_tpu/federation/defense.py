@@ -106,6 +106,26 @@ class ByzantineDefense:
             self._pending_quarantine.clear()
             self.screen_rejects = 0
 
+    # ---- durability (federation/durability.py) ----
+
+    def journal_state(self):
+        """``(suspicion, quarantined)`` copies for the node journal —
+        suspicion decays slowly by design, so losing it to a restart
+        would hand every persistent attacker a free EWMA reset."""
+        with self._lock:
+            return dict(self._suspicion), sorted(self._quarantined)
+
+    def restore(self, suspicion: dict, quarantined: List[str]) -> None:
+        """Re-arm from a journal (max-merge: concurrent observations
+        since the snapshot are never lowered). Quarantine is NOT
+        re-fired — the pre-crash eviction already broadcast, and the
+        restored set keeps :meth:`admit` dropping those origins."""
+        with self._lock:
+            for origin, s in suspicion.items():
+                if s > self._suspicion.get(origin, 0.0):
+                    self._suspicion[origin] = float(s)
+            self._quarantined.update(quarantined)
+
     # ---- the screen ----
 
     @staticmethod

@@ -31,7 +31,11 @@ k-th local update" — and the elastic churn events ride the same plan:
 aggregator's current global), ``LeaveSpec(at_s, graceful=True)`` removes
 one (a graceful aggregator forwards its partial buffer to the successor
 tier before exiting; an abrupt one is discovered like a crash, after
-``evict_delay``), and ``ByzantineSpec`` attackers corrupt their payloads
+``evict_delay``), ``RestartSpec`` kills a node like a CrashSpec and
+``resume_after_s`` later resurrects it from its (virtual) journal — same
+address, retained sequence counters and adopted global, catching up via
+a bootstrap pull — so kill-and-resurrect replays bit-exact on the
+virtual clock, and ``ByzantineSpec`` attackers corrupt their payloads
 on the virtual wire through the SAME ``byz_corrupt_update`` helper the
 live injector runs — with ``Settings.BYZ_SCREEN`` on, each aggregator's
 :class:`~p2pfl_tpu.federation.defense.ByzantineDefense` screens arrivals
@@ -84,6 +88,7 @@ class FleetResult:
     byz_corrupted: int = 0  #: payloads corrupted by ByzantineSpec attackers
     screen_rejects: int = 0  #: contributions the admission screen refused
     quarantined: List[str] = field(default_factory=list)  #: evicted attackers
+    restarted: List[str] = field(default_factory=list)  #: RestartSpec resurrections
 
     def final_loss(self) -> float:
         return self.loss_curve[-1][2] if self.loss_curve else float("inf")
@@ -215,6 +220,11 @@ class SimulatedAsyncFleet:
             self._make_node(addr)
 
         self._up_seq: Dict[str, Any] = {}
+        #: per-node death generation for RestartSpec resurrections: a
+        #: pending evict event carries the epoch of the death that armed
+        #: it, so an evict that was overtaken by a resurrection (or a
+        #: later second death) is a no-op instead of evicting a LIVE node
+        self._death_epoch: Dict[str, int] = {}
         self._buffers: Dict[str, Dict[str, BufferedAggregator]] = {}
         #: per-aggregator admission screens (federation/defense.py) —
         #: created lazily, only under Settings.BYZ_SCREEN; no callback:
@@ -372,6 +382,14 @@ class SimulatedAsyncFleet:
         if self.plan is None:
             return None
         return self.plan.crashes.get(addr)
+
+    def _restart_spec(self, addr: str):
+        """The node's kill-and-resurrect spec, fire-once (the plan's
+        ``_crashed`` set — the same latch the live stage hook uses, so a
+        resumed node re-reaching the trigger round does not die again)."""
+        if self.plan is None or addr in self.plan._crashed:
+            return None
+        return getattr(self.plan, "restarts", {}).get(addr)
 
     def _defense_for(self, addr: str):
         """The aggregator's admission screen (None when screening is off)."""
@@ -534,12 +552,53 @@ class SimulatedAsyncFleet:
                         t, addr, tgt, node.global_params, node.known_version
                     )
 
-    def _on_evict(self, t: float, addr: str) -> None:
+    def _on_evict(self, t: float, addr: str, epoch: Optional[int] = None) -> None:
+        # epoch-guarded evicts come from RestartSpec deaths: if the node
+        # resurrected (or died again) since this evict was armed, the
+        # epoch moved on and this event is about a corpse that no longer
+        # exists. Un-epoched evicts (quarantine, abrupt leave, CrashSpec)
+        # stay unconditional — their targets never come back.
+        if epoch is not None and self._death_epoch.get(addr, 0) != epoch:
+            return
         if addr in self._dead:
             return
         self._dead.add(addr)
         self._buffers.pop(addr, None)  # a corpse's pending dies with it
         self._rederive(t)
+
+    def _on_resurrect(self, t: float, addr: str) -> None:
+        """A RestartSpec node comes back FROM ITS JOURNAL: same address,
+        retained ``seq`` counter / ``high_water`` / model and adopted
+        global (the :class:`_SimNode`'s in-memory retention is the
+        virtual stand-in for a perfect :class:`~p2pfl_tpu.federation.
+        durability.NodeJournal`), re-entering through the same elastic
+        seam a joiner uses — re-derivation plus a bootstrap pull that
+        catches it up on any global minted while it was dead. Because
+        ``seq`` continues where it stopped, upstream version vectors
+        accept its first post-resurrection push and dedup any pre-crash
+        in-flight duplicate — the property the live drill pins."""
+        node = self.nodes.get(addr)
+        if node is None or not node.crashed:
+            return
+        # invalidate this death's pending evict whether or not it fired
+        self._death_epoch[addr] = self._death_epoch.get(addr, 0) + 1
+        node.crashed = False
+        self.result.restarted.append(addr)
+        if addr in self._dead:
+            self._dead.discard(addr)
+            self._rederive(t)
+        # bootstrap pull (the _on_join idiom): adopt anything newer than
+        # the journaled global; _adopt's version gate drops a stale reply
+        target = self.router.push_target(addr)
+        if target is not None and target != addr:
+            params, version = self._agg_snapshot(target)
+            if version > 0:
+                self._push(
+                    t + self.link_delay, "model_arrive",
+                    (addr, params, version, target),
+                )
+        if node.updates_done < self.updates_per_node:
+            self._push(t + node.duration, "train_done", (addr,))
 
     # ---- event loop ----
 
@@ -570,6 +629,8 @@ class SimulatedAsyncFleet:
                 self._on_leave(t, *payload)
             elif kind == "evict":
                 self._on_evict(t, *payload)
+            elif kind == "resurrect":
+                self._on_resurrect(t, *payload)
         root = self.router.root
         gbuf = self._buffers.get(root, {}).get("global") if root else None
         if gbuf is not None:
@@ -596,6 +657,22 @@ class SimulatedAsyncFleet:
             # re-derive the topology around the hole (successor election,
             # K repair) — the heartbeat plane's virtual stand-in
             self._push(t + self.evict_delay, "evict", (addr,))
+            return
+        rspec = self._restart_spec(addr)
+        if (
+            rspec is not None
+            and rspec.stage == "AsyncTrainStage"
+            and (rspec.round_no is None or rspec.round_no == node.updates_done)
+        ):
+            self.plan._crashed.add(addr)
+            node.crashed = True
+            self.result.crashed.append(addr)
+            ep = self._death_epoch.get(addr, 0) + 1
+            self._death_epoch[addr] = ep
+            # the evict carries this death's epoch: a resurrection that
+            # lands before the eviction window closes invalidates it
+            self._push(t + self.evict_delay, "evict", (addr, ep))
+            self._push(t + max(rspec.resume_after_s, 1e-6), "resurrect", (addr,))
             return
         # adopt the freshest global that arrived while "training"
         if node.pending_global is not None:

@@ -52,12 +52,12 @@ wire compression work as-is.
 
 from __future__ import annotations
 
-import itertools
 import threading
 import time
 from typing import TYPE_CHECKING, Any, List, Optional, Tuple
 
 from p2pfl_tpu.federation.buffer import BufferedAggregator, FlushResult
+from p2pfl_tpu.federation.durability import SeqCounter, rebuild_updates
 from p2pfl_tpu.federation.routing import TierRouter, VersionHighWater
 from p2pfl_tpu.federation.staleness import as_version, xp_mismatch
 from p2pfl_tpu.learning.weights import ModelUpdate
@@ -163,9 +163,11 @@ class AsyncContext:
         #: regional aggregates are deduped in DIFFERENT version vectors,
         #: but each stream must be monotone on its own — and must survive
         #: role changes (a re-promoted aggregator continuing at seq 1
-        #: would be rejected as a replay by its parent's version vector)
-        self.train_seq = itertools.count(1)
-        self._up_seq = itertools.count(1)
+        #: would be rejected as a replay by its parent's version vector).
+        #: SeqCounter (not itertools.count) so the journal can read the
+        #: stream position and a resurrection can resume strictly past it
+        self.train_seq = SeqCounter(1)
+        self._up_seq = SeqCounter(1)
         self.rbuf: Optional[BufferedAggregator] = None
         self.gbuf: Optional[BufferedAggregator] = None
         self._apply_initial_plan()
@@ -390,6 +392,108 @@ class AsyncContext:
         with self.lock:
             dirty, self._stash_dirty = self._stash_dirty, False
         return dirty
+
+    # ---- crash-resurrection (federation/durability.py) ----
+
+    def restore_from_journal(self, snap) -> List[Action]:
+        """Re-arm this context from a recovered journal snapshot — the
+        resurrection's second half, run on the learning thread right
+        after the stash drain and BEFORE the elastic bootstrap join.
+
+        Restores, in order: the journaled ``(members, dead)`` view
+        (monotone union + re-derive, exactly like ``merge_view`` — the
+        resurrectee's fresh heartbeat view lacks the dead members every
+        survivor keeps as cluster holes); the version state (high-water,
+        adopted global pre-seeded into the mailbox so ``_bootstrap_join``
+        returns instantly and the pull only fetches anything NEWER the
+        fleet minted meanwhile); the own-sequence counters, resumed
+        strictly past the journaled position plus
+        ``Settings.JOURNAL_SEQ_MARGIN`` (covers updates minted after the
+        last snapshot but before the crash — upstream VersionVectors
+        treat the gap as lost updates, never as replays); each journaled
+        buffer tier (version floor + VV marks + pending re-buffered, or
+        — when the restart's re-derivation demoted this node — the
+        pending successor-forwarded raw with original triples, the PR-11
+        migration idiom); and the Byzantine suspicion/quarantine state.
+        Returns the actions all of that produced (possible flushes,
+        migration forwards) for the caller to execute outside the lock.
+        """
+        actions: List[Action] = []
+        with self.lock:
+            new_members = set(snap.members) - self.members
+            new_dead = {
+                d for d in snap.dead if d != self.addr and d not in self._dead
+            }
+            if new_members or new_dead:
+                self.members |= new_members | set(new_dead)
+                self._dead |= new_dead
+                actions += self._rederive_locked(
+                    "journal_recover",
+                    {"joined": sorted(new_members), "dead": sorted(new_dead)},
+                )
+            if (
+                snap.global_params is not None
+                and snap.global_version > self.global_version
+            ):
+                self.global_version = snap.global_version
+                self.pending_global = (snap.global_params, snap.global_version)
+                self.last_global = (snap.global_params, snap.global_version)
+            self.base_version = max(self.base_version, snap.base_version)
+            self.high_water.observe(snap.high_water)
+            margin = max(0, int(Settings.JOURNAL_SEQ_MARGIN))
+            self.train_seq = SeqCounter(
+                max(self.train_seq.next_value, snap.train_seq + margin)
+            )
+            self._up_seq = SeqCounter(
+                max(self._up_seq.next_value, snap.up_seq + margin)
+            )
+            rbuf = self.rbuf
+            if rbuf is not None and self.last_global is not None:
+                rbuf.set_global(*self.last_global)
+            for bj in snap.buffers:
+                regional = bj.tier == "regional"
+                buf = self.rbuf if regional else self.gbuf
+                updates = rebuild_updates(bj, self.xid)
+                if buf is not None:
+                    res = buf.restore_journal(bj.version, bj.vv, updates)
+                    if res:
+                        actions += (
+                            self._regional_flush(res)
+                            if regional
+                            else self._global_flush(res)
+                        )
+                elif updates:
+                    # the restart landed this node in a smaller role than
+                    # it died in: forward the journaled pending raw to the
+                    # successor tier, original triples intact — its own
+                    # version vector re-dedups any copy that also reached
+                    # it directly while we were dead
+                    target = (
+                        self.router.push_target(self.addr)
+                        if regional
+                        else self.router.root
+                    )
+                    if target is not None:
+                        logger.log_comm_metric(
+                            self.addr, "async_buffer_migrated", len(updates)
+                        )
+                        actions += [("async_update", target, u) for u in updates]
+            restored_pending = sum(len(b.pending) for b in snap.buffers)
+        self.node.defense.restore(snap.suspicion, snap.quarantined)
+        logger.log_comm_metric(self.addr, "journal_restored")
+        telemetry.event(
+            self.addr,
+            "journal_restored",
+            kind="stage",
+            attrs={
+                "snap": snap.snap,
+                "version": snap.global_version,
+                "pending": restored_pending,
+                "train_seq": snap.train_seq,
+                "up_seq": snap.up_seq,
+            },
+        )
+        return actions
 
     # ---- receive paths (commands + local offers) ----
 
@@ -767,6 +871,13 @@ class AsyncLearningWorkflow:
             from p2pfl_tpu.commands.federation import drain_async_stash
 
             drain_async_stash(node, ctx)
+            # crash-resurrection: restore buffers/counters/membership from
+            # the recovered journal BEFORE the bootstrap join — the
+            # journaled global pre-seeds the mailbox, so the join's pull
+            # wait returns instantly and only fetches anything newer
+            snap = node.consume_resume_snapshot()
+            if snap is not None:
+                ctx.execute_actions(ctx.restore_from_journal(snap))
             if joining:
                 self._bootstrap_join(node, ctx)
             self._local_loop(node, ctx)
@@ -785,6 +896,11 @@ class AsyncLearningWorkflow:
             else:
                 node.protocol.broadcast(node.protocol.build_msg("async_done"))
                 self._drain(node, ctx)
+            # final snapshot: the journal's recovery point covers the
+            # drain's late adoptions too (a crash after this line resumes
+            # with the experiment's end state, not one update behind)
+            if node.journal is not None:
+                self._journal_snapshot(node, ctx)
             # the experiment's RESULT is the latest global model this node
             # knows — not its local tail update (which it already pushed;
             # whether that merged or was discarded with a partial buffer,
@@ -973,6 +1089,29 @@ class AsyncLearningWorkflow:
                     # dropped, not retried: the next local update
                     # supersedes this one anyway
                     logger.log_comm_metric(node.addr, "async_push_fail")
+            # durable recovery point AFTER the push: the journaled
+            # train_seq then already counts the update just sent, so a
+            # resurrection's seq margin only has to cover in-flight
+            # duplicates, never a whole un-journaled update
+            if (
+                node.journal is not None
+                and (i + 1) % max(1, int(Settings.JOURNAL_EVERY_N_UPDATES)) == 0
+            ):
+                self._journal_snapshot(node, ctx)
+
+    @staticmethod
+    def _journal_snapshot(node: "Node", ctx: AsyncContext) -> None:
+        """Capture under the locks, commit OUTSIDE them (commit_snapshot
+        is blocking disk I/O — p2pfl-check holds it to the same
+        no-lock-across rule as a send). A failed snapshot is a logged
+        gap in durability, never a crashed learning thread."""
+        from p2pfl_tpu.federation.durability import capture_snapshot
+
+        try:
+            snap = capture_snapshot(node, ctx)
+            node.journal.commit_snapshot(snap, learner=node.learner)
+        except Exception as exc:  # noqa: BLE001 — durability must not take the node down
+            logger.error(node.addr, f"Journal snapshot failed: {exc!r}")
 
     def _drain(self, node: "Node", ctx: AsyncContext) -> None:
         """Every node serves until the whole fleet is done or dead:

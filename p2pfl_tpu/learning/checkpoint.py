@@ -18,6 +18,8 @@ from typing import Any, Optional
 
 import orbax.checkpoint as ocp
 
+from p2pfl_tpu.settings import Settings
+
 Pytree = Any
 
 
@@ -25,9 +27,24 @@ def _path(directory: str) -> str:
     return os.path.abspath(os.path.expanduser(directory))
 
 
-def save_state(directory: str, state: dict, step: int = 0) -> None:
+def _manager(directory: str, keep_n: Optional[int]) -> ocp.CheckpointManager:
+    """A CheckpointManager with retention wired: ``keep_n`` newest steps
+    are kept (``CheckpointManagerOptions.max_to_keep``), older ones GC'd
+    on save. None reads ``Settings.CHECKPOINT_KEEP_N``; 0 = unbounded —
+    the pre-retention behavior, still the standalone default, but a
+    long-lived fleet member saving every update MUST bound this (the
+    node journal passes its own ``JOURNAL_KEEP_N``)."""
+    if keep_n is None:
+        keep_n = int(Settings.CHECKPOINT_KEEP_N)
+    options = ocp.CheckpointManagerOptions(max_to_keep=keep_n) if keep_n > 0 else None
+    return ocp.CheckpointManager(_path(directory), options=options)
+
+
+def save_state(
+    directory: str, state: dict, step: int = 0, keep_n: Optional[int] = None
+) -> None:
     """Save an arbitrary pytree-of-arrays state dict."""
-    with ocp.CheckpointManager(_path(directory)) as mgr:
+    with _manager(directory, keep_n) as mgr:
         mgr.save(step, args=ocp.args.StandardSave(state), force=True)
         mgr.wait_until_finished()
 
@@ -41,11 +58,17 @@ def restore_state(directory: str, template: dict, step: Optional[int] = None) ->
         return mgr.restore(step, args=ocp.args.StandardRestore(template))
 
 
-def save_learner(directory: str, learner, round: Optional[int] = None) -> None:  # noqa: A002
+def save_learner(
+    directory: str,
+    learner,
+    round: Optional[int] = None,  # noqa: A002
+    keep_n: Optional[int] = None,
+) -> None:
     save_state(
         directory,
         {"params": learner.params, "opt_state": learner.opt_state},
         step=round or 0,
+        keep_n=keep_n,
     )
 
 

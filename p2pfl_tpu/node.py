@@ -141,6 +141,12 @@ class Node:
         self._pending_xid: Optional[str] = None
         self._async_join = False
         self._async_leave = threading.Event()
+        # crash-resurrection (federation/durability.py): an attached
+        # NodeJournal makes the async workflow snapshot after every Nth
+        # own update; a snapshot recovered by Node.resume waits here for
+        # the workflow to consume (restore buffers/counters/membership)
+        self.journal: Optional[Any] = None
+        self._resume_snapshot: Optional[Any] = None
         # the finished async experiment's canonical result
         # (params, version, xid) — kept until the next experiment starts
         # so async_pull can still be served AFTER the workflow exited (a
@@ -314,6 +320,135 @@ class Node:
         self._pending_xid = None
         self._async_join = True
         self._start_learning_thread(rounds, epochs)
+
+    def enable_journal(self, directory: str, keep_n: Optional[int] = None) -> None:
+        """Attach a crash-resurrection journal (federation/durability.py):
+        the async workflow then commits one snapshot every
+        ``Settings.JOURNAL_EVERY_N_UPDATES`` of this node's own updates
+        (plus a final one at drain), and :meth:`resume` can later bring
+        the node back from ``directory`` as itself."""
+        from p2pfl_tpu.federation.durability import NodeJournal
+
+        self.journal = NodeJournal(directory, node_name=self.addr, keep_n=keep_n)
+
+    @classmethod
+    def resume(
+        cls,
+        journal_dir: str,
+        model: Any = None,
+        data: Any = None,
+        learner: Any = None,
+        protocol: Type[CommunicationProtocol] = InMemoryProtocol,
+        bootstrap: Optional[list] = None,
+        rounds: Optional[int] = None,
+        epochs: int = 1,
+        start: bool = True,
+        simulation: bool = False,
+    ) -> "Node":
+        """Resurrect a node from its journal — it comes back as ITSELF.
+
+        Recovers the last committed snapshot, rebuilds a Node under the
+        journaled ADDRESS (identity is what makes upstream VersionVectors
+        dedup its pre-crash in-flight updates instead of double-merging),
+        restores the learner's params/opt_state, and re-enters the
+        running experiment through the EXISTING elastic join machinery:
+        the workflow sees the join flag, announces ``async_join``, pulls
+        a bootstrap global (catching up if the fleet moved past the
+        journaled version), and then restores buffers, version vector,
+        membership view, suspicion state and sequence counters from the
+        snapshot — counters resumed strictly past the journaled
+        high-water plus ``Settings.JOURNAL_SEQ_MARGIN``.
+
+        ``bootstrap`` lists peers to connect to (default: the journaled
+        live membership view minus self). ``rounds`` is the remaining
+        local update budget (default: journaled ``total_rounds`` minus
+        updates already done, floor 1). Caller supplies ``model``/
+        ``data`` (or a ready ``learner``) exactly as for ``__init__`` —
+        datasets are not journaled, only learned state is.
+
+        Raises ``FileNotFoundError`` when the journal has no
+        recoverable snapshot (an empty directory is not a node).
+        """
+        from p2pfl_tpu.federation.durability import NodeJournal
+        from p2pfl_tpu.management.telemetry import telemetry
+
+        t0 = time.monotonic()
+        journal = NodeJournal(journal_dir)
+        snap = journal.recover()
+        if snap is None:
+            raise FileNotFoundError(f"no recoverable journal snapshot under {journal_dir}")
+        journal.node_name = snap.addr
+        node = cls(
+            model,
+            data,
+            address=snap.addr,
+            learner=learner,
+            protocol=protocol(snap.addr) if isinstance(protocol, type) else protocol,
+            simulation=simulation,
+        )
+        if node.learner is not None:
+            from p2pfl_tpu.learning.weights import restore_like
+
+            template = node.learner.get_parameters()
+            if snap.learner_step is not None:
+                import os
+
+                from p2pfl_tpu.learning.checkpoint import restore_learner
+
+                restore_learner(
+                    os.path.join(journal.directory, "learner"),
+                    node.learner,
+                    step=snap.learner_step,
+                )
+            elif snap.learner_params is not None:
+                node.learner.set_parameters(restore_like(template, snap.learner_params))
+            # re-materialize the journaled flat dicts as pytrees with the
+            # learner's structure (the fleet shares one model structure)
+            if snap.global_params is not None:
+                snap.global_params = restore_like(template, snap.global_params)
+            for bj in snap.buffers:
+                bj.pending = [
+                    (o, s, b, c, n, restore_like(template, p))
+                    for o, s, b, c, n, p in bj.pending
+                ]
+        node.journal = journal
+        node._resume_snapshot = snap
+        # the elastic join path, with the journaled identity: KEEP the
+        # experiment id (a joiner nulls it — it never saw start_learning;
+        # a resurrectee DID, and stamping the journaled xid keeps its
+        # frames inside the experiment's xp filter from the first push)
+        node._pending_xid = snap.xid
+        node._async_join = True
+        if start:
+            node.start()
+            peers = bootstrap if bootstrap is not None else [
+                a for a in snap.members if a != snap.addr and a not in snap.dead
+            ]
+            for peer in peers:
+                node.connect(peer)
+            budget = rounds if rounds is not None else max(
+                snap.total_rounds - snap.updates_done, 1
+            )
+            logger.log_comm_metric(snap.addr, "node_resumed")
+            telemetry.event(
+                snap.addr,
+                "node_resumed",
+                kind="stage",
+                attrs={
+                    "snap": snap.snap,
+                    "version": snap.global_version,
+                    "updates_done": snap.updates_done,
+                    "resume_ms": round((time.monotonic() - t0) * 1000.0, 3),
+                },
+            )
+            node._start_learning_thread(budget, epochs)
+        return node
+
+    def consume_resume_snapshot(self) -> Optional[Any]:
+        """Pop the recovered snapshot (the workflow restores from it
+        exactly once — a later experiment must start clean)."""
+        snap, self._resume_snapshot = self._resume_snapshot, None
+        return snap
 
     def request_async_leave(self) -> None:
         """Ask the running async workflow to leave GRACEFULLY: it stops

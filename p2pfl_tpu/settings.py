@@ -337,6 +337,32 @@ class Settings:
     # single direct round-trip, so this only needs to cover connection
     # setup plus one full-model push.
     ASYNC_JOIN_TIMEOUT: float = 15.0
+    # --- crash-resurrection journal (federation/durability.py) ---
+    # Snapshot cadence: a node with a journal attached commits one
+    # snapshot every N of its own training updates (plus one final
+    # snapshot at drain/leave). 1 = after every update — the tightest
+    # recovery point; raise to amortize the disk write against very
+    # short local epochs.
+    JOURNAL_EVERY_N_UPDATES: int = 1
+    # Journal retention: keep the newest N committed snapshots (the
+    # manifest-committed one is always kept). 0 = keep all — only for
+    # forensic runs; a long-lived fleet member writes one snapshot per
+    # update forever.
+    JOURNAL_KEEP_N: int = 3
+    # Resurrection sequence margin: a resumed node restarts its own
+    # train/up sequence counters at journaled_next + margin, covering
+    # updates minted AFTER the last snapshot but BEFORE the crash (at
+    # most JOURNAL_EVERY_N_UPDATES of them in flight, but duplicate
+    # timers can re-deliver). Upstream VersionVectors accept seq gaps by
+    # design (a gap is a lost update, not a protocol error), so the only
+    # cost of a generous margin is a cosmetic hole in the sequence.
+    JOURNAL_SEQ_MARGIN: int = 16
+    # Orbax retention for learning/checkpoint.py save_state: keep the
+    # newest N checkpoint steps (CheckpointManagerOptions.max_to_keep).
+    # 0 = unbounded (the pre-durability behavior, kept as the default
+    # for standalone checkpointing); the journal passes its own
+    # JOURNAL_KEEP_N explicitly.
+    CHECKPOINT_KEEP_N: int = 0
     # --- Megafleet (federation/megafleet.py, ops/fleet_kernels.py) ---
     # Default Bonawitz production knobs for the vectorized fleet engine,
     # read ONCE at MegaFleet construction (never inside a traced body —
@@ -676,6 +702,10 @@ def set_test_settings() -> None:
     Settings.HIER_CLUSTER_SIZE = 0
     Settings.ASYNC_DRAIN_TIMEOUT = 15.0
     Settings.ASYNC_JOIN_TIMEOUT = 5.0
+    Settings.JOURNAL_EVERY_N_UPDATES = 1
+    Settings.JOURNAL_KEEP_N = 3
+    Settings.JOURNAL_SEQ_MARGIN = 16
+    Settings.CHECKPOINT_KEEP_N = 0
     Settings.MEGAFLEET_PACE_WINDOW = 0.0
     Settings.MEGAFLEET_SELECT_FRAC = 1.0
     Settings.MEGAFLEET_REGIONAL_RATE_S = 0.0

@@ -1,0 +1,546 @@
+"""Crash-resurrection (ISSUE 20): durable node journals, deterministic
+restart/rejoin.
+
+Five layers, mirroring the change's structure:
+
+- the journal frame + manifest codec: roundtrip fidelity, retention GC,
+  and the SeqCounter the async context's streams now run on;
+- crash consistency under torture: ≥50 random mid-write kills (torn
+  temp files, torn final frames, kills between the frame commit and the
+  manifest commit, torn manifests) — recovery always lands on a
+  committed snapshot, never a torn one — plus the hostile-corruption
+  fixture exercising the CRC checks both ways;
+- the simulator under RestartSpec: bit-exact replay from ``(seed,
+  plan)``, crash-and-restart recovering the update budget a crash-only
+  plan loses, and the death-epoch guard on both sides of the eviction
+  window;
+- the sequence-resumption regression over REAL gRPC: a resumed node's
+  first push is accepted (never ``async_dup_drop``ped — the journaled
+  seq + margin outruns every upstream VersionVector mark), while a
+  pre-crash in-flight duplicate of its last update IS dropped;
+- the live drill: a member of an in-process fleet is hard-crashed
+  mid-round by a FaultPlan RestartSpec and resumed from its journal by
+  the ``resurrect_fn`` seam — survivors and resurrectee converge on one
+  global.
+"""
+
+import json
+import os
+import random
+import re
+import time
+
+import numpy as np
+import pytest
+
+from p2pfl_tpu.communication.faults import (
+    CrashSpec,
+    FaultPlan,
+    RestartSpec,
+    hard_crash,
+    install_fault_plan,
+    remove_fault_plan,
+)
+from p2pfl_tpu.communication.grpc_transport import GrpcProtocol
+from p2pfl_tpu.communication.memory import MemoryRegistry
+from p2pfl_tpu.federation.durability import (
+    BufferJournal,
+    JournalSnapshot,
+    NodeJournal,
+    SeqCounter,
+    rebuild_updates,
+)
+from p2pfl_tpu.federation.simfleet import SimulatedAsyncFleet
+from p2pfl_tpu.learning.learner import DummyLearner
+from p2pfl_tpu.learning.weights import ModelUpdate
+from p2pfl_tpu.management.logger import logger
+from p2pfl_tpu.node import Node
+from p2pfl_tpu.settings import Settings
+from p2pfl_tpu.utils import full_connection, wait_convergence, wait_to_finish
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    MemoryRegistry.reset()
+    logger.reset_comm_metrics()
+    yield
+    Settings.FEDERATION_MODE = "sync"
+    Settings.HIER_CLUSTER_SIZE = 0
+    MemoryRegistry.reset()
+
+
+def _sum_metric(metric):
+    return sum(d.get(metric, 0.0) for d in logger.get_comm_metrics().values())
+
+
+def _pace(seconds):
+    """A stage hook that paces local updates so faults land mid-run."""
+
+    def hook(node, stage_name):
+        if stage_name == "AsyncTrainStage":
+            time.sleep(seconds)
+
+    return hook
+
+
+def _mk_snap(addr: str, marker: int) -> JournalSnapshot:
+    """A snapshot whose every integrity-checkable field encodes ``marker``."""
+    return JournalSnapshot(
+        addr=addr,
+        xid="xp-dur",
+        members=[addr, "peer-a", "peer-b"],
+        dead=["peer-b"],
+        global_version=marker,
+        base_version=max(marker - 1, 0),
+        high_water=marker,
+        train_seq=marker + 1,
+        up_seq=marker,
+        total_rounds=10,
+        updates_done=marker,
+        suspicion={"peer-a": 0.25},
+        quarantined=[],
+        global_params={"w": np.full(16, float(marker), np.float32)},
+        buffers=[
+            BufferJournal(
+                tier="regional",
+                version=marker,
+                vv={"peer-a": marker},
+                pending=[
+                    (
+                        "peer-a",
+                        marker,
+                        max(marker - 1, 0),
+                        ["peer-a"],
+                        3,
+                        {"w": np.full(16, float(marker) * 2.0, np.float32)},
+                    )
+                ],
+            )
+        ],
+    )
+
+
+def _flat_array(params):
+    """The single tensor of a template-less recovered params dict."""
+    assert len(params) == 1
+    return np.asarray(next(iter(params.values())))
+
+
+# ---------------------------------------------------------------------------
+# codec + retention
+# ---------------------------------------------------------------------------
+
+
+def test_seq_counter_is_journalable_count():
+    c = SeqCounter(5)
+    assert c.next_value == 5
+    assert next(c) == 5 and next(c) == 6
+    assert c.next_value == 7  # never issued yet
+
+
+def test_journal_roundtrip_and_retention(tmp_path):
+    j = NodeJournal(str(tmp_path), node_name="rt", keep_n=3)
+    for marker in range(1, 6):
+        j.commit_snapshot(_mk_snap("rt", marker))
+    # retention: only the newest keep_n frames survive GC
+    frames = sorted(p.name for p in tmp_path.glob("snap-*.p2pj"))
+    assert frames == ["snap-3.p2pj", "snap-4.p2pj", "snap-5.p2pj"]
+    rec = NodeJournal(str(tmp_path)).recover()
+    assert rec is not None and rec.snap == 5
+    assert rec.addr == "rt" and rec.xid == "xp-dur"
+    assert rec.members == ["peer-a", "peer-b", "rt"] or rec.members == [
+        "rt",
+        "peer-a",
+        "peer-b",
+    ]
+    assert rec.dead == ["peer-b"]
+    assert rec.global_version == 5 and rec.train_seq == 6 and rec.high_water == 5
+    assert rec.suspicion == {"peer-a": 0.25}
+    np.testing.assert_array_equal(_flat_array(rec.global_params), np.full(16, 5.0))
+    (bj,) = rec.buffers
+    assert bj.tier == "regional" and bj.version == 5 and bj.vv == {"peer-a": 5}
+    ups = rebuild_updates(bj, rec.xid)
+    assert len(ups) == 1
+    assert ups[0].version == ("peer-a", 5, 4) and ups[0].xp == "xp-dur"
+    assert ups[0].contributors == ["peer-a"] and ups[0].num_samples == 3
+    # a new journal over the same directory numbers past the survivors
+    assert NodeJournal(str(tmp_path))._next_snap == 6
+
+
+def test_journal_recover_with_template_rebuilds_pytrees(tmp_path):
+    j = NodeJournal(str(tmp_path), node_name="tp")
+    j.commit_snapshot(_mk_snap("tp", 4))
+    template = {"w": np.zeros(16, np.float32)}
+    rec = NodeJournal(str(tmp_path)).recover(template=template)
+    assert set(rec.global_params.keys()) == {"w"}
+    np.testing.assert_array_equal(np.asarray(rec.global_params["w"]), np.full(16, 4.0))
+    np.testing.assert_array_equal(
+        np.asarray(rec.buffers[0].pending[0][5]["w"]), np.full(16, 8.0)
+    )
+
+
+def test_journal_empty_directory_recovers_none(tmp_path):
+    assert NodeJournal(str(tmp_path)).recover() is None
+    with pytest.raises(FileNotFoundError):
+        Node.resume(str(tmp_path), learner=DummyLearner(value=0.0), start=False)
+
+
+# ---------------------------------------------------------------------------
+# crash consistency: torture + hostile corruption
+# ---------------------------------------------------------------------------
+
+
+class _Killed(Exception):
+    """The injected SIGKILL: aborts a commit at a chosen byte offset."""
+
+
+class _KillableJournal(NodeJournal):
+    """A journal whose writes can be killed mid-flight, byte-exactly.
+
+    ``kill_mode`` selects where the next commit dies; ``record`` tracks
+    ground truth (which frames are durable, which snapshot the manifest
+    last committed) so the test can state the recovery invariant.
+    """
+
+    kill_mode = None
+    rng = None
+    record = None
+    current_marker = 0
+
+    def _write_atomic(self, name, payload):
+        is_manifest = name == "MANIFEST"
+        mode = self.kill_mode
+        if mode == "frame_tmp" and not is_manifest:
+            # killed mid temp-file write: torn bytes at the TEMP name,
+            # final name never appears
+            cut = self.rng.randrange(0, len(payload))
+            with open(os.path.join(self.directory, f"{name}.tmp.kill"), "wb") as f:
+                f.write(payload[:cut])
+            raise _Killed(name)
+        if mode == "frame_torn" and not is_manifest:
+            # the adversarial case the trailing CRC exists for: torn
+            # bytes surface at the FINAL name (power loss reordering)
+            cut = self.rng.randrange(0, len(payload))
+            with open(os.path.join(self.directory, name), "wb") as f:
+                f.write(payload[:cut])
+            raise _Killed(name)
+        if mode == "pre_manifest" and is_manifest:
+            # killed between the frame commit and the manifest commit
+            raise _Killed(name)
+        if mode == "manifest_torn" and is_manifest:
+            cut = self.rng.randrange(0, len(payload))
+            with open(os.path.join(self.directory, name), "wb") as f:
+                f.write(payload[:cut])
+            raise _Killed(name)
+        super()._write_atomic(name, payload)
+        if is_manifest:
+            self.record["floor"] = int(json.loads(payload)["snap"])
+        else:
+            m = re.match(r"^snap-(\d+)\.p2pj$", name)
+            if m:
+                self.record["durable"][int(m.group(1))] = self.current_marker
+
+
+def test_journal_torture_random_midwrite_kills(tmp_path):
+    """≥50 random mid-write kills: recovery ALWAYS lands on a committed
+    (or at worst durable-but-uncommitted, never torn) snapshot whose
+    content verifies bit-exactly against what was written."""
+    rng = random.Random(20)
+    record = {"durable": {}, "floor": 0}
+
+    def fresh_journal():
+        j = _KillableJournal(str(tmp_path), node_name="tort", keep_n=0)
+        j.rng = rng
+        j.record = record
+        return j
+
+    j = fresh_journal()
+    kills = 0
+    marker = 0
+    modes = ["frame_tmp", "frame_torn", "pre_manifest", "manifest_torn"]
+    while kills < 55:
+        marker += 1
+        mode = rng.choice(modes + [None, None])  # ~1/3 clean commits
+        j.kill_mode = mode
+        j.current_marker = marker
+        if mode is None:
+            j.commit_snapshot(_mk_snap("tort", marker))
+            continue
+        with pytest.raises(_Killed):
+            j.commit_snapshot(_mk_snap("tort", marker))
+        kills += 1
+        # "reboot": a fresh journal over the directory, as resume() does
+        j = fresh_journal()
+        j.kill_mode = None
+        rec = j.recover()
+        assert rec is not None, "a kill destroyed the committed snapshot"
+        # the recovery invariant: a durable frame, never behind the
+        # manifest's committed floor, content bit-exact as written
+        assert rec.snap in record["durable"], f"recovered torn frame {rec.snap}"
+        assert rec.snap >= record["floor"]
+        want = record["durable"][rec.snap]
+        assert rec.global_version == want
+        np.testing.assert_array_equal(
+            _flat_array(rec.global_params), np.full(16, float(want))
+        )
+        (bj,) = rec.buffers
+        assert bj.vv == {"peer-a": want}
+    assert kills >= 50 and record["floor"] > 0
+
+
+def test_journal_corruption_fixture_both_ways(tmp_path):
+    """The CRC checks cross-verify: a corrupt manifest falls back to the
+    newest self-verifying frame; a corrupt frame fails the manifest's CRC
+    AND its own, falling back to the previous committed snapshot."""
+    j = NodeJournal(str(tmp_path), node_name="fx", keep_n=0)
+    for marker in (1, 2, 3):
+        j.commit_snapshot(_mk_snap("fx", marker))
+    manifest = tmp_path / "MANIFEST"
+    committed = manifest.read_bytes()
+    # (a) manifest corrupted → scan finds the newest frame by its own CRC
+    manifest.write_bytes(b'{"snapshot": "snap-3.p2pj", "crc": 1}')
+    rec = NodeJournal(str(tmp_path)).recover()
+    assert rec is not None and rec.snap == 3 and rec.global_version == 3
+    manifest.write_bytes(b"\x00garbage\xff")
+    rec = NodeJournal(str(tmp_path)).recover()
+    assert rec is not None and rec.snap == 3 and rec.global_version == 3
+    # (b) manifest intact but its frame torn → double fallback to snap-2
+    manifest.write_bytes(committed)
+    frame = tmp_path / "snap-3.p2pj"
+    payload = bytearray(frame.read_bytes())
+    payload[len(payload) // 2] ^= 0xFF
+    frame.write_bytes(bytes(payload))
+    rec = NodeJournal(str(tmp_path)).recover()
+    assert rec is not None and rec.snap == 2 and rec.global_version == 2
+    np.testing.assert_array_equal(_flat_array(rec.global_params), np.full(16, 2.0))
+    # (b') truncation instead of a bit flip — same outcome
+    frame.write_bytes(frame.read_bytes()[: len(payload) // 3])
+    rec = NodeJournal(str(tmp_path)).recover()
+    assert rec is not None and rec.snap == 2
+
+
+# ---------------------------------------------------------------------------
+# simulator: RestartSpec replay + recovery
+# ---------------------------------------------------------------------------
+
+
+def _addrs(n):
+    return [f"sim-{i:04d}" for i in range(n)]
+
+
+def _restart_plan(n, resume_after=2.0, victims=(3, 11, 27)):
+    addrs = _addrs(n)
+    return FaultPlan(
+        seed=1905,
+        restarts={
+            addrs[i]: RestartSpec(round_no=1, resume_after_s=resume_after)
+            for i in victims
+        },
+    )
+
+
+def test_simfleet_restart_replays_bit_exact_and_recovers_budget():
+    """ISSUE 20 acceptance (sim half): crash-and-restart replays
+    bit-exact from (seed, plan) and recovers the update budget a
+    crash-only plan permanently loses."""
+    n, victims = 40, (3, 11, 27)
+
+    def run(plan):
+        return SimulatedAsyncFleet(
+            n, seed=11, cluster_size=8, updates_per_node=5, plan=plan,
+            evict_delay=0.5,
+        ).run()
+
+    a, b = run(_restart_plan(n)), run(_restart_plan(n))
+    assert sorted(a.restarted) == [f"sim-{i:04d}" for i in sorted(victims)]
+    assert a.restarted == b.restarted  # event-time order, deterministic
+    assert a.crashed == b.crashed and sorted(a.crashed) == sorted(a.restarted)
+    assert a.version == b.version and a.version > 0
+    np.testing.assert_array_equal(np.asarray(a.params["w"]), np.asarray(b.params["w"]))
+    assert a.loss_curve == b.loss_curve
+    # restart recovers the budget: every node finishes all its updates,
+    # while crash-only forfeits the victims' remainders
+    c = run(
+        FaultPlan(
+            seed=1905,
+            crashes={
+                _addrs(n)[i]: CrashSpec("AsyncTrainStage", round_no=1)
+                for i in victims
+            },
+        )
+    )
+    assert not c.restarted
+    assert a.updates_sent == n * 5
+    assert c.updates_sent < a.updates_sent
+    # minted versions stay strictly monotone through death AND rebirth
+    versions = [v for _t, v, _l in a.loss_curve]
+    assert versions == sorted(versions) and len(set(versions)) == len(versions)
+
+
+def test_simfleet_restart_epoch_guard_on_both_sides_of_eviction():
+    """A resurrection BEFORE the eviction window must invalidate the
+    pending evict (the death-epoch guard); one AFTER it re-derives the
+    node back in. Both replay bit-exact."""
+    n = 24
+
+    def run(resume_after):
+        return SimulatedAsyncFleet(
+            n, seed=5, cluster_size=8, updates_per_node=4,
+            plan=_restart_plan(n, resume_after=resume_after, victims=(7,)),
+            evict_delay=1.0,
+        ).run()
+
+    # resume at 0.2 < evict_delay 1.0: the corpse returns before the
+    # survivors ever noticed — the stale evict must not fire later
+    fast_a, fast_b = run(0.2), run(0.2)
+    assert fast_a.restarted == ["sim-0007"]
+    assert fast_a.loss_curve == fast_b.loss_curve
+    np.testing.assert_array_equal(
+        np.asarray(fast_a.params["w"]), np.asarray(fast_b.params["w"])
+    )
+    # resume at 3.0 > evict_delay: evicted, then re-derived back in
+    slow_a, slow_b = run(3.0), run(3.0)
+    assert slow_a.restarted == ["sim-0007"]
+    assert slow_a.loss_curve == slow_b.loss_curve
+    np.testing.assert_array_equal(
+        np.asarray(slow_a.params["w"]), np.asarray(slow_b.params["w"])
+    )
+    # both worlds complete the victim's budget
+    assert fast_a.updates_sent == n * 4 and slow_a.updates_sent == n * 4
+
+
+# ---------------------------------------------------------------------------
+# real gRPC: the sequence-resumption regression
+# ---------------------------------------------------------------------------
+
+
+def test_grpc_resume_first_push_accepted_precrash_duplicate_dropped(tmp_path):
+    """ISSUE 20 regression over REAL sockets: after resurrection the
+    node's first pushes are accepted (journaled seq + margin outruns the
+    aggregator's VersionVector marks), while a pre-crash in-flight
+    duplicate of its LAST update — finally delivered — is deduped, not
+    double-merged."""
+    Settings.FEDERATION_MODE = "async"
+    Settings.FEDBUFF_K = 2
+    Settings.HIER_CLUSTER_SIZE = 0
+    jdir = str(tmp_path / "journal")
+    nodes = [
+        Node(learner=DummyLearner(value=float(i)), protocol=GrpcProtocol("127.0.0.1:0"))
+        for i in range(3)
+    ]
+    for n in nodes:
+        n.start()
+    for n in nodes:
+        full_connection(n, nodes)
+    wait_convergence(nodes, 2, only_direct=True, wait=10)
+    by_addr = sorted(n.addr for n in nodes)
+    root = next(n for n in nodes if n.addr == by_addr[0])  # the aggregator
+    victim = next(n for n in nodes if n.addr == by_addr[-1])  # an edge
+    victim.enable_journal(jdir)
+    for n in nodes:
+        n.stage_hooks.append(_pace(0.35))
+    revived = None
+    try:
+        root.set_start_learning(rounds=8, epochs=1)
+        deadline = time.monotonic() + 25
+        while _sum_metric("journal_snapshot") < 2 and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert _sum_metric("journal_snapshot") >= 2, "victim never snapshotted"
+        hard_crash(victim)
+        # peek the journal for the pre-crash identity (as resume() will)
+        peek = NodeJournal(jdir).recover()
+        last_seq = peek.train_seq - 1
+        assert last_seq >= 1
+        dup_base = _sum_metric("async_dup_drop")
+        revived = Node.resume(
+            jdir, learner=DummyLearner(value=0.0), protocol=GrpcProtocol, rounds=3
+        )
+        assert revived.addr == victim.addr  # same identity, same port
+        # replay the pre-crash in-flight duplicate over the wire: the
+        # root's VersionVector already holds this (origin, seq) mark
+        dup = ModelUpdate(
+            {k: np.zeros_like(np.asarray(v)) for k, v in revived.learner.get_parameters().items()},
+            [victim.addr],
+            1,
+        )
+        dup.version = (victim.addr, last_seq, peek.base_version)
+        dup.xp = peek.xid
+        env = revived.protocol.build_weights("async_update", 0, dup)
+        assert revived.protocol.send(root.addr, env, create_connection=True)
+        deadline = time.monotonic() + 10
+        while _sum_metric("async_dup_drop") < dup_base + 1 and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert _sum_metric("async_dup_drop") == dup_base + 1, "duplicate not deduped"
+        survivors = [n for n in nodes if n is not victim] + [revived]
+        wait_to_finish(survivors, timeout=60)
+        assert _sum_metric("node_resumed") == 1
+        # the ONLY drop is the forged duplicate: every organic post-resume
+        # push from the revived node was accepted (seq margin held)
+        assert _sum_metric("async_dup_drop") == dup_base + 1
+        assert _sum_metric("async_merge") >= 2
+        params = [np.asarray(n.learner.get_parameters()["w"]) for n in survivors]
+        for p in params[1:]:
+            np.testing.assert_allclose(p, params[0], atol=1e-5)
+    finally:
+        targets = nodes + ([revived] if revived is not None else [])
+        for n in targets:
+            n.stop()
+
+
+# ---------------------------------------------------------------------------
+# live drill: FaultPlan RestartSpec + resurrect_fn
+# ---------------------------------------------------------------------------
+
+
+def test_live_kill_and_resurrect_drill(tmp_path):
+    """ISSUE 20 acceptance (live half): a 5-node fleet, one member
+    hard-crashed mid-round by a RestartSpec and resumed from its journal
+    through the resurrect_fn seam — it rejoins via the elastic path and
+    the whole fleet (survivors + resurrectee) converges on one global."""
+    Settings.FEDERATION_MODE = "async"
+    Settings.FEDBUFF_K = 2
+    Settings.HIER_CLUSTER_SIZE = 0
+    jdir = str(tmp_path / "journal")
+    nodes = [Node(learner=DummyLearner(value=float(i)), address=f"rz-{i}") for i in range(5)]
+    for n in nodes:
+        n.start()
+    for n in nodes:
+        full_connection(n, nodes)
+    wait_convergence(nodes, 4, only_direct=True, wait=10)
+    victim = nodes[3]  # an edge (rz-0 sorts first → aggregates)
+    victim.enable_journal(jdir)
+    revived_box = []
+
+    def resurrect(addr):
+        assert addr == victim.addr
+        revived_box.append(
+            Node.resume(jdir, learner=DummyLearner(value=0.0), rounds=2)
+        )
+
+    plan = FaultPlan(
+        seed=7,
+        restarts={victim.addr: RestartSpec(round_no=2, resume_after_s=1.0)},
+    )
+    install_fault_plan(nodes, plan, resurrect_fn=resurrect)
+    for n in nodes:
+        n.stage_hooks.append(_pace(0.35))
+    try:
+        nodes[0].set_start_learning(rounds=6, epochs=1)
+        deadline = time.monotonic() + 30
+        while not revived_box and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert revived_box, "the resurrection timer never fired"
+        survivors = [n for n in nodes if n is not victim] + revived_box
+        wait_to_finish(survivors, timeout=60)
+        assert _sum_metric("fault_crash") >= 1
+        assert _sum_metric("node_resumed") == 1
+        assert _sum_metric("journal_recovered") == 1
+        assert _sum_metric("journal_restored") == 1
+        assert _sum_metric("async_merge") >= 2
+        params = [np.asarray(n.learner.get_parameters()["w"]) for n in survivors]
+        for p in params[1:]:
+            np.testing.assert_allclose(p, params[0], atol=1e-5)
+    finally:
+        remove_fault_plan(nodes)
+        for n in nodes + revived_box:
+            n.stop()
